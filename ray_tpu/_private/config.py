@@ -1,0 +1,125 @@
+"""Runtime configuration flag registry.
+
+TPU-native equivalent of the reference's ``RAY_CONFIG(type, name, default)``
+macro registry (reference: ``src/ray/common/ray_config_def.h``).  Flags are
+declared once here, may be overridden by ``RAY_TPU_<NAME>`` environment
+variables, and by a ``_system_config`` dict passed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: Callable
+    doc: str
+
+
+class ConfigRegistry:
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._overrides: Dict[str, Any] = {}
+
+    def define(self, name: str, default: Any, doc: str = "") -> None:
+        ftype = type(default)
+        if ftype is bool:
+            def conv(v):
+                if isinstance(v, str):
+                    return v.lower() in ("1", "true", "yes", "on")
+                return bool(v)
+        else:
+            conv = ftype
+        self._flags[name] = _Flag(name, default, conv, doc)
+
+    def get(self, name: str) -> Any:
+        flag = self._flags[name]
+        if name in self._overrides:
+            return self._overrides[name]
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        if env is not None:
+            return flag.type(env)
+        return flag.default
+
+    def set(self, name: str, value: Any) -> None:
+        flag = self._flags[name]
+        self._overrides[name] = flag.type(value)
+
+    def apply_system_config(self, system_config: Dict[str, Any]) -> None:
+        for k, v in (system_config or {}).items():
+            if k not in self._flags:
+                raise ValueError(f"Unknown system config flag: {k}")
+            self.set(k, v)
+
+    def reset(self) -> None:
+        self._overrides.clear()
+
+    def to_json(self) -> str:
+        return json.dumps({k: self.get(k) for k in self._flags})
+
+    def items(self):
+        return {k: self.get(k) for k in self._flags}.items()
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+GLOBAL_CONFIG = ConfigRegistry()
+_d = GLOBAL_CONFIG.define
+
+# --- core object store -----------------------------------------------------
+_d("inline_object_max_bytes", 100 * 1024,
+   "Objects at or below this size live in the control-plane memory store "
+   "instead of the node shared-memory store.")
+_d("shm_store_capacity_bytes", 0,
+   "Capacity of the node shm object store. 0 = 30% of system memory.")
+_d("shm_eviction_headroom", 0.1,
+   "Fraction of capacity freed beyond demand when evicting.")
+_d("object_spill_dir", "",
+   "Directory for spilling evicted primary objects. '' = <session>/spill.")
+_d("object_store_mmap_threshold_bytes", 1024 * 1024,
+   "Reads at or above this size return zero-copy views into shm.")
+
+# --- scheduler -------------------------------------------------------------
+_d("worker_pool_min_workers", 0, "Prestarted workers per node.")
+_d("worker_lease_timeout_s", 30.0, "Timeout for leasing a worker.")
+_d("scheduler_spread_threshold", 0.5,
+   "Hybrid policy: pack nodes below this utilization, then spread.")
+_d("scheduler_top_k_fraction", 0.2,
+   "Hybrid policy: random pick among best k = max(1, frac*nodes).")
+_d("max_pending_tasks_per_node", 1_000_000, "Backpressure bound.")
+_d("max_tasks_in_flight_per_worker", 1,
+   "Pipelined task pushes per leased worker.")
+
+# --- fault tolerance -------------------------------------------------------
+_d("task_default_max_retries", 3, "Default retries for normal tasks.")
+_d("actor_default_max_restarts", 0, "Default actor restarts.")
+_d("health_check_period_s", 1.0, "Control-plane liveness probe period.")
+_d("health_check_timeout_s", 10.0, "Misses before a node is declared dead.")
+_d("lineage_max_bytes", 64 * 1024 * 1024,
+   "Budget for retained lineage specs per worker.")
+
+# --- networking ------------------------------------------------------------
+_d("rpc_connect_timeout_s", 10.0, "Socket connect timeout.")
+_d("rpc_frame_max_bytes", 512 * 1024 * 1024, "Max RPC frame size.")
+_d("pubsub_poll_timeout_s", 60.0, "Long-poll timeout for subscribers.")
+
+# --- logging / events ------------------------------------------------------
+_d("event_stats", True, "Record per-handler event-loop stats.")
+_d("task_events_max_buffer", 65536, "Ring buffer size for task events.")
+
+# --- TPU layer -------------------------------------------------------------
+_d("tpu_chips_per_host", 0, "Override detected chip count. 0 = autodetect.")
+_d("mesh_default_axes", "dp,fsdp,tp",
+   "Default logical mesh axis order for SPMD groups.")
+_d("collective_chunk_bytes", 4 * 1024 * 1024,
+   "Chunk size for host-side (CPU backend) collective pipelining.")

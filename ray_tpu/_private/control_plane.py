@@ -1,0 +1,385 @@
+"""Control plane — the cluster metadata authority.
+
+TPU-native equivalent of the reference's GCS server
+(``src/ray/gcs/gcs_server/gcs_server.cc``): internal KV, node table +
+health, actor directory (incl. named actors), object directory + inline
+memory store, placement-group table, pubsub, and task events.  One instance
+lives in the head process and is served both in-process (the driver calls
+methods directly) and over a unix socket (workers and extra node managers
+use :class:`ray_tpu._private.protocol.RpcClient`).
+
+Design departures from the reference, on purpose:
+- storage is in-memory python structures guarded by one lock per table —
+  persistence/failover (Redis-equivalent) is a later-round concern;
+- object *data* for small objects lives here (the reference keeps small
+  objects in the owner's in-process store; centralizing them gives every
+  process cheap access on one host, and the shm store handles the rest).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
+
+
+class _Waiters:
+    """Condition-variable fan-out keyed by arbitrary hashable keys."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def notify(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait_for(self, predicate, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                value = predicate()
+                if value is not None:
+                    return value
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+
+class ControlPlane:
+    def __init__(self):
+        self._lock = threading.RLock()
+        # internal KV (function table, runtime metadata, user internal_kv)
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        # object directory: id -> location dict
+        #   {"where": "inline"} | {"where": "shm", "size": int}
+        #   plus "error": bool when the stored value is a wrapped TaskError
+        self._objects: Dict[bytes, Dict[str, Any]] = {}
+        self._inline_data: Dict[bytes, bytes] = {}
+        self._object_waiters = _Waiters()
+        # actors
+        self._actors: Dict[bytes, Dict[str, Any]] = {}
+        self._named_actors: Dict[Tuple[str, str], bytes] = {}
+        self._actor_waiters = _Waiters()
+        # nodes
+        self._nodes: Dict[bytes, Dict[str, Any]] = {}
+        # placement groups
+        self._placement_groups: Dict[bytes, Dict[str, Any]] = {}
+        self._pg_waiters = _Waiters()
+        # pubsub: channel -> (seq, messages ring)
+        self._channels: Dict[str, List[Tuple[int, Any]]] = defaultdict(list)
+        self._channel_seq: Dict[str, int] = defaultdict(int)
+        self._pub_waiters = _Waiters()
+        # task events ring buffer
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_cap = 65536
+        # errors pushed to drivers
+        self._counters: Dict[str, int] = defaultdict(int)
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------- KV ----
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: str = "default") -> bool:
+        with self._lock:
+            k = (namespace, bytes(key))
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = bytes(value)
+            return True
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get((namespace, bytes(key)))
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.pop((namespace, bytes(key)), None) is not None
+
+    def kv_exists(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return (namespace, bytes(key)) in self._kv
+
+    def kv_keys(self, prefix: bytes = b"",
+                namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for (ns, k) in self._kv
+                    if ns == namespace and k.startswith(prefix)]
+
+    # --------------------------------------------------------- objects ----
+    def put_inline(self, object_id: bytes, data: bytes,
+                   is_error: bool = False, owner: bytes = b"") -> None:
+        with self._lock:
+            self._inline_data[object_id] = data
+            self._objects[object_id] = {
+                "where": "inline", "size": len(data), "error": is_error,
+                "owner": owner, "commit_time": time.time(),
+            }
+        self._object_waiters.notify()
+
+    def commit_shm(self, object_id: bytes, size: int,
+                   node_id: bytes = b"", is_error: bool = False,
+                   owner: bytes = b"") -> None:
+        with self._lock:
+            self._objects[object_id] = {
+                "where": "shm", "size": size, "node": node_id,
+                "error": is_error, "owner": owner,
+                "commit_time": time.time(),
+            }
+        self._object_waiters.notify()
+
+    def get_location(self, object_id: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            loc = self._objects.get(object_id)
+            return dict(loc) if loc else None
+
+    def get_inline(self, object_id: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._inline_data.get(object_id)
+
+    def wait_object(self, object_id: bytes,
+                    timeout: Optional[float]) -> Optional[Dict[str, Any]]:
+        """Block until the object is committed; returns its location."""
+        return self._object_waiters.wait_for(
+            lambda: self.get_location(object_id), timeout)
+
+    def wait_any(self, object_ids: List[bytes], num_returns: int,
+                 timeout: Optional[float]) -> List[bytes]:
+        """Return ids of committed objects once >= num_returns are ready."""
+        ids = [bytes(o) for o in object_ids]
+
+        def ready():
+            with self._lock:
+                done = [o for o in ids if o in self._objects]
+            if len(done) >= num_returns:
+                return done
+            return None
+
+        result = self._object_waiters.wait_for(ready, timeout)
+        if result is None:
+            with self._lock:
+                return [o for o in ids if o in self._objects]
+        return result
+
+    def free_objects(self, object_ids: List[bytes]) -> int:
+        freed = 0
+        with self._lock:
+            for o in object_ids:
+                o = bytes(o)
+                if o in self._objects:
+                    self._objects.pop(o, None)
+                    self._inline_data.pop(o, None)
+                    freed += 1
+        return freed
+
+    def objects_summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": len(self._objects),
+                "inline_bytes": sum(len(v) for v in self._inline_data.values()),
+            }
+
+    def list_objects(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(loc, object_id=oid.hex())
+                    for oid, loc in self._objects.items()]
+
+    # ---------------------------------------------------------- actors ----
+    def register_actor(self, actor_id: bytes, info: Dict[str, Any]) -> None:
+        with self._lock:
+            name = info.get("name")
+            ns = info.get("namespace", "default")
+            if name:
+                existing = self._named_actors.get((ns, name))
+                if existing is not None:
+                    state = self._actors.get(existing, {}).get("state")
+                    if state not in (None, "DEAD"):
+                        raise ValueError(
+                            f"Actor name '{name}' already taken in "
+                            f"namespace '{ns}'")
+                self._named_actors[(ns, name)] = actor_id
+            info = dict(info)
+            info.setdefault("state", "PENDING")
+            info.setdefault("num_restarts", 0)
+            info["actor_id"] = actor_id
+            self._actors[actor_id] = info
+        self._actor_waiters.notify()
+
+    def update_actor(self, actor_id: bytes, **updates) -> None:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.update(updates)
+            if updates.get("state") == "DEAD" and info.get("name"):
+                self._named_actors.pop(
+                    (info.get("namespace", "default"), info["name"]), None)
+        self._actor_waiters.notify()
+        self.publish(f"actor:{actor_id.hex()}", updates)
+
+    def get_actor_info(self, actor_id: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            return dict(info) if info else None
+
+    def wait_actor_state(self, actor_id: bytes, states: Tuple[str, ...],
+                         timeout: Optional[float]) -> Optional[Dict[str, Any]]:
+        def check():
+            info = self.get_actor_info(actor_id)
+            if info and info.get("state") in states:
+                return info
+            return None
+        return self._actor_waiters.wait_for(check, timeout)
+
+    def resolve_named_actor(self, name: str,
+                            namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._named_actors.get((namespace, name))
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(v) for v in self._actors.values()]
+
+    def list_named_actors(self, all_namespaces: bool = False,
+                          namespace: str = "default") -> List[Any]:
+        with self._lock:
+            if all_namespaces:
+                return [{"namespace": ns, "name": n}
+                        for (ns, n) in self._named_actors]
+            return [n for (ns, n) in self._named_actors if ns == namespace]
+
+    # ----------------------------------------------------------- nodes ----
+    def register_node(self, node_id: bytes, info: Dict[str, Any]) -> None:
+        with self._lock:
+            info = dict(info)
+            info["node_id"] = node_id
+            info.setdefault("state", "ALIVE")
+            info["last_heartbeat"] = time.time()
+            self._nodes[node_id] = info
+        self.publish("nodes", {"event": "register", "node_id": node_id.hex()})
+
+    def heartbeat_node(self, node_id: bytes,
+                       resources_available: Optional[Dict] = None,
+                       load: Optional[Dict] = None) -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return
+            info["last_heartbeat"] = time.time()
+            if resources_available is not None:
+                info["resources_available"] = resources_available
+            if load is not None:
+                info["load"] = load
+
+    def mark_node_dead(self, node_id: bytes, reason: str = "") -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return
+            info["state"] = "DEAD"
+            info["death_reason"] = reason
+        self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(v) for v in self._nodes.values()]
+
+    def get_node(self, node_id: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            return dict(info) if info else None
+
+    # ------------------------------------------------- placement groups ----
+    def register_placement_group(self, pg_id: bytes,
+                                 info: Dict[str, Any]) -> None:
+        with self._lock:
+            info = dict(info)
+            info["pg_id"] = pg_id
+            info.setdefault("state", "PENDING")
+            self._placement_groups[pg_id] = info
+        self._pg_waiters.notify()
+
+    def update_placement_group(self, pg_id: bytes, **updates) -> None:
+        with self._lock:
+            info = self._placement_groups.get(pg_id)
+            if info is None:
+                return
+            info.update(updates)
+        self._pg_waiters.notify()
+
+    def get_placement_group(self, pg_id: bytes) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            info = self._placement_groups.get(pg_id)
+            return dict(info) if info else None
+
+    def wait_placement_group(self, pg_id: bytes,
+                             timeout: Optional[float]) -> Optional[Dict]:
+        def check():
+            info = self.get_placement_group(pg_id)
+            if info and info.get("state") in ("CREATED", "REMOVED"):
+                return info
+            return None
+        return self._pg_waiters.wait_for(check, timeout)
+
+    def list_placement_groups(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(v) for v in self._placement_groups.values()]
+
+    # ---------------------------------------------------------- pubsub ----
+    def publish(self, channel: str, message: Any) -> int:
+        with self._lock:
+            self._channel_seq[channel] += 1
+            seq = self._channel_seq[channel]
+            ring = self._channels[channel]
+            ring.append((seq, message))
+            if len(ring) > 4096:
+                del ring[: len(ring) - 4096]
+        self._pub_waiters.notify()
+        return seq
+
+    def poll(self, channel: str, cursor: int,
+             timeout: Optional[float]) -> Tuple[int, List[Any]]:
+        """Long-poll messages with seq > cursor."""
+        def fetch():
+            with self._lock:
+                msgs = [(s, m) for (s, m) in self._channels.get(channel, [])
+                        if s > cursor]
+            if msgs:
+                return msgs
+            return None
+        msgs = self._pub_waiters.wait_for(fetch, timeout)
+        if not msgs:
+            return cursor, []
+        new_cursor = max(s for s, _ in msgs)
+        return new_cursor, [m for _, m in msgs]
+
+    # ------------------------------------------------------ task events ----
+    def add_task_event(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            event = dict(event)
+            event.setdefault("time", time.time())
+            self._task_events.append(event)
+            if len(self._task_events) > self._task_events_cap:
+                del self._task_events[: len(self._task_events)
+                                      - self._task_events_cap]
+
+    def list_task_events(self, limit: int = 10000) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._task_events[-limit:])
+
+    # -------------------------------------------------------- counters ----
+    def incr(self, name: str, amount: int = 1) -> int:
+        with self._lock:
+            self._counters[name] += amount
+            return self._counters[name]
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def ping(self) -> float:
+        return time.time()

@@ -1,0 +1,220 @@
+"""Head-node / session bootstrap.
+
+TPU-native analogue of ``python/ray/_private/node.py`` + ``services.py``:
+creates the session directory, starts the control plane and the head node
+manager (in-process rather than as separate daemons — one host needs no
+process boundary; extra nodes run :mod:`ray_tpu._private.node_proc`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import getpass
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.control_plane import ControlPlane
+from ray_tpu._private.ids import JobID, NodeID, WorkerID
+from ray_tpu._private.node_manager import NodeManager
+from ray_tpu._private.object_store import ShmStore
+from ray_tpu._private.worker import CoreWorker
+
+
+def _default_tmp_root() -> str:
+    return os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu_{getpass.getuser()}")
+
+
+def _shm_root(session_name: str) -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"ray_tpu_{session_name}")
+
+
+def _gc_stale_sessions() -> None:
+    """Remove session/shm dirs whose head process is gone.
+
+    Session names embed the head pid (``session_<ts>_<pid>``); a dead pid
+    means a crashed driver left state behind (reference equivalent: session
+    dir cleanup in ``ray start``).
+    """
+    import glob
+    import re
+    for path in (glob.glob(os.path.join(_default_tmp_root(), "session_*"))
+                 + glob.glob(_shm_root("session_*"))):
+        m = re.search(r"session_\d+_\d+_(\d+)$", path)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            shutil.rmtree(path, ignore_errors=True)
+        except PermissionError:
+            pass
+
+
+def default_resources(num_cpus: Optional[float],
+                      num_tpus: Optional[float],
+                      resources: Optional[Dict[str, float]]) -> Dict[str,
+                                                                     float]:
+    from ray_tpu.accelerators.tpu import (TPUAcceleratorManager,
+                                          detect_num_tpus)
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus) if num_cpus is not None else float(
+        os.cpu_count() or 1)
+    tpus = float(num_tpus) if num_tpus is not None else float(
+        detect_num_tpus())
+    if tpus:
+        out["TPU"] = tpus
+        head_res = TPUAcceleratorManager.get_pod_head_resource_name()
+        if head_res:
+            out[head_res] = 1.0
+    out.update({k: float(v) for k, v in (resources or {}).items()})
+    out.setdefault("node:__internal_head__", 1.0)
+    return out
+
+
+class HeadNode:
+    """Everything a single-host cluster needs, hosted in the driver."""
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 namespace: str = "default",
+                 system_config: Optional[Dict[str, Any]] = None,
+                 session_name: Optional[str] = None):
+        GLOBAL_CONFIG.apply_system_config(system_config or {})
+        _gc_stale_sessions()
+        self.session_name = session_name or (
+            f"session_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}")
+        self.session_dir = os.path.join(_default_tmp_root(),
+                                        self.session_name)
+        os.makedirs(os.path.join(self.session_dir, "sockets"), exist_ok=True)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.shm_root = _shm_root(self.session_name)
+        self.spill_dir = (GLOBAL_CONFIG.object_spill_dir
+                          or os.path.join(self.session_dir, "spill"))
+
+        self.control_plane = ControlPlane()
+        self.cp_sock_path = os.path.join(self.session_dir, "sockets",
+                                         "cp.sock")
+        self.cp_server = protocol.RpcServer(self.cp_sock_path,
+                                            self.control_plane, name="cp")
+        self.store = ShmStore(self.shm_root, spill_dir=self.spill_dir)
+        self.node_id = NodeID.from_random().binary()
+        self.resources = default_resources(num_cpus, num_tpus, resources)
+        self.node_manager = NodeManager(
+            node_id=self.node_id, session_dir=self.session_dir,
+            control_plane=self.control_plane,
+            cp_sock_path=self.cp_sock_path, shm_store=self.store,
+            resources=self.resources)
+        self.job_id = JobID.from_random()
+        self.worker = CoreWorker(
+            mode="driver", job_id=self.job_id,
+            worker_id=WorkerID.from_random(), node_id=self.node_id,
+            control_plane=self.control_plane,
+            node_manager=self.node_manager, shm_store=self.store,
+            session_dir=self.session_dir, namespace=namespace)
+        self._extra_nodes: list = []
+        self._stopped = False
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="head-health")
+        self._health_thread.start()
+        atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    def add_node(self, num_cpus: float = 1.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 env: Optional[Dict[str, str]] = None) -> bytes:
+        """Spawn an extra node-manager process (multi-node simulation).
+
+        Parity: reference ``python/ray/cluster_utils.py`` ``Cluster.add_node``
+        (real raylet processes on one machine).
+        """
+        node_id = NodeID.from_random().binary()
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        proc_env = dict(os.environ)
+        proc_env.update(env or {})
+        proc_env.update({
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_CP_SOCK": self.cp_sock_path,
+            "RAY_TPU_NODE_ID": node_id.hex(),
+            "RAY_TPU_SHM_ROOT": self.shm_root,
+            "RAY_TPU_SPILL_DIR": self.spill_dir,
+            "RAY_TPU_NODE_RESOURCES": json.dumps(res),
+        })
+        log = open(os.path.join(self.session_dir, "logs",
+                                f"node-{node_id.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_proc"],
+            env=proc_env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        self._extra_nodes.append((node_id, proc))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            info = self.control_plane.get_node(node_id)
+            if info is not None:
+                return node_id
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node process exited with {proc.returncode}")
+            time.sleep(0.05)
+        raise TimeoutError("extra node failed to register")
+
+    def remove_node(self, node_id: bytes) -> None:
+        for nid, proc in self._extra_nodes:
+            if nid == node_id:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                self.control_plane.mark_node_dead(node_id, "removed")
+                return
+        raise KeyError(node_id.hex())
+
+    # ------------------------------------------------------------------
+    def _health_loop(self):
+        timeout = GLOBAL_CONFIG.health_check_timeout_s
+        period = GLOBAL_CONFIG.health_check_period_s
+        while not self._stopped:
+            time.sleep(period)
+            if self._stopped:
+                return
+            now = time.time()
+            for info in self.control_plane.list_nodes():
+                if info["state"] != "ALIVE":
+                    continue
+                if info["node_id"] == self.node_id:
+                    continue
+                if now - info.get("last_heartbeat", now) > timeout:
+                    self.control_plane.mark_node_dead(
+                        info["node_id"], "missed heartbeats")
+
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        for nid, proc in self._extra_nodes:
+            proc.terminate()
+        for nid, proc in self._extra_nodes:
+            try:
+                proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.node_manager.stop()
+        self.cp_server.shutdown()
+        self.store.destroy()
+        shutil.rmtree(self.spill_dir, ignore_errors=True)

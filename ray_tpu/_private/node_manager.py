@@ -1,0 +1,787 @@
+"""Node manager — per-node scheduler daemon (raylet-equivalent).
+
+TPU-native analogue of the reference raylet (``src/ray/raylet/``):
+worker pool (forks language workers), task queueing + dispatch, dependency
+management, actor hosting, resource accounting, and spillback to other
+nodes.  One NodeManager runs in the head process (serving the driver
+in-process) and one per extra node process; they all talk to the same
+control plane.
+
+Scheduling follows the reference's hybrid policy shape
+(``raylet/scheduling/policy/hybrid_scheduling_policy.cc``): prefer the
+local node while utilization is below ``scheduler_spread_threshold``, then
+spread by lowest utilization; explicit strategies (spread / node-affinity /
+placement-group) override.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.task_spec import (TaskSpec, acquire, fits, release)
+from ray_tpu.exceptions import (ActorDiedError, WorkerCrashedError,
+                                format_remote_traceback)
+
+_EXIT_SENTINEL = {"type": "exit"}
+
+
+class _Worker:
+    """NM-side view of one worker process."""
+
+    def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen],
+                 tpu: bool = False):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.tpu = tpu
+        self.sock: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.state = "starting"  # starting | idle | busy | actor | dead
+        self.current_task: Optional[TaskSpec] = None
+        self.actor_id: Optional[bytes] = None
+        self.blocked = False
+        self.inflight_actor_tasks: Dict[bytes, TaskSpec] = {}
+
+    def send(self, msg: Any) -> bool:
+        if self.sock is None:
+            return False
+        try:
+            with self.send_lock:
+                protocol.send_msg(self.sock, msg)
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
+
+
+class _ActorState:
+    def __init__(self, creation_spec: TaskSpec):
+        self.creation_spec = creation_spec
+        self.worker: Optional[_Worker] = None
+        self.state = "PENDING"
+        self.queued: deque = deque()  # actor TaskSpecs awaiting a live worker
+        self.restarts_used = 0
+        self.resources = dict(creation_spec.resources)
+
+
+class NodeManager:
+    def __init__(self, node_id: bytes, session_dir: str, control_plane,
+                 cp_sock_path: str, shm_store, resources: Dict[str, float],
+                 node_ip: str = "127.0.0.1", labels: Optional[Dict] = None):
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.cp = control_plane  # ControlPlane or RpcClient
+        self.cp_sock_path = cp_sock_path
+        self.store = shm_store
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.node_ip = node_ip
+        self.labels = labels or {}
+        self._res_lock = threading.RLock()
+
+        self.sock_path = os.path.join(
+            session_dir, "sockets", f"nm_{node_id.hex()[:12]}.sock")
+        self._server = protocol.RpcServer(self.sock_path, self,
+                                          name=f"nm-{node_id.hex()[:6]}")
+
+        self._workers: Dict[bytes, _Worker] = {}
+        self._idle: deque = deque()
+        self._starting = 0
+        self._actors: Dict[bytes, _ActorState] = {}
+        self._pending: deque = deque()           # ready-to-schedule specs
+        self._waiting: Dict[bytes, TaskSpec] = {}  # task_id -> waiting on deps
+        self._retries_left: Dict[bytes, int] = {}
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        # TPU chip assignment bookkeeping
+        self._free_chips: List[int] = list(
+            range(int(resources.get("TPU", 0))))
+        self._worker_chips: Dict[bytes, List[int]] = {}
+        # remote node manager clients (for spillback / actor routing)
+        self._peers: Dict[bytes, protocol.RpcClient] = {}
+
+        self.cp.register_node(node_id, {
+            "ip": node_ip,
+            "sock_path": self.sock_path,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "session_dir": session_dir,
+        })
+
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="nm-dispatch", daemon=True)
+        self._dispatch_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="nm-heartbeat", daemon=True)
+        self._hb_thread.start()
+        for _ in range(GLOBAL_CONFIG.worker_pool_min_workers):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------------
+    # Public RPC surface (called by drivers/workers via RpcClient, or
+    # in-process by the driver).
+    # ------------------------------------------------------------------
+    def submit_task(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._retries_left.setdefault(spec.task_id, spec.max_retries)
+            self._pending.append(spec)
+        self.cp.add_task_event({"task_id": spec.task_id.hex(),
+                                "name": spec.name, "state": "PENDING",
+                                "node": self.node_id.hex()})
+        self._wake.set()
+
+    def submit_actor_creation(self, spec: TaskSpec) -> None:
+        assert spec.actor_creation and spec.actor_id
+        with self._lock:
+            self._actors[spec.actor_id] = _ActorState(spec)
+            self._pending.append(spec)
+        self._wake.set()
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        """Queue a method call on an actor hosted by this node."""
+        with self._lock:
+            astate = self._actors.get(spec.actor_id)
+            if astate is None or astate.state == "DEAD":
+                self._fail_task(spec, ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "",
+                    "actor not found or dead"))
+                return
+            astate.queued.append(spec)
+            self._flush_actor_queue_locked(astate)
+        self._wake.set()
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> bool:
+        with self._lock:
+            astate = self._actors.get(actor_id)
+            if astate is None:
+                return False
+            if no_restart:
+                astate.restarts_used = astate.creation_spec.max_restarts + 1
+            worker = astate.worker
+        if worker is not None and worker.proc is not None:
+            worker.proc.terminate()
+        elif worker is not None:
+            # in-process actor (driver-hosted) — not supported; mark dead
+            self._on_actor_worker_death(astate, "killed")
+        return True
+
+    def cancel_task(self, task_id: bytes) -> bool:
+        with self._lock:
+            for i, spec in enumerate(self._pending):
+                if spec.task_id == task_id:
+                    del self._pending[i]
+                    from ray_tpu.exceptions import TaskCancelledError
+                    self._fail_task(spec, TaskCancelledError(task_id.hex()))
+                    return True
+            spec = self._waiting.pop(task_id, None)
+        if spec is not None:
+            from ray_tpu.exceptions import TaskCancelledError
+            self._fail_task(spec, TaskCancelledError(task_id.hex()))
+            return True
+        return False
+
+    def node_stats(self) -> Dict[str, Any]:
+        with self._lock, self._res_lock:
+            return {
+                "node_id": self.node_id.hex(),
+                "resources_total": dict(self.resources_total),
+                "resources_available": dict(self.resources_available),
+                "num_workers": len(self._workers),
+                "num_idle": len(self._idle),
+                "num_pending": len(self._pending),
+                "num_waiting": len(self._waiting),
+                "num_actors": len(self._actors),
+                "store": self.store.stats(),
+            }
+
+    def reserve_bundle(self, pg_id: bytes, bundle_index: int,
+                       resources: Dict[str, float]) -> bool:
+        """Placement-group 2PC 'prepare+commit' collapsed to one step.
+
+        Mirrors the effect of the reference's
+        ``PrepareBundleResources``/``CommitBundleResources``
+        (``protobuf/node_manager.proto``): on success the node exposes
+        bundle-indexed custom resources that PG-scheduled tasks consume.
+        """
+        wildcard = f"pg_{pg_id.hex()}"
+        indexed = f"pg_{pg_id.hex()}_{bundle_index}"
+        with self._res_lock:
+            if not fits(self.resources_available, resources):
+                return False
+            acquire(self.resources_available, resources)
+            for name, qty in resources.items():
+                self.resources_total[f"{indexed}_{name}"] = qty
+                self.resources_available[f"{indexed}_{name}"] = qty
+                self.resources_total[f"{wildcard}_{name}"] = (
+                    self.resources_total.get(f"{wildcard}_{name}", 0) + qty)
+                self.resources_available[f"{wildcard}_{name}"] = (
+                    self.resources_available.get(f"{wildcard}_{name}", 0)
+                    + qty)
+        self._wake.set()
+        return True
+
+    def return_bundle(self, pg_id: bytes, bundle_index: int,
+                      resources: Dict[str, float]) -> None:
+        wildcard = f"pg_{pg_id.hex()}"
+        indexed = f"pg_{pg_id.hex()}_{bundle_index}"
+        with self._res_lock:
+            for name, qty in resources.items():
+                self.resources_total.pop(f"{indexed}_{name}", None)
+                self.resources_available.pop(f"{indexed}_{name}", None)
+                wkey = f"{wildcard}_{name}"
+                if wkey in self.resources_total:
+                    self.resources_total[wkey] -= qty
+                    self.resources_available[wkey] = (
+                        self.resources_available.get(wkey, 0) - qty)
+                    if self.resources_total[wkey] <= 0:
+                        self.resources_total.pop(wkey, None)
+                        self.resources_available.pop(wkey, None)
+            release(self.resources_available, resources)
+        self._wake.set()
+
+    def shutdown_node(self) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # Worker channel (hijacked connection)
+    # ------------------------------------------------------------------
+    def stream_worker(self, conn: socket.socket, worker_id: bytes) -> None:
+        """A worker process registered its task channel."""
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                worker = _Worker(worker_id, None)
+                self._workers[worker_id] = worker
+            worker.sock = conn
+            worker.state = "idle"
+            self._starting = max(0, self._starting - 1)
+            self._idle.append(worker)
+        self._wake.set()
+        self._worker_reader(worker)
+
+    def _worker_reader(self, worker: _Worker) -> None:
+        try:
+            while True:
+                msg = protocol.recv_msg(worker.sock)
+                self._handle_worker_msg(worker, msg)
+        except (protocol.ConnectionClosed, ConnectionResetError, OSError,
+                EOFError):
+            self._on_worker_death(worker)
+
+    def _handle_worker_msg(self, worker: _Worker, msg: Dict[str, Any]):
+        kind = msg.get("type")
+        if kind == "done":
+            task_id = msg["task_id"]
+            with self._lock:
+                if worker.actor_id is not None:
+                    worker.inflight_actor_tasks.pop(task_id, None)
+                    spec = None
+                else:
+                    spec = worker.current_task
+                    worker.current_task = None
+            if spec is not None:
+                self._release_task_resources(spec, worker)
+                retrying = False
+                if msg.get("error") and msg.get("error_payload") is not None:
+                    # Application exception with retry_exceptions=True: the
+                    # worker deferred the error commit so we can resubmit.
+                    with self._lock:
+                        left = self._retries_left.get(spec.task_id, 0)
+                        if left > 0:
+                            self._retries_left[spec.task_id] = left - 1
+                            self._pending.append(spec)
+                            retrying = True
+                    if not retrying:
+                        for oid in spec.return_object_ids():
+                            self.cp.put_inline(oid, msg["error_payload"],
+                                               is_error=True)
+                        self._fail_generator_stream(spec,
+                                                    msg["error_payload"])
+                with self._lock:
+                    if not retrying:
+                        self._retries_left.pop(spec.task_id, None)
+                    if worker.state == "busy":
+                        worker.state = "idle"
+                        self._idle.append(worker)
+            self.cp.add_task_event({
+                "task_id": task_id.hex(), "state": "FINISHED"
+                if not msg.get("error") else "FAILED",
+                "node": self.node_id.hex()})
+            self._wake.set()
+        elif kind == "actor_ready":
+            with self._lock:
+                astate = self._actors.get(msg["actor_id"])
+                if astate is not None:
+                    astate.state = "ALIVE"
+                    astate.worker = worker
+                    worker.actor_id = msg["actor_id"]
+                    worker.state = "actor"
+                    self._flush_actor_queue_locked(astate)
+            self.cp.update_actor(msg["actor_id"], state="ALIVE",
+                                 node_id=self.node_id,
+                                 nm_sock=self.sock_path,
+                                 pid=msg.get("pid"))
+            self._wake.set()
+        elif kind == "actor_init_failed":
+            with self._lock:
+                astate = self._actors.get(msg["actor_id"])
+                spec = worker.current_task
+                worker.current_task = None
+                worker.actor_id = None
+            if spec is not None:
+                self._release_task_resources(spec, worker)
+            with self._lock:
+                # recycle the worker: the failed __init__ left no state
+                worker.state = "idle"
+                self._idle.append(worker)
+            if astate is not None:
+                # Creation raised: do not restart, error is in the object.
+                astate.restarts_used = astate.creation_spec.max_restarts + 1
+                self._on_actor_worker_death(astate, "init failed",
+                                            from_msg=True, worker=worker)
+            self._wake.set()
+        elif kind == "blocked":
+            # Worker blocked in get(): release its CPU so the node can run
+            # other tasks (reference: CPU borrowing while blocked).
+            with self._lock:
+                if not worker.blocked and worker.current_task:
+                    worker.blocked = True
+                    cpus = worker.current_task.resources.get("CPU", 0)
+                    if cpus:
+                        with self._res_lock:
+                            release(self.resources_available, {"CPU": cpus})
+            self._wake.set()
+        elif kind == "unblocked":
+            with self._lock:
+                if worker.blocked and worker.current_task:
+                    worker.blocked = False
+                    cpus = worker.current_task.resources.get("CPU", 0)
+                    if cpus:
+                        with self._res_lock:
+                            acquire(self.resources_available, {"CPU": cpus})
+        elif kind == "exit":
+            with self._lock:
+                worker.state = "dead"
+
+    # ------------------------------------------------------------------
+    # Dispatch
+    # ------------------------------------------------------------------
+    def _dispatch_loop(self):
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            try:
+                self._dispatch_once()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _dispatch_once(self):
+        with self._lock:
+            queue = list(self._pending)
+            self._pending.clear()
+        requeue: List[TaskSpec] = []
+        for spec in queue:
+            if self._stopped.is_set():
+                return
+            deps = spec.dependencies()
+            unready = [d for d in deps if self.cp.get_location(d) is None]
+            if unready:
+                self._wait_for_deps(spec, unready)
+                continue
+            if not self._try_dispatch(spec):
+                requeue.append(spec)
+        if requeue:
+            with self._lock:
+                # preserve order ahead of newly arrived tasks
+                self._pending.extendleft(reversed(requeue))
+
+    def _wait_for_deps(self, spec: TaskSpec, deps: List[bytes]):
+        with self._lock:
+            self._waiting[spec.task_id] = spec
+
+        def waiter():
+            remaining = list(deps)
+            while remaining and not self._stopped.is_set():
+                ready = self.cp.wait_any(remaining, len(remaining), 5.0)
+                remaining = [d for d in remaining if d not in set(ready)]
+            with self._lock:
+                if self._waiting.pop(spec.task_id, None) is not None:
+                    self._pending.append(spec)
+            self._wake.set()
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="nm-depwait").start()
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[Dict[str, Any]]:
+        """Choose a target node; None => run locally."""
+        strategy = spec.scheduling_strategy
+        nodes = [n for n in self.cp.list_nodes() if n["state"] == "ALIVE"]
+        if strategy.kind == "node_affinity":
+            if strategy.node_id == self.node_id:
+                return None
+            for n in nodes:
+                if n["node_id"] == strategy.node_id:
+                    return n
+            if strategy.soft:
+                return None
+            return None  # hard affinity to a dead node: run locally & fail?
+        if strategy.kind == "spread":
+            # Round-robin over nodes that can ever fit the shape; heartbeat
+            # load is too stale (1s) to break ties between bursts.
+            candidates = sorted(
+                (n for n in nodes
+                 if fits(n.get("resources_total", {}), spec.resources)
+                 or n["node_id"] == self.node_id),
+                key=lambda n: n["node_id"])
+            if not candidates:
+                return None
+            self._spread_rr = getattr(self, "_spread_rr", -1) + 1
+            best = candidates[self._spread_rr % len(candidates)]
+            return None if best["node_id"] == self.node_id else best
+        # default hybrid: local first if it can ever fit and is under
+        # the spread threshold; else best remote fit.
+        with self._res_lock:
+            local_fits_now = fits(self.resources_available, spec.resources)
+            local_fits_ever = fits(self.resources_total, spec.resources)
+            total_cpu = self.resources_total.get("CPU", 0) or 1
+            local_util = 1.0 - (self.resources_available.get("CPU", 0)
+                                / total_cpu)
+        if local_fits_now:
+            return None
+        if (local_fits_ever
+                and local_util < GLOBAL_CONFIG.scheduler_spread_threshold):
+            return None
+        for n in nodes:
+            if n["node_id"] == self.node_id:
+                continue
+            if fits(n.get("resources_available", {}), spec.resources):
+                return n
+        return None if local_fits_ever else (nodes and None)
+
+    def _try_dispatch(self, spec: TaskSpec) -> bool:
+        target = self._pick_node(spec)
+        if target is not None:
+            try:
+                peer = self._peer_client(target)
+                if spec.actor_creation:
+                    peer.call("submit_actor_creation", spec)
+                else:
+                    peer.call("submit_task", spec)
+                return True
+            except (OSError, ConnectionError):
+                pass  # fall through to local
+        with self._res_lock:
+            if not fits(self.resources_available, spec.resources):
+                return False
+            acquire(self.resources_available, spec.resources)
+        need_tpu = spec.resources.get("TPU", 0) > 0
+        worker = self._take_idle_worker(need_tpu)
+        if worker is None:
+            with self._res_lock:
+                release(self.resources_available, spec.resources)
+            self._maybe_spawn_worker(need_tpu)
+            return False
+        chips = self._assign_chips(spec, worker)
+        with self._lock:
+            worker.current_task = spec
+            worker.state = "busy" if not spec.actor_creation else "actor"
+        ok = worker.send({"type": "task", "spec": spec, "chips": chips})
+        if not ok:
+            self._on_worker_death(worker)
+            return False
+        self.cp.add_task_event({"task_id": spec.task_id.hex(),
+                                "name": spec.name, "state": "RUNNING",
+                                "node": self.node_id.hex(),
+                                "worker": worker.worker_id.hex()})
+        return True
+
+    def _flush_actor_queue_locked(self, astate: _ActorState):
+        if astate.state != "ALIVE" or astate.worker is None:
+            return
+        while astate.queued:
+            spec = astate.queued.popleft()
+            astate.worker.inflight_actor_tasks[spec.task_id] = spec
+            if not astate.worker.send({"type": "task", "spec": spec,
+                                       "chips": None}):
+                astate.queued.appendleft(spec)
+                astate.worker.inflight_actor_tasks.pop(spec.task_id, None)
+                break
+
+    # ------------------------------------------------------------------
+    # Worker pool
+    # ------------------------------------------------------------------
+    def _take_idle_worker(self, need_tpu: bool = False) -> Optional[_Worker]:
+        with self._lock:
+            for i, w in enumerate(self._idle):
+                if (w.state == "idle" and w.sock is not None
+                        and w.tpu == need_tpu):
+                    del self._idle[i]
+                    return w
+            # clean out dead entries
+            self._idle = deque(w for w in self._idle
+                               if w.state == "idle" and w.sock is not None)
+            return None
+
+    def _maybe_spawn_worker(self, tpu: bool = False):
+        with self._lock:
+            # Bound concurrent starts: worker startup is expensive (python +
+            # preloaded jax); a tight dispatch loop must not fork-bomb.
+            max_concurrent_starts = max(2, int(os.cpu_count() or 1))
+            if self._starting >= max_concurrent_starts:
+                return
+            max_workers = int(self.resources_total.get("CPU", 1)) + 64
+            if len(self._workers) + self._starting >= max_workers:
+                return
+            self._starting += 1
+        self._spawn_worker(tpu)
+
+    def _spawn_worker(self, tpu: bool = False):
+        worker_id = WorkerID.from_random().binary()
+        env = dict(os.environ)
+        if not tpu:
+            # CPU workers skip the TPU runtime entirely: drop any site hook
+            # that pre-imports jax/claims chips, and pin jax (if a task
+            # imports it) to the host platform.  This makes worker startup
+            # ~10x faster and keeps the node's TPU chips free for workers
+            # that actually request the TPU resource.
+            parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p and "axon" not in p]
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            if repo_root not in parts:
+                parts.append(repo_root)
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+            env["JAX_PLATFORMS"] = "cpu"
+        env.update({
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_CP_SOCK": self.cp_sock_path,
+            "RAY_TPU_NM_SOCK": self.sock_path,
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+            "RAY_TPU_SHM_ROOT": self.store.root,
+            "RAY_TPU_SPILL_DIR": self.store.spill_dir or "",
+        })
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=False)
+        out.close()
+        with self._lock:
+            worker = _Worker(worker_id, proc, tpu=tpu)
+            self._workers[worker_id] = worker
+
+    def _assign_chips(self, spec: TaskSpec,
+                      worker: _Worker) -> Optional[List[int]]:
+        n = int(spec.resources.get("TPU", 0))
+        if n <= 0:
+            return None
+        with self._res_lock:
+            chips = self._free_chips[:n]
+            del self._free_chips[:n]
+        self._worker_chips[worker.worker_id] = chips
+        return chips
+
+    def _release_task_resources(self, spec: TaskSpec, worker: _Worker):
+        with self._res_lock:
+            res = dict(spec.resources)
+            if worker.blocked:
+                res.pop("CPU", None)
+                worker.blocked = False
+            release(self.resources_available, res)
+            chips = self._worker_chips.pop(worker.worker_id, None)
+            if chips:
+                self._free_chips.extend(chips)
+
+    # ------------------------------------------------------------------
+    # Failure handling
+    # ------------------------------------------------------------------
+    def _on_worker_death(self, worker: _Worker):
+        with self._lock:
+            if worker.state == "dead":
+                return
+            prev_state = worker.state
+            worker.state = "dead"
+            self._workers.pop(worker.worker_id, None)
+            spec = worker.current_task
+            worker.current_task = None
+            actor_id = worker.actor_id
+        if prev_state == "starting":
+            with self._lock:
+                self._starting = max(0, self._starting - 1)
+        if spec is not None:
+            self._release_task_resources(spec, worker)
+            if actor_id is None and not spec.actor_creation:
+                self._maybe_retry(spec)
+        if actor_id is not None or (spec is not None and spec.actor_creation):
+            aid = actor_id or spec.actor_id
+            with self._lock:
+                astate = self._actors.get(aid)
+            if astate is not None:
+                self._on_actor_worker_death(astate, "worker died",
+                                            worker=worker)
+        self._wake.set()
+
+    def _maybe_retry(self, spec: TaskSpec):
+        with self._lock:
+            left = self._retries_left.get(spec.task_id, 0)
+            if left > 0:
+                self._retries_left[spec.task_id] = left - 1
+                self._pending.append(spec)
+                retried = True
+            else:
+                retried = False
+        if retried:
+            self.cp.add_task_event({"task_id": spec.task_id.hex(),
+                                    "state": "RETRY",
+                                    "node": self.node_id.hex()})
+            self._wake.set()
+        else:
+            self._fail_task(spec, WorkerCrashedError(
+                f"worker died while running task {spec.name}"))
+
+    def _on_actor_worker_death(self, astate: _ActorState, reason: str,
+                               from_msg: bool = False,
+                               worker: Optional[_Worker] = None):
+        spec = astate.creation_spec
+        # Fail in-flight calls on the dead worker; they are not retried
+        # (at-most-once actor semantics unless max_task_retries).
+        dead_worker = worker or astate.worker
+        inflight = []
+        if dead_worker is not None:
+            with self._lock:
+                inflight = list(dead_worker.inflight_actor_tasks.values())
+                dead_worker.inflight_actor_tasks.clear()
+        can_restart = (spec.max_restarts == -1
+                       or astate.restarts_used < spec.max_restarts)
+        for t in inflight:
+            if t.max_task_retries != 0 and can_restart:
+                with self._lock:
+                    astate.queued.appendleft(t)
+            else:
+                self._fail_task(t, ActorDiedError(
+                    spec.actor_id.hex(), reason))
+        with self._lock:
+            astate.worker = None
+            if can_restart:
+                astate.state = "RESTARTING"
+                astate.restarts_used += 1
+                if spec.actor_creation:
+                    self._pending.append(spec)
+            else:
+                astate.state = "DEAD"
+                queued = list(astate.queued)
+                astate.queued.clear()
+        if can_restart:
+            self.cp.update_actor(spec.actor_id, state="RESTARTING",
+                                 num_restarts=astate.restarts_used)
+            self._wake.set()
+        else:
+            if not from_msg:
+                # creation object may still be pending a consumer: mark error
+                self._fail_task(spec, ActorDiedError(spec.actor_id.hex(),
+                                                     reason))
+            for t in queued:
+                self._fail_task(t, ActorDiedError(spec.actor_id.hex(),
+                                                  reason))
+            self.cp.update_actor(spec.actor_id, state="DEAD",
+                                 death_reason=reason)
+
+    def _fail_task(self, spec: TaskSpec, error: BaseException):
+        """Commit error objects for every return so getters unblock."""
+        from ray_tpu.exceptions import TaskError
+        err = TaskError(error, format_remote_traceback(error),
+                        spec.task_id.hex())
+        data = serialization.dumps(err)
+        for oid in spec.return_object_ids():
+            if self.cp.get_location(oid) is None:
+                self.cp.put_inline(oid, data, is_error=True)
+        self._fail_generator_stream(spec, data)
+        self.cp.add_task_event({"task_id": spec.task_id.hex(),
+                                "state": "FAILED",
+                                "node": self.node_id.hex()})
+
+    def _fail_generator_stream(self, spec: TaskSpec, error_data: bytes):
+        """Terminate a dead generator stream so consumers unblock.
+
+        Commits the error as the next stream item and seals the stream with
+        a length marker (items live at return indices 1.., marker at
+        GEN_LEN_INDEX — see CoreWorker generator protocol).
+        """
+        if not spec.is_generator:
+            return
+        from ray_tpu._private.ids import ObjectID, TaskID
+        from ray_tpu._private.worker import GEN_LEN_INDEX
+        tid = TaskID(spec.task_id)
+        len_oid = ObjectID(
+            spec.task_id + GEN_LEN_INDEX.to_bytes(4, "big")).binary()
+        if self.cp.get_location(len_oid) is not None:
+            return  # stream completed normally
+        index = 0
+        while self.cp.get_location(
+                ObjectID.for_task_return(tid, index + 1).binary()) is not None:
+            index += 1
+        self.cp.put_inline(
+            ObjectID.for_task_return(tid, index + 1).binary(),
+            error_data, is_error=True)
+        self.cp.put_inline(len_oid, serialization.dumps(index + 1))
+
+    # ------------------------------------------------------------------
+    def _peer_client(self, node_info: Dict[str, Any]) -> protocol.RpcClient:
+        nid = node_info["node_id"]
+        if isinstance(nid, str):
+            nid = bytes.fromhex(nid)
+        client = self._peers.get(nid)
+        if client is None:
+            client = protocol.RpcClient(node_info["sock_path"])
+            self._peers[nid] = client
+        return client
+
+    def _heartbeat_loop(self):
+        period = GLOBAL_CONFIG.health_check_period_s
+        while not self._stopped.wait(period):
+            try:
+                with self._res_lock:
+                    avail = dict(self.resources_available)
+                with self._lock:
+                    load = {"num_pending": len(self._pending)
+                            + len(self._waiting)}
+                self.cp.heartbeat_node(self.node_id, avail, load)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stop(self):
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._wake.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.send(_EXIT_SENTINEL)
+        deadline = time.time() + 2.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=max(0.05, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    w.proc.terminate()
+                    try:
+                        w.proc.wait(timeout=1.0)
+                    except subprocess.TimeoutExpired:
+                        w.proc.kill()
+        self._server.shutdown()

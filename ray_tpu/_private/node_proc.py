@@ -1,0 +1,44 @@
+"""Extra node-manager process (multi-node simulation on one host).
+
+Started by :meth:`ray_tpu._private.node.HeadNode.add_node`; runs one
+NodeManager with its own worker pool against the shared control plane and
+shm store (same host, so the object plane is naturally shared — chunked
+cross-host transfer is a later-round feature tracked in ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+
+from ray_tpu._private import protocol
+from ray_tpu._private.node_manager import NodeManager
+from ray_tpu._private.object_store import ShmStore
+
+
+def main():
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    cp_sock = os.environ["RAY_TPU_CP_SOCK"]
+    node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
+    resources = json.loads(os.environ["RAY_TPU_NODE_RESOURCES"])
+    cp = protocol.RpcClient(cp_sock)
+    store = ShmStore(os.environ["RAY_TPU_SHM_ROOT"],
+                     spill_dir=os.environ.get("RAY_TPU_SPILL_DIR") or None)
+    nm = NodeManager(node_id=node_id, session_dir=session_dir,
+                     control_plane=cp, cp_sock_path=cp_sock,
+                     shm_store=store, resources=resources)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    nm.stop()
+
+
+if __name__ == "__main__":
+    main()

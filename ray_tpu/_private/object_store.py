@@ -1,0 +1,253 @@
+"""Node shared-memory object store.
+
+TPU-native equivalent of the reference's Plasma store
+(``src/ray/object_manager/plasma/store.cc``): immutable, sealed objects in
+shared memory, read zero-copy by every process on the node.
+
+Design: instead of a store *daemon* owning one big dlmalloc'd mmap and a
+socket protocol (the reference's design, built for a world without
+``memfd``/tmpfs maturity), each object is a file in a per-session tmpfs
+directory (``/dev/shm``).  Creation is atomic (write to ``*.tmp``, then
+``rename``), reads are ``mmap(MAP_SHARED, PROT_READ)`` so numpy buffers
+deserialize as zero-copy views.  Capacity accounting + LRU eviction +
+spill-to-disk are handled by :class:`ShmStore`; a C++ fastpath
+(``src/shmstore``) accelerates bulk copies when built, with this module as
+the always-available fallback.
+
+The *tensor plane does not live here*: jax device arrays stay in HBM and
+move over ICI/DCN via XLA collectives.  This store carries host-side task
+args/returns, dataset blocks, and checkpoints.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+def _default_capacity() -> int:
+    cap = GLOBAL_CONFIG.shm_store_capacity_bytes
+    if cap:
+        return cap
+    try:
+        total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        total = 8 << 30
+    return int(total * 0.3)
+
+
+class _MappedObject:
+    """Keeps the mmap alive as long as any deserialized view references it."""
+
+    __slots__ = ("mm", "path")
+
+    def __init__(self, path: str):
+        self.path = path
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            self.mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+
+    def view(self) -> memoryview:
+        return memoryview(self.mm)
+
+
+class ShmStore:
+    """Per-node object store rooted at a tmpfs directory."""
+
+    def __init__(self, root: str, capacity: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.capacity = capacity or _default_capacity()
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        # id -> (size, last_access); rebuilt lazily from disk on miss
+        self._index: Dict[bytes, Tuple[int, float]] = {}
+        self._used = 0
+        # Sealed mmaps cached per process so repeated gets share one mapping.
+        self._mapped: Dict[bytes, _MappedObject] = {}
+
+    # -------------------------------------------------------- paths -----
+    def _path(self, object_id: bytes) -> str:
+        return os.path.join(self.root, object_id.hex())
+
+    def _spill_path(self, object_id: bytes) -> str:
+        assert self.spill_dir
+        return os.path.join(self.spill_dir, object_id.hex())
+
+    # -------------------------------------------------------- write -----
+    def put_serialized(self, object_id: bytes,
+                       obj: "serialization.SerializedObject") -> int:
+        """Create + seal an object; returns its sealed size."""
+        size = obj.total_bytes
+        self._ensure_capacity(size)
+        path = self._path(object_id)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w+b") as f:
+            f.truncate(size)
+            with mmap.mmap(f.fileno(), size) as mm:
+                obj.write_into(memoryview(mm))
+        os.rename(tmp, path)  # seal: atomic visibility
+        with self._lock:
+            self._index[object_id] = (size, time.monotonic())
+            self._used += size
+        return size
+
+    def put_bytes(self, object_id: bytes, data: bytes) -> int:
+        self._ensure_capacity(len(data))
+        path = self._path(object_id)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, path)
+        with self._lock:
+            self._index[object_id] = (len(data), time.monotonic())
+            self._used += len(data)
+        return len(data)
+
+    # --------------------------------------------------------- read -----
+    def contains(self, object_id: bytes) -> bool:
+        return os.path.exists(self._path(object_id)) or (
+            self.spill_dir is not None
+            and os.path.exists(self._spill_path(object_id)))
+
+    def get_view(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object; None if absent."""
+        with self._lock:
+            mapped = self._mapped.get(object_id)
+            if mapped is not None:
+                self._touch(object_id)
+                return mapped.view()
+        path = self._path(object_id)
+        if not os.path.exists(path):
+            if not self._restore_from_spill(object_id):
+                return None
+        try:
+            mapped = _MappedObject(path)
+        except (FileNotFoundError, ValueError):
+            return None
+        with self._lock:
+            self._mapped[object_id] = mapped
+            self._touch(object_id)
+        return mapped.view()
+
+    def get_object(self, object_id: bytes) -> Optional[Any]:
+        view = self.get_view(object_id)
+        if view is None:
+            return None
+        return serialization.deserialize_frame(view)
+
+    def size_of(self, object_id: bytes) -> Optional[int]:
+        try:
+            return os.stat(self._path(object_id)).st_size
+        except FileNotFoundError:
+            return None
+
+    # ------------------------------------------------------- delete -----
+    def delete(self, object_id: bytes) -> bool:
+        with self._lock:
+            self._mapped.pop(object_id, None)
+            entry = self._index.pop(object_id, None)
+            if entry:
+                self._used -= entry[0]
+        removed = False
+        for path in ([self._path(object_id)]
+                     + ([self._spill_path(object_id)] if self.spill_dir
+                        else [])):
+            try:
+                os.unlink(path)
+                removed = True
+            except FileNotFoundError:
+                pass
+        return removed
+
+    # ----------------------------------------------- eviction / spill ----
+    def _touch(self, object_id: bytes) -> None:
+        entry = self._index.get(object_id)
+        if entry:
+            self._index[object_id] = (entry[0], time.monotonic())
+
+    def _ensure_capacity(self, need: int) -> None:
+        if need > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {need} bytes exceeds store capacity "
+                f"{self.capacity}")
+        with self._lock:
+            if self._used + need <= self.capacity:
+                return
+            headroom = int(self.capacity * GLOBAL_CONFIG.shm_eviction_headroom)
+            target = self._used + need - self.capacity + headroom
+            victims = sorted(self._index.items(), key=lambda kv: kv[1][1])
+        freed = 0
+        for oid, (size, _) in victims:
+            if freed >= target:
+                break
+            if self._evict_one(oid):
+                freed += size
+        with self._lock:
+            if self._used + need > self.capacity:
+                raise ObjectStoreFullError(
+                    f"cannot free {need} bytes (used={self._used}, "
+                    f"capacity={self.capacity})")
+
+    def _evict_one(self, object_id: bytes) -> bool:
+        """Spill to disk if configured, else drop (directory will recommit)."""
+        path = self._path(object_id)
+        with self._lock:
+            if object_id in self._mapped:
+                return False  # actively mapped in this process; skip
+            entry = self._index.pop(object_id, None)
+            if entry:
+                self._used -= entry[0]
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            try:
+                shutil.move(path, self._spill_path(object_id))
+                return True
+            except FileNotFoundError:
+                return False
+        try:
+            os.unlink(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def _restore_from_spill(self, object_id: bytes) -> bool:
+        if not self.spill_dir:
+            return False
+        spath = self._spill_path(object_id)
+        if not os.path.exists(spath):
+            return False
+        size = os.stat(spath).st_size
+        self._ensure_capacity(size)
+        shutil.move(spath, self._path(object_id))
+        with self._lock:
+            self._index[object_id] = (size, time.monotonic())
+            self._used += size
+        return True
+
+    # -------------------------------------------------------- stats -----
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"used_bytes": self._used, "capacity_bytes": self.capacity,
+                    "num_objects": len(self._index),
+                    "num_mapped": len(self._mapped)}
+
+    def release_mappings(self) -> None:
+        with self._lock:
+            self._mapped.clear()
+
+    def destroy(self) -> None:
+        self.release_mappings()
+        shutil.rmtree(self.root, ignore_errors=True)

@@ -1,0 +1,207 @@
+"""Framed RPC over unix-domain sockets.
+
+TPU-native counterpart of the reference's gRPC layer (``src/ray/rpc/``).
+The control plane and node managers are in-cluster trusted peers on the
+same host or VPC, so the wire format is length-prefixed pickle frames —
+simple, fast, and sufficient for the control plane.  The *tensor* plane
+never touches this layer: device arrays move over ICI/DCN inside XLA
+programs, host objects through the shm object store.
+
+Frame: [u64 little-endian length][pickle payload]
+
+Server: thread per connection; handlers may block (long-poll waits).
+Client: one persistent connection per thread (so a blocking call only
+blocks its own thread), with automatic reconnect.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+_LEN = struct.Struct("<Q")
+
+
+class ConnectionClosed(ConnectionError):
+    pass
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 4 * 1024 * 1024))
+        if not chunk:
+            raise ConnectionClosed("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    return recv_exact(sock, length)
+
+
+def send_msg(sock: socket.socket, msg: Any) -> None:
+    send_frame(sock, pickle.dumps(msg, protocol=5))
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    return pickle.loads(recv_frame(sock))
+
+
+class RpcServer:
+    """Threaded unix-socket server dispatching to a handler object.
+
+    Any public method of ``handler`` is callable remotely.  A request is
+    ``("call", method, args, kwargs)``; the reply ``("ok", result)`` or
+    ``("err", exc)``.  Connections may also be *hijacked*: if the handler
+    method name starts with ``stream_`` it receives the raw socket and owns
+    the connection from then on (used for worker task channels).
+    """
+
+    def __init__(self, sock_path: str, handler: Any, name: str = "rpc"):
+        self.sock_path = sock_path
+        self.handler = handler
+        self.name = name
+        os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(sock_path)
+        self._sock.listen(512)
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name=f"{self.name}-conn", daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                try:
+                    req = recv_msg(conn)
+                except (ConnectionClosed, ConnectionResetError, OSError,
+                        EOFError):
+                    return
+                kind = req[0]
+                if kind != "call":
+                    send_msg(conn, ("err", ValueError(f"bad frame {kind}")))
+                    continue
+                _, method, args, kwargs = req
+                if method.startswith("stream_"):
+                    # Connection handoff: handler owns the socket now.
+                    fn = getattr(self.handler, method)
+                    fn(conn, *args, **kwargs)
+                    return
+                try:
+                    fn = getattr(self.handler, method)
+                    if method.startswith("_"):
+                        raise AttributeError(method)
+                    result = fn(*args, **kwargs)
+                    reply = ("ok", result)
+                except BaseException as e:  # noqa: BLE001 - ship to caller
+                    e._remote_tb = traceback.format_exc()  # type: ignore
+                    reply = ("err", e)
+                try:
+                    send_msg(conn, reply)
+                except (BrokenPipeError, OSError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def shutdown(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if os.path.exists(self.sock_path):
+            try:
+                os.unlink(self.sock_path)
+            except OSError:
+                pass
+
+
+class RpcClient:
+    """Thread-local persistent connections to an RpcServer."""
+
+    def __init__(self, sock_path: str, connect_timeout: float = 10.0):
+        self.sock_path = sock_path
+        self.connect_timeout = connect_timeout
+        self._local = threading.local()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.connect_timeout)
+        sock.connect(self.sock_path)
+        sock.settimeout(None)
+        return sock
+
+    def _conn(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = self._connect()
+            self._local.sock = sock
+        return sock
+
+    def call(self, method: str, *args, **kwargs) -> Any:
+        for attempt in (0, 1):
+            sock = self._conn()
+            try:
+                send_msg(sock, ("call", method, args, kwargs))
+                status, payload = recv_msg(sock)
+                break
+            except (ConnectionClosed, ConnectionResetError, BrokenPipeError,
+                    OSError):
+                self._local.sock = None
+                if attempt == 1:
+                    raise
+        if status == "ok":
+            return payload
+        raise payload
+
+    def __getattr__(self, name: str) -> Callable:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        def _proxy(*args, **kwargs):
+            return self.call(name, *args, **kwargs)
+        _proxy.__name__ = name
+        return _proxy
+
+    def hijack(self, method: str, *args, **kwargs) -> socket.socket:
+        """Open a fresh connection and hand it to a ``stream_`` handler."""
+        sock = self._connect()
+        send_msg(sock, ("call", method, args, kwargs))
+        return sock
+
+    def close(self):
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._local.sock = None

@@ -1,0 +1,123 @@
+"""Zero-copy object serialization.
+
+TPU-native equivalent of the reference's serialization layer
+(``python/ray/_private/serialization.py`` + ``includes/serialization.pxi``):
+cloudpickle for arbitrary Python with pickle protocol-5 out-of-band buffers
+so large numpy / jax host arrays are written and read without copies.
+
+Wire layout of a sealed object::
+
+    [8s magic "RTPUOBJ1"][u32 nbuf][u64 meta_len]
+    [nbuf x (u64 offset, u64 length)]        # offsets from start of payload
+    [meta bytes (cloudpickle)]
+    [64-byte-aligned buffer 0][... buffer 1] ...
+
+Readers reconstruct the object with ``pickle.loads(meta, buffers=views)``
+where each view is a slice of one mmap — numpy arrays come back as views
+over shared memory (copied only if the caller mutates them; we expose them
+read-only like the reference does for plasma-backed arrays).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Sequence, Tuple
+
+import cloudpickle
+
+MAGIC = b"RTPUOBJ1"
+_ALIGN = 64
+_HEADER = len(MAGIC) + 4 + 8  # magic, nbuf, meta_len
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """A serialized object: metadata bytes + out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers", "total_bytes")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview]):
+        self.meta = meta
+        self.buffers = buffers
+        offset = _align(_HEADER + 16 * len(buffers) + len(meta))
+        for b in buffers:
+            offset = _align(offset + b.nbytes)
+        self.total_bytes = offset
+
+    def write_into(self, dst: memoryview) -> int:
+        """Write the framed object into ``dst``; returns bytes written."""
+        nbuf = len(self.buffers)
+        header_end = _HEADER + 16 * nbuf
+        dst[:len(MAGIC)] = MAGIC
+        dst[len(MAGIC):len(MAGIC) + 4] = nbuf.to_bytes(4, "little")
+        dst[len(MAGIC) + 4:_HEADER] = len(self.meta).to_bytes(8, "little")
+        offset = _align(header_end + len(self.meta))
+        index = []
+        for b in self.buffers:
+            index.append((offset, b.nbytes))
+            offset = _align(offset + b.nbytes)
+        pos = _HEADER
+        for off, length in index:
+            dst[pos:pos + 8] = off.to_bytes(8, "little")
+            dst[pos + 8:pos + 16] = length.to_bytes(8, "little")
+            pos += 16
+        dst[header_end:header_end + len(self.meta)] = self.meta
+        for (off, length), b in zip(index, self.buffers):
+            dst[off:off + length] = b
+        return offset
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = []
+    for pb in buffers:
+        view = pb.raw()
+        if not view.contiguous:
+            view = memoryview(pb.raw().tobytes())
+        views.append(view)
+    return SerializedObject(meta, views)
+
+
+def parse_frame(payload: memoryview) -> Tuple[memoryview, List[memoryview]]:
+    """Split a framed payload into (meta, buffer views). Zero-copy."""
+    if bytes(payload[:len(MAGIC)]) != MAGIC:
+        raise ValueError("corrupt object: bad magic")
+    nbuf = int.from_bytes(payload[len(MAGIC):len(MAGIC) + 4], "little")
+    meta_len = int.from_bytes(payload[len(MAGIC) + 4:_HEADER], "little")
+    header_end = _HEADER + 16 * nbuf
+    views = []
+    pos = _HEADER
+    for _ in range(nbuf):
+        off = int.from_bytes(payload[pos:pos + 8], "little")
+        length = int.from_bytes(payload[pos + 8:pos + 16], "little")
+        views.append(payload[off:off + length])
+        pos += 16
+    meta = payload[header_end:header_end + meta_len]
+    return meta, views
+
+
+def deserialize_frame(payload: memoryview) -> Any:
+    meta, views = parse_frame(payload)
+    return pickle.loads(bytes(meta), buffers=views)
+
+
+def deserialize(meta: bytes, buffers: Sequence[memoryview]) -> Any:
+    return pickle.loads(meta, buffers=list(buffers))
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot serialize to a contiguous frame (for small objects / RPC)."""
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes) -> Any:
+    return deserialize_frame(memoryview(data))

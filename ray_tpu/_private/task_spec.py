@@ -1,0 +1,126 @@
+"""Task / actor specifications — the wire contract of the scheduler.
+
+TPU-native analogue of the reference's ``src/ray/protobuf/common.proto``
+``TaskSpec`` + ``src/ray/common/task/task_spec.cc``.  Specs are plain
+dataclasses pickled over the control sockets; argument values are either
+inline serialized bytes or ObjectID references (the reference inlines
+"small" args the same way — ``transport/dependency_resolver.cc``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class Arg:
+    """One task argument: exactly one of ``inline`` / ``object_id`` set."""
+    inline: Optional[bytes] = None
+    object_id: Optional[bytes] = None
+
+
+@dataclass
+class SchedulingStrategy:
+    """Normalized scheduling strategy.
+
+    kinds: "default" (hybrid policy), "spread",
+    "node_affinity" (node_id, soft), "placement_group" (pg_id, bundle_index,
+    capture_child_tasks).
+    """
+    kind: str = "default"
+    node_id: Optional[bytes] = None
+    soft: bool = False
+    pg_id: Optional[bytes] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    job_id: bytes
+    name: str
+    # Function: key into the control-plane function table (cloudpickled).
+    function_key: bytes
+    args: List[Arg] = field(default_factory=list)
+    kwargs: Dict[str, Arg] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=SchedulingStrategy)
+    # Actor fields
+    actor_id: Optional[bytes] = None          # set for actor tasks
+    actor_creation: bool = False              # this task constructs the actor
+    actor_method: Optional[str] = None
+    seq_no: int = 0                           # per-caller ordering
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    # Generator tasks
+    is_generator: bool = False
+    # Owner (submitting worker) for lineage/debugging
+    owner_id: bytes = b""
+    # Runtime env / accelerator visibility
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    # Depth for hybrid-policy tie-breaking; parent task id for lineage
+    parent_task_id: Optional[bytes] = None
+
+    def return_object_ids(self) -> List[bytes]:
+        from ray_tpu._private.ids import ObjectID, TaskID
+        tid = TaskID(self.task_id)
+        return [ObjectID.for_task_return(tid, i).binary()
+                for i in range(self.num_returns)]
+
+    def dependencies(self) -> List[bytes]:
+        deps = [a.object_id for a in self.args if a.object_id is not None]
+        deps += [a.object_id for a in self.kwargs.values()
+                 if a.object_id is not None]
+        return deps
+
+
+@dataclass
+class Bundle:
+    """One placement-group bundle: a resource set reserved atomically."""
+    resources: Dict[str, float]
+    node_id: Optional[bytes] = None  # filled when committed
+
+
+def normalize_resources(num_cpus: Optional[float], num_gpus: Optional[float],
+                        num_tpus: Optional[float],
+                        resources: Optional[Dict[str, float]],
+                        memory: Optional[float] = None,
+                        default_cpus: float = 1.0) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus) if num_cpus is not None else default_cpus
+    if num_gpus:
+        out["GPU"] = float(num_gpus)
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+    if memory:
+        out["memory"] = float(memory)
+    for k, v in (resources or {}).items():
+        if k in ("CPU", "GPU", "TPU", "memory"):
+            raise ValueError(
+                f"Use the dedicated argument for resource {k!r}")
+        out[k] = float(v)
+    return {k: v for k, v in out.items() if v != 0 or k == "CPU"}
+
+
+def fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    for k, v in need.items():
+        if v > 0 and avail.get(k, 0.0) + 1e-9 < v:
+            return False
+    return True
+
+
+def acquire(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def release(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) + v

@@ -1,0 +1,448 @@
+"""CoreWorker — the in-process runtime of every driver and worker.
+
+TPU-native analogue of the reference core worker
+(``src/ray/core_worker/core_worker.cc`` + ``python/ray/_private/worker.py``):
+object put/get/wait, task + actor-task submission, the function table, and
+generator streaming.  The driver holds in-process handles to the control
+plane and local node manager; worker processes hold socket clients — the
+logic is identical either way.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.task_spec import Arg, SchedulingStrategy, TaskSpec
+from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError)
+from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
+
+# index of the generator end-of-stream marker object
+GEN_LEN_INDEX = 2**32 - 2
+
+
+class CoreWorker:
+    def __init__(self, mode: str, job_id: JobID, worker_id: WorkerID,
+                 node_id: bytes, control_plane, node_manager, shm_store,
+                 session_dir: str, namespace: str = "default",
+                 nm_notify=None):
+        assert mode in ("driver", "worker")
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.cp = control_plane
+        self.nm = node_manager
+        self.store = shm_store
+        self.session_dir = session_dir
+        self.namespace = namespace
+        self._nm_notify = nm_notify  # callable(msg) to notify NM blocked state
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._fn_keys: Dict[int, bytes] = {}  # id(fn) -> registered key
+        self._actor_nm_cache: Dict[bytes, Any] = {}
+        self._seq_lock = threading.Lock()
+        self._actor_seq: Dict[bytes, int] = {}
+        self._gen_len_cache: Dict[bytes, int] = {}
+        self.current_actor = None
+        self.current_actor_id: Optional[bytes] = None
+        # Per-execution-context task id (contextvar: safe under threaded
+        # actor pools and async actor event loops alike).
+        self._task_id_var: "contextvars.ContextVar[Optional[bytes]]" = (
+            contextvars.ContextVar(f"task_id_{worker_id.hex()[:8]}",
+                                   default=None))
+
+    @property
+    def current_task_id(self) -> Optional[bytes]:
+        return self._task_id_var.get()
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[bytes]) -> None:
+        self._task_id_var.set(value)
+
+    # ------------------------------------------------------------------
+    # Function / class table
+    # ------------------------------------------------------------------
+    def register_function(self, fn, prefix: bytes = b"fn:") -> bytes:
+        cached = self._fn_keys.get(id(fn))
+        if cached is not None:
+            return cached
+        blob = cloudpickle.dumps(fn)
+        key = prefix + hashlib.sha1(blob).digest()
+        self.cp.kv_put(key, blob, overwrite=False, namespace="_functions")
+        self._fn_keys[id(fn)] = key
+        self._fn_cache[key] = fn
+        return key
+
+    def load_function(self, key: bytes):
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self.cp.kv_get(key, namespace="_functions")
+            if blob is None:
+                raise RuntimeError(f"function {key!r} not found in table")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # Objects
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random().binary()
+        self.put_object(oid, value)
+        return ObjectRef(oid)
+
+    def put_object(self, oid: bytes, value: Any,
+                   is_error: bool = False) -> None:
+        sobj = serialization.serialize(value)
+        owner = self.worker_id.binary()
+        if sobj.total_bytes <= GLOBAL_CONFIG.inline_object_max_bytes:
+            self.cp.put_inline(oid, sobj.to_bytes(), is_error=is_error,
+                               owner=owner)
+        else:
+            self.store.put_serialized(oid, sobj)
+            self.cp.commit_shm(oid, sobj.total_bytes, node_id=self.node_id,
+                               is_error=is_error, owner=owner)
+
+    def _fetch_committed(self, oid: bytes, loc: Dict[str, Any]) -> Any:
+        if loc["where"] == "inline":
+            data = self.cp.get_inline(oid)
+            if data is None:
+                raise KeyError(f"inline object {oid.hex()} vanished")
+            value = serialization.deserialize_frame(memoryview(data))
+        else:
+            value = self.store.get_object(oid)
+            if value is None:
+                raise KeyError(f"shm object {oid.hex()} missing from store")
+        return value
+
+    def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
+            timeout: Optional[float] = None) -> Any:
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(
+                    f"get() expects ObjectRef(s), got {type(r).__name__}")
+        ids = [r.binary() for r in ref_list]
+        unready = [o for o in ids if self.cp.get_location(o) is None]
+        if unready:
+            self._notify_blocked(True)
+            try:
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                for o in unready:
+                    t = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+                    loc = self.cp.wait_object(o, t)
+                    if loc is None:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for {o.hex()}")
+            finally:
+                self._notify_blocked(False)
+        values = []
+        for o in ids:
+            loc = self.cp.get_location(o)
+            if loc is None:
+                raise GetTimeoutError(f"object {o.hex()} not available")
+            value = self._fetch_committed(o, loc)
+            if loc.get("error"):
+                if isinstance(value, TaskError):
+                    raise value.as_instanceof_cause()
+                if isinstance(value, BaseException):
+                    raise value
+            values.append(value)
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List[ObjectRef],
+                                                List[ObjectRef]]:
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        ids = [r.binary() for r in refs]
+        if num_returns > len(ids):
+            raise ValueError("num_returns exceeds number of refs")
+        self._notify_blocked(True)
+        try:
+            ready_ids = set(self.cp.wait_any(ids, num_returns, timeout))
+        finally:
+            self._notify_blocked(False)
+        ready, not_ready = [], []
+        for r in refs:
+            # Ray contract: len(ready) <= num_returns; surplus completed
+            # refs stay in not_ready and are returned by the next wait().
+            if r.binary() in ready_ids and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                not_ready.append(r)
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]) -> int:
+        ids = [r.binary() for r in refs]
+        for o in ids:
+            self.store.delete(o)
+        return self.cp.free_objects(ids)
+
+    def _notify_blocked(self, blocked: bool):
+        if self.mode == "worker" and self._nm_notify is not None:
+            try:
+                self._nm_notify({"type": "blocked" if blocked
+                                 else "unblocked"})
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # Generator streaming
+    # ------------------------------------------------------------------
+    def _gen_len_oid(self, task_id: bytes) -> bytes:
+        return ObjectID(task_id + GEN_LEN_INDEX.to_bytes(4, "big")).binary()
+
+    def commit_generator_item(self, task_id: bytes, index: int, value: Any,
+                              is_error: bool = False) -> bytes:
+        # Streamed items live at return indices 1.. (index 0 is the task's
+        # nominal return, which carries the item count).
+        oid = ObjectID.for_task_return(TaskID(task_id), index + 1).binary()
+        self.put_object(oid, value, is_error=is_error)
+        return oid
+
+    def commit_generator_done(self, task_id: bytes, length: int) -> None:
+        self.put_object(self._gen_len_oid(task_id), length)
+
+    def peek_generator_length(self, task_id: bytes) -> Optional[int]:
+        cached = self._gen_len_cache.get(task_id)
+        if cached is not None:
+            return cached
+        oid = self._gen_len_oid(task_id)
+        loc = self.cp.get_location(oid)
+        if loc is None:
+            return None
+        length = self._fetch_committed(oid, loc)
+        self._gen_len_cache[task_id] = length
+        return length
+
+    def wait_generator_length(self, task_id: bytes) -> Optional[int]:
+        return self.peek_generator_length(task_id)
+
+    def wait_ready_or_len(self, oid: bytes, task_id: bytes):
+        len_oid = self._gen_len_oid(task_id)
+        while True:
+            ready = self.cp.wait_any([oid, len_oid], 1, 30.0)
+            if ready:
+                return
+
+    # ------------------------------------------------------------------
+    # Task submission
+    # ------------------------------------------------------------------
+    def _serialize_args(self, args: Sequence[Any],
+                        kwargs: Dict[str, Any]) -> Tuple[List[Arg],
+                                                         Dict[str, Arg]]:
+        def one(value: Any) -> Arg:
+            if isinstance(value, ObjectRef):
+                return Arg(object_id=value.binary())
+            if isinstance(value, ObjectRefGenerator):
+                raise TypeError(
+                    "Pass generator refs individually, not the generator")
+            sobj = serialization.serialize(value)
+            if sobj.total_bytes <= GLOBAL_CONFIG.inline_object_max_bytes:
+                return Arg(inline=sobj.to_bytes())
+            oid = ObjectID.from_random().binary()
+            self.store.put_serialized(oid, sobj)
+            self.cp.commit_shm(oid, sobj.total_bytes, node_id=self.node_id,
+                               owner=self.worker_id.binary())
+            return Arg(object_id=oid)
+
+        return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
+
+    def submit_task(self, fn, args: Sequence[Any], kwargs: Dict[str, Any],
+                    opts: Dict[str, Any]) -> Union[ObjectRef,
+                                                   List[ObjectRef],
+                                                   ObjectRefGenerator]:
+        fn_key = self.register_function(fn)
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        task_id = TaskID.for_normal_task(self.job_id)
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id.binary(), job_id=self.job_id.binary(),
+            name=opts.get("name") or getattr(fn, "__qualname__", "task"),
+            function_key=fn_key, args=ser_args, kwargs=ser_kwargs,
+            num_returns=1 if streaming else num_returns,
+            resources=opts["resources"],
+            max_retries=opts.get(
+                "max_retries", GLOBAL_CONFIG.task_default_max_retries),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=opts.get(
+                "scheduling_strategy") or SchedulingStrategy(),
+            is_generator=streaming,
+            owner_id=self.worker_id.binary(),
+            runtime_env=opts.get("runtime_env") or {},
+            parent_task_id=self.current_task_id,
+        )
+        self.nm.submit_task(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id.binary())
+        refs = [ObjectRef(o) for o in spec.return_object_ids()]
+        return refs[0] if num_returns == 1 else refs
+
+    # ------------------------------------------------------------------
+    # Actors
+    # ------------------------------------------------------------------
+    def create_actor(self, cls, args: Sequence[Any], kwargs: Dict[str, Any],
+                     opts: Dict[str, Any]) -> bytes:
+        cls_key = self.register_function(cls, prefix=b"cls:")
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_creation(actor_id)
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        name = opts.get("name")
+        self.cp.register_actor(actor_id.binary(), {
+            "name": name, "namespace": opts.get("namespace", self.namespace),
+            "class_name": getattr(cls, "__name__", "Actor"),
+            "state": "PENDING",
+            "max_restarts": opts.get("max_restarts", 0),
+            "lifetime": opts.get("lifetime"),
+            "resources": opts["resources"],
+        })
+        spec = TaskSpec(
+            task_id=task_id.binary(), job_id=self.job_id.binary(),
+            name=f"{getattr(cls, '__name__', 'Actor')}.__init__",
+            function_key=cls_key, args=ser_args, kwargs=ser_kwargs,
+            num_returns=1, resources=opts["resources"],
+            scheduling_strategy=opts.get(
+                "scheduling_strategy") or SchedulingStrategy(),
+            actor_id=actor_id.binary(), actor_creation=True,
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            owner_id=self.worker_id.binary(),
+            runtime_env=opts.get("runtime_env") or {},
+        )
+        self.nm.submit_actor_creation(spec)
+        return actor_id.binary()
+
+    def _actor_nm(self, actor_id: bytes, wait: bool = True):
+        """Client to the node manager hosting the actor."""
+        info = self.cp.get_actor_info(actor_id)
+        if info is None:
+            raise ActorDiedError(actor_id.hex(), "unknown actor")
+        state = info.get("state")
+        if state in ("PENDING", "RESTARTING") and wait:
+            self._notify_blocked(True)
+            try:
+                info = self.cp.wait_actor_state(
+                    actor_id, ("ALIVE", "DEAD"), timeout=300.0)
+            finally:
+                self._notify_blocked(False)
+            if info is None:
+                raise ActorDiedError(actor_id.hex(),
+                                     "timed out waiting for actor start")
+        if info.get("state") == "DEAD":
+            raise ActorDiedError(actor_id.hex(),
+                                 info.get("death_reason", "actor is dead"))
+        nm_sock = info.get("nm_sock")
+        if nm_sock is None:
+            raise ActorDiedError(actor_id.hex(), "actor has no address")
+        if self.nm is not None and getattr(self.nm, "sock_path", None) == \
+                nm_sock:
+            return self.nm
+        client = self._actor_nm_cache.get(actor_id)
+        if client is None or getattr(client, "sock_path", None) != nm_sock:
+            from ray_tpu._private.protocol import RpcClient
+            client = RpcClient(nm_sock)
+            client.sock_path = nm_sock
+            self._actor_nm_cache[actor_id] = client
+        return client
+
+    def submit_actor_task(self, actor_id: bytes, method_name: str,
+                          args: Sequence[Any], kwargs: Dict[str, Any],
+                          opts: Dict[str, Any]) -> Union[ObjectRef,
+                                                         List[ObjectRef],
+                                                         ObjectRefGenerator]:
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        task_id = TaskID.for_actor_task(ActorID(actor_id))
+        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        with self._seq_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+        spec = TaskSpec(
+            task_id=task_id.binary(), job_id=self.job_id.binary(),
+            name=f"actor.{method_name}",
+            function_key=b"", args=ser_args, kwargs=ser_kwargs,
+            num_returns=1 if streaming else num_returns,
+            resources={}, actor_id=actor_id, actor_method=method_name,
+            seq_no=seq, is_generator=streaming,
+            max_task_retries=opts.get("max_task_retries", 0),
+            owner_id=self.worker_id.binary(),
+        )
+        try:
+            nm = self._actor_nm(actor_id)
+            if nm is self.nm and self.mode == "driver":
+                nm.submit_actor_task(spec)
+            else:
+                nm.call("submit_actor_task", spec) if hasattr(nm, "call") \
+                    else nm.submit_actor_task(spec)
+        except ActorDiedError as e:
+            err = TaskError(e, "", task_id.hex())
+            data = serialization.dumps(err)
+            for oid in spec.return_object_ids():
+                self.cp.put_inline(oid, data, is_error=True)
+            if streaming:
+                self.commit_generator_done(task_id.binary(), 1)
+                self.commit_generator_item(task_id.binary(), 0, err,
+                                           is_error=True)
+        if streaming:
+            return ObjectRefGenerator(task_id.binary())
+        refs = [ObjectRef(o) for o in spec.return_object_ids()]
+        return refs[0] if num_returns == 1 else refs
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        try:
+            nm = self._actor_nm(actor_id, wait=True)
+        except ActorDiedError:
+            return
+        if hasattr(nm, "call"):
+            nm.call("kill_actor", actor_id, no_restart)
+        else:
+            nm.kill_actor(actor_id, no_restart)
+
+    def cancel_task(self, ref: ObjectRef):
+        if hasattr(self.nm, "call"):
+            return self.nm.call("cancel_task", ref.task_id())
+        return self.nm.cancel_task(ref.task_id())
+
+
+# ----------------------------------------------------------------------
+# Global worker management
+# ----------------------------------------------------------------------
+_global_worker: Optional[CoreWorker] = None
+_global_node = None
+_init_lock = threading.RLock()
+
+
+def global_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first")
+    return _global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker], node=None):
+    global _global_worker, _global_node
+    _global_worker = worker
+    _global_node = node
+
+
+def global_node():
+    return _global_node
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None

@@ -1,0 +1,288 @@
+"""Worker process entrypoint (``python -m ray_tpu._private.worker_proc``).
+
+TPU-native analogue of the reference's ``python/ray/_private/workers/
+default_worker.py`` + the execution half of the core worker: connects to
+the node manager's task channel, executes pushed tasks/actor methods, and
+commits results to the object store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.ids import JobID, WorkerID
+from ray_tpu._private.object_store import ShmStore
+from ray_tpu._private.task_spec import Arg, TaskSpec
+from ray_tpu._private.worker import CoreWorker, set_global_worker
+from ray_tpu.exceptions import TaskError, format_remote_traceback
+from ray_tpu.object_ref import ObjectRef
+
+
+class WorkerProcess:
+    def __init__(self):
+        self.session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+        self.cp_sock = os.environ["RAY_TPU_CP_SOCK"]
+        self.nm_sock = os.environ["RAY_TPU_NM_SOCK"]
+        self.worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+        self.node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
+        self.cp = protocol.RpcClient(self.cp_sock)
+        self.nm_client = protocol.RpcClient(self.nm_sock)
+        self.nm_client.sock_path = self.nm_sock
+        self.store = ShmStore(
+            os.environ["RAY_TPU_SHM_ROOT"],
+            spill_dir=os.environ.get("RAY_TPU_SPILL_DIR") or None)
+        self.stream = self.nm_client.hijack(
+            "stream_worker", self.worker_id.binary())
+        self._send_lock = threading.Lock()
+        self.core = CoreWorker(
+            mode="worker", job_id=JobID.nil(), worker_id=self.worker_id,
+            node_id=self.node_id, control_plane=self.cp,
+            node_manager=self.nm_client, shm_store=self.store,
+            session_dir=self.session_dir, nm_notify=self._send)
+        set_global_worker(self.core)
+        # actor execution machinery (populated on creation)
+        self.actor_pool: Optional[ThreadPoolExecutor] = None
+        self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.is_async_actor = False
+
+    def _send(self, msg: Dict[str, Any]):
+        with self._send_lock:
+            protocol.send_msg(self.stream, msg)
+
+    # ------------------------------------------------------------------
+    def run(self):
+        while True:
+            try:
+                msg = protocol.recv_msg(self.stream)
+            except (protocol.ConnectionClosed, ConnectionResetError,
+                    OSError, EOFError):
+                return
+            kind = msg.get("type")
+            if kind == "exit":
+                self._send({"type": "exit"})
+                return
+            if kind != "task":
+                continue
+            spec: TaskSpec = msg["spec"]
+            chips = msg.get("chips")
+            if spec.actor_creation:
+                self._execute_creation(spec, chips)
+            elif spec.actor_id is not None:
+                self._dispatch_actor_task(spec)
+            else:
+                self._execute_task(spec, chips)
+
+    # ------------------------------------------------------------------
+    def _resolve_args(self, spec: TaskSpec):
+        def one(arg: Arg):
+            if arg.inline is not None:
+                return serialization.loads(arg.inline)
+            return self.core.get(ObjectRef(arg.object_id))
+        args = [one(a) for a in spec.args]
+        kwargs = {k: one(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _set_visible_chips(self, chips: Optional[List[int]]):
+        # Parity with the reference's per-task accelerator isolation
+        # (python/ray/_private/accelerators/tpu.py TPU_VISIBLE_CHIPS).
+        if chips is not None:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chips))
+            os.environ.setdefault("TPU_CHIPS_PER_HOST_BOUNDS",
+                                  f"1,{len(chips)},1")
+
+    def _commit_results(self, spec: TaskSpec, result: Any):
+        if spec.is_generator:
+            count = 0
+            try:
+                if inspect.isgenerator(result) or hasattr(
+                        result, "__iter__") and not isinstance(
+                            result, (list, tuple, dict, str, bytes)):
+                    for item in result:
+                        self.core.commit_generator_item(
+                            spec.task_id, count, item)
+                        count += 1
+                else:
+                    for item in list(result):
+                        self.core.commit_generator_item(
+                            spec.task_id, count, item)
+                        count += 1
+            except BaseException as e:  # noqa: BLE001
+                err = TaskError(e, format_remote_traceback(e),
+                                spec.task_id.hex())
+                self.core.commit_generator_item(spec.task_id, count, err,
+                                                is_error=True)
+                count += 1
+                self.core.commit_generator_done(spec.task_id, count)
+                raise
+            self.core.commit_generator_done(spec.task_id, count)
+            # also commit the nominal return so plain get() works
+            self.core.put_object(spec.return_object_ids()[0], count)
+            return
+        oids = spec.return_object_ids()
+        if spec.num_returns == 1:
+            self.core.put_object(oids[0], result)
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(values)} values")
+            for oid, v in zip(oids, values):
+                self.core.put_object(oid, v)
+
+    def _commit_error(self, spec: TaskSpec, exc: BaseException):
+        err = TaskError(exc, format_remote_traceback(exc),
+                        spec.task_id.hex())
+        try:
+            for oid in spec.return_object_ids():
+                self.core.put_object(oid, err, is_error=True)
+            if spec.is_generator:
+                self.core.commit_generator_item(spec.task_id, 0, err,
+                                                is_error=True)
+                self.core.commit_generator_done(spec.task_id, 1)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+
+    # ------------------------------------------------------------------
+    def _execute_task(self, spec: TaskSpec, chips):
+        self.core.current_task_id = spec.task_id
+        error = False
+        error_payload = None
+        try:
+            self._set_visible_chips(chips)
+            fn = self.core.load_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec)
+            if inspect.iscoroutinefunction(fn):
+                result = asyncio.run(fn(*args, **kwargs))
+            else:
+                result = fn(*args, **kwargs)
+            self._commit_results(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            error = True
+            if spec.retry_exceptions:
+                # Defer the error commit: the node manager decides whether
+                # to resubmit (reference: task retries on app exceptions).
+                err = TaskError(e, format_remote_traceback(e),
+                                spec.task_id.hex())
+                error_payload = serialization.dumps(err)
+            else:
+                self._commit_error(spec, e)
+        finally:
+            self.core.current_task_id = None
+        self._send({"type": "done", "task_id": spec.task_id, "error": error,
+                    "error_payload": error_payload})
+
+    def _execute_creation(self, spec: TaskSpec, chips):
+        try:
+            self._set_visible_chips(chips)
+            cls = self.core.load_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec)
+            instance = cls(*args, **kwargs)
+            self.core.current_actor = instance
+            self.core.current_actor_id = spec.actor_id
+            self.is_async_actor = any(
+                inspect.iscoroutinefunction(getattr(cls, n, None))
+                for n in dir(cls) if not n.startswith("__"))
+            if self.is_async_actor:
+                self.actor_loop = asyncio.new_event_loop()
+                t = threading.Thread(target=self.actor_loop.run_forever,
+                                     daemon=True, name="actor-loop")
+                t.start()
+            elif spec.max_concurrency > 1:
+                self.actor_pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency,
+                    thread_name_prefix="actor")
+            self.core.put_object(spec.return_object_ids()[0], None)
+            self._send({"type": "actor_ready", "actor_id": spec.actor_id,
+                        "pid": os.getpid()})
+        except BaseException as e:  # noqa: BLE001
+            self._commit_error(spec, e)
+            self._send({"type": "actor_init_failed",
+                        "actor_id": spec.actor_id})
+            self._send({"type": "done", "task_id": spec.task_id,
+                        "error": True})
+
+    def _dispatch_actor_task(self, spec: TaskSpec):
+        if self.is_async_actor and self.actor_loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_async(spec), self.actor_loop)
+        elif self.actor_pool is not None:
+            self.actor_pool.submit(self._run_actor_task, spec)
+        else:
+            self._run_actor_task(spec)
+
+    def _run_actor_task(self, spec: TaskSpec):
+        self.core.current_task_id = spec.task_id
+        try:
+            method = self._lookup_method(spec)
+            args, kwargs = self._resolve_args(spec)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            self._commit_results(spec, result)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            self._commit_error(spec, e)
+            error = True
+        finally:
+            self.core.current_task_id = None
+        self._send({"type": "done", "task_id": spec.task_id, "error": error})
+        if spec.actor_method == "__ray_terminate__":
+            os._exit(0)
+
+    async def _run_actor_task_async(self, spec: TaskSpec):
+        self.core.current_task_id = spec.task_id
+        try:
+            method = self._lookup_method(spec)
+            args, kwargs = self._resolve_args(spec)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._commit_results(spec, result)
+            error = False
+        except BaseException as e:  # noqa: BLE001
+            self._commit_error(spec, e)
+            error = True
+        self._send({"type": "done", "task_id": spec.task_id, "error": error})
+        if spec.actor_method == "__ray_terminate__":
+            os._exit(0)
+
+    def _lookup_method(self, spec: TaskSpec):
+        instance = self.core.current_actor
+        if spec.actor_method == "__ray_terminate__":
+            return lambda: None
+        if spec.actor_method == "__ray_call__":
+            # run an arbitrary function against the actor instance
+            def _call(fn, *a, **kw):
+                return fn(instance, *a, **kw)
+            return _call
+        method = getattr(instance, spec.actor_method, None)
+        if method is None:
+            raise AttributeError(
+                f"actor {type(instance).__name__} has no method "
+                f"{spec.actor_method!r}")
+        return method
+
+
+def main():
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1)
+    proc = WorkerProcess()
+    try:
+        proc.run()
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+
+
+if __name__ == "__main__":
+    main()

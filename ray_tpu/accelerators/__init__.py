@@ -1,0 +1,3 @@
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager, detect_num_tpus
+
+__all__ = ["TPUAcceleratorManager", "detect_num_tpus"]

@@ -1,0 +1,115 @@
+"""TPU accelerator manager.
+
+Parity target: reference ``python/ray/_private/accelerators/tpu.py``
+(``TPUAcceleratorManager``) — chip detection, per-task visibility via
+``TPU_VISIBLE_CHIPS``, pod metadata.  Re-designed for a JAX-first stack:
+detection prefers an already-imported jax, falls back to GCE/GKE metadata
+env vars, and never imports jax eagerly (importing jax grabs the chips).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+RESOURCE_NAME = "TPU"
+VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+# GKE injects these; GCE metadata equivalents handled via env for now.
+_TPU_CHIP_COUNT_ENVS = ("TPU_CHIP_COUNT", "TPU_NUM_DEVICES")
+_TPU_TYPE_ENVS = ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE")
+
+
+def _jax_backend_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class TPUAcceleratorManager:
+    @staticmethod
+    def get_resource_name() -> str:
+        return RESOURCE_NAME
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return VISIBLE_CHIPS_ENV
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        # 1. explicit override
+        for env in _TPU_CHIP_COUNT_ENVS:
+            value = os.environ.get(env)
+            if value:
+                try:
+                    return int(value)
+                except ValueError:
+                    pass
+        # 2. restricted visibility
+        visible = os.environ.get(VISIBLE_CHIPS_ENV)
+        if visible:
+            return len([c for c in visible.split(",") if c != ""])
+        # 3. jax — but only if this process ALREADY initialized the
+        #    backend.  jax.devices() would otherwise claim the chips for
+        #    this process, starving workers that need them.
+        jax = sys.modules.get("jax")
+        if jax is not None and _jax_backend_initialized():
+            try:
+                return len([d for d in jax.devices()
+                            if d.platform not in ("cpu", "gpu")])
+            except Exception:  # noqa: BLE001
+                return 0
+        return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        for env in _TPU_TYPE_ENVS:
+            value = os.environ.get(env)
+            if value:
+                return value
+        jax = sys.modules.get("jax")
+        if jax is not None and _jax_backend_initialized():
+            try:
+                devs = [d for d in jax.devices()
+                        if d.platform not in ("cpu", "gpu")]
+                if devs:
+                    return getattr(devs[0], "device_kind", "TPU")
+            except Exception:  # noqa: BLE001
+                pass
+        return None
+
+    @staticmethod
+    def set_visible_accelerator_ids(ids: List[int]) -> None:
+        os.environ[VISIBLE_CHIPS_ENV] = ",".join(str(i) for i in ids)
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[List[int]]:
+        visible = os.environ.get(VISIBLE_CHIPS_ENV)
+        if visible is None:
+            return None
+        if visible == "":
+            return []
+        return [int(c) for c in visible.split(",")]
+
+    @staticmethod
+    def get_pod_worker_count() -> int:
+        value = os.environ.get("TPU_WORKER_COUNT")
+        return int(value) if value else 1
+
+    @staticmethod
+    def get_pod_head_resource_name() -> Optional[str]:
+        """``TPU-<pod_type>-head`` resource on worker 0 of a pod slice.
+
+        Mirrors the reference's pod-slice head resource so gang schedulers
+        can target the host that must run the coordinator.
+        """
+        pod_type = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if pod_type and os.environ.get("TPU_WORKER_ID", "0") == "0":
+            return f"TPU-{pod_type}-head"
+        return None
+
+
+def detect_num_tpus() -> int:
+    return TPUAcceleratorManager.get_current_node_num_accelerators()

@@ -1,0 +1,129 @@
+"""User-visible exception types.
+
+Parity with the reference's ``python/ray/exceptions.py``: task errors wrap
+the remote traceback and re-raise at ``get`` time; actor/object errors carry
+the relevant IDs.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayError(RayTpuError):
+    """Alias kept for API familiarity."""
+
+
+class TaskError(RayError):
+    """A task raised an exception; re-raised from ``get``.
+
+    Carries the remote traceback string so the user sees the real failure
+    site (reference behavior: ``RayTaskError`` in ``python/ray/exceptions.py``).
+    """
+
+    def __init__(self, cause: BaseException, remote_tb: str = "",
+                 task_id: Optional[str] = None, proctitle: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        self.task_id = task_id
+        self.proctitle = proctitle
+        super().__init__(str(cause))
+
+    def __str__(self):
+        msg = f"{type(self.cause).__name__}: {self.cause}"
+        if self.remote_tb:
+            msg += "\n\nremote traceback:\n" + self.remote_tb
+        return msg
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is-a ``type(cause)`` for except clauses."""
+        cause_cls = type(self.cause)
+        if cause_cls in (SystemExit, KeyboardInterrupt):
+            return self
+        try:
+            class _Wrapped(TaskError, cause_cls):  # type: ignore[misc]
+                def __init__(wrapped_self):
+                    TaskError.__init__(wrapped_self, self.cause,
+                                       self.remote_tb, self.task_id)
+            _Wrapped.__name__ = f"TaskError({cause_cls.__name__})"
+            _Wrapped.__qualname__ = _Wrapped.__name__
+            return _Wrapped()
+        except TypeError:
+            return self
+
+
+RayTaskError = TaskError
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayError):
+    pass
+
+
+RayActorError = ActorError
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id: str = "", reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(
+            f"Actor {actor_id} is dead: {reason or 'actor process exited'}")
+
+
+class ActorUnavailableError(ActorError):
+    """Actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayError):
+    def __init__(self, object_id: str = "", reason: str = ""):
+        self.object_id = object_id
+        super().__init__(
+            f"Object {object_id} is lost: {reason or 'all copies failed'}")
+
+
+class ObjectStoreFullError(RayError):
+    pass
+
+
+class OutOfMemoryError(RayError):
+    """Task killed by the node memory monitor."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayError):
+    def __init__(self, task_id: Optional[str] = None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id or ''} was cancelled")
+
+
+class PendingCallsLimitExceeded(RayError):
+    pass
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class NodeDiedError(RayError):
+    pass
+
+
+class RaySystemError(RayError):
+    pass
+
+
+def format_remote_traceback(exc: BaseException) -> str:
+    return "".join(
+        traceback.format_exception(type(exc), exc, exc.__traceback__))

@@ -1,0 +1,129 @@
+"""ObjectRef — a future for an object in the cluster.
+
+Parity target: ``python/ray/_raylet.pyx`` ``ObjectRef`` /
+``ObjectRefGenerator``.  Refs are cheap value types wrapping the 20-byte
+ObjectID; they pickle freely (into task args, other objects, etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id",)
+
+    def __init__(self, object_id: bytes):
+        if isinstance(object_id, ObjectID):
+            object_id = object_id.binary()
+        if not isinstance(object_id, bytes) or len(object_id) != ObjectID.SIZE:
+            raise ValueError(f"bad object id: {object_id!r}")
+        self._id = object_id
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self) -> bytes:
+        return self._id[:16]
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        import concurrent.futures
+
+        import ray_tpu
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(ray_tpu.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        """Allow ``await ref`` inside async actors."""
+        import asyncio
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+class ObjectRefGenerator:
+    """Iterator over the streamed returns of a generator task.
+
+    Mirrors the reference's streaming generators
+    (``_raylet.pyx`` ``ObjectRefGenerator``): each ``__next__`` blocks
+    until the producer commits the next yield, raising StopIteration once
+    the end-of-stream marker is committed.
+    """
+
+    def __init__(self, task_id: bytes, worker=None):
+        self._task_id = task_id
+        self._index = 0
+        self._done_at: Optional[int] = None
+
+    def _worker(self):
+        from ray_tpu._private.worker import global_worker
+        return global_worker()
+
+    def _ref_at(self, index: int) -> ObjectRef:
+        # item i is committed at return index i+1 (0 = nominal return)
+        from ray_tpu._private.ids import ObjectID, TaskID
+        return ObjectRef(
+            ObjectID.for_task_return(TaskID(self._task_id),
+                                     index + 1).binary())
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        worker = self._worker()
+        length = worker.wait_generator_length(self._task_id)
+        if length is not None and self._index >= length:
+            raise StopIteration
+        # Wait for either the item or the (possibly shorter) final length.
+        ref = self._ref_at(self._index)
+        worker.wait_ready_or_len(ref.binary(), self._task_id)
+        length = worker.peek_generator_length(self._task_id)
+        if length is not None and self._index >= length:
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id,))
+
+    def completed(self):
+        return self
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+
+StreamingObjectRefGenerator = ObjectRefGenerator

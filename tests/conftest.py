@@ -1,0 +1,49 @@
+"""Test fixtures.
+
+Mirrors the reference's workhorse fixtures
+(``python/ray/tests/conftest.py``: ``ray_start_regular``,
+``ray_start_cluster``): a fresh runtime per test, plus an in-process
+multi-node simulation.  JAX runs on a virtual 8-device CPU mesh so sharding
+paths compile without TPU hardware (the driver bench runs on the real chip).
+"""
+
+import os
+import sys
+
+# Must run before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip())
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Head + helper to add simulated nodes (extra node-manager processes)."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_node
+    ray_tpu.init(num_cpus=2)
+    node = global_node()
+    yield node
+    ray_tpu.shutdown()

@@ -1,0 +1,167 @@
+"""Core API tests: tasks, objects, errors.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(ray_start_regular):
+    ray = ray_start_regular
+    ref = ray.put({"a": 1, "b": [1, 2, 3]})
+    assert ray.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy_zero_copy(ray_start_regular):
+    ray = ray_start_regular
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray.put(arr)
+    out = ray.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # large arrays come back as views over shm (read-only)
+    assert not out.flags.writeable
+
+
+def test_simple_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get(f.remote(1)) == 2
+
+
+def test_task_with_object_args(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def f(x, y):
+        return x + y
+
+    a = ray.put(10)
+    b = f.remote(a, 5)
+    c = f.remote(b, b)
+    assert ray.get(c) == 30
+
+
+def test_task_chain_parallel(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray.get(refs) == [i * i for i in range(20)]
+
+
+def test_multiple_returns(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def bad():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        ray.get(bad.remote())
+
+
+def test_nested_tasks(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def inner(x):
+        return x * 2
+
+    @ray.remote
+    def outer(x):
+        import ray_tpu
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray.get(outer.remote(10)) == 21
+
+
+def test_wait(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.01)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = ray.wait([fast, slow_ref], num_returns=1,
+                                timeout=10.0)
+    assert ready == [fast]
+    assert not_ready == [slow_ref]
+
+
+def test_get_timeout(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def hang():
+        time.sleep(60)
+
+    from ray_tpu.exceptions import GetTimeoutError
+    with pytest.raises(GetTimeoutError):
+        ray.get(hang.remote(), timeout=0.2)
+
+
+def test_large_args_promoted_to_objects(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def total(arr):
+        return float(arr.sum())
+
+    arr = np.ones(500_000, dtype=np.float32)
+    assert ray.get(total.remote(arr)) == 500_000.0
+
+
+def test_generator_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_cluster_resources(ray_start_regular):
+    ray = ray_start_regular
+    res = ray.cluster_resources()
+    assert res["CPU"] == 4.0
+
+
+def test_runtime_context(ray_start_regular):
+    ray = ray_start_regular
+    ctx = ray.get_runtime_context()
+    assert len(ctx.get_node_id()) == 32
+
+    @ray.remote
+    def whoami():
+        import ray_tpu
+        return ray_tpu.get_runtime_context().get_task_id()
+
+    tid = ray.get(whoami.remote())
+    assert tid is not None and len(tid) == 32

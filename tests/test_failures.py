@@ -1,0 +1,154 @@
+"""Fault-tolerance & edge-case regressions (reference:
+``python/ray/tests/test_failure*.py``, ``test_streaming_generator.py``)."""
+
+import time
+
+import pytest
+
+
+def test_generator_read_after_completion(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(3)
+    time.sleep(1.5)  # let the producer finish before consuming
+    assert [ray.get(r) for r in g] == [0, 10, 20]
+
+
+def test_wait_num_returns_cap(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(4)]
+    ray.get(list(refs))  # all complete
+    ready, not_ready = ray.wait(refs, num_returns=1)
+    assert len(ready) == 1
+    assert len(not_ready) == 3
+    ready2, rest = ray.wait(not_ready, num_returns=2)
+    assert len(ready2) == 2 and len(rest) == 1
+
+
+def test_retry_exceptions(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+
+    @ray.remote(max_retries=3, retry_exceptions=True)
+    def flaky(counter):
+        import ray_tpu
+        n = ray_tpu.get(counter.incr.remote())
+        if n < 3:
+            raise RuntimeError(f"transient {n}")
+        return n
+
+    assert ray.get(flaky.remote(c), timeout=60) == 3
+
+
+def test_no_retry_without_opt_in(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=3)
+    def always_fails():
+        raise RuntimeError("app error: no retry by default")
+
+    with pytest.raises(RuntimeError, match="no retry"):
+        ray.get(always_fails.remote(), timeout=30)
+
+
+def test_worker_crash_retries_task(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Tally:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    t = Tally.remote()
+
+    @ray.remote(max_retries=2)
+    def die_once(tally):
+        import os
+
+        import ray_tpu
+        n = ray_tpu.get(tally.incr.remote())
+        if n == 1:
+            os._exit(1)  # simulate worker crash
+        return "survived"
+
+    assert ray.get(die_once.remote(t), timeout=60) == "survived"
+
+
+def test_worker_crash_no_retries_raises(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0)
+    def die():
+        import os
+        os._exit(1)
+
+    from ray_tpu.exceptions import WorkerCrashedError
+    with pytest.raises(WorkerCrashedError):
+        ray.get(die.remote(), timeout=60)
+
+
+def test_generator_producer_death_unblocks_consumer(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote(max_retries=0, num_returns="streaming")
+    def doomed_gen():
+        yield 1
+        time.sleep(0.3)
+        import os
+        os._exit(1)
+
+    g = doomed_gen.remote()
+    it = iter(g)
+    first = ray.get(next(it), timeout=30)
+    assert first == 1
+    with pytest.raises(Exception):
+        for r in it:
+            ray.get(r, timeout=30)
+
+
+def test_actor_init_failure_recycles_worker(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("nope")
+
+        def f(self):
+            return 1
+
+    from ray_tpu._private.worker import global_node
+    nm = global_node().node_manager
+    for _ in range(3):
+        b = Bad.remote()
+        with pytest.raises(Exception):
+            ray.get(b.f.remote(), timeout=30)
+    time.sleep(0.5)
+    stats = nm.node_stats()
+    # failed creations must not leak busy workers
+    assert stats["num_idle"] >= 1
+    assert stats["num_workers"] <= 6

@@ -1,0 +1,91 @@
+"""Unit tests for IDs, config, serialization, shm store (no cluster)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import ConfigRegistry
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID)
+
+
+def test_id_sizes_and_embedding():
+    job = JobID.from_random()
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_task(actor)
+    assert task.job_id() == job
+    obj = ObjectID.for_task_return(task, 7)
+    assert obj.task_id() == task
+    assert obj.return_index() == 7
+
+
+def test_id_round_trip_hex():
+    t = TaskID.for_normal_task(JobID.from_random())
+    assert TaskID.from_hex(t.hex()) == t
+
+
+def test_actor_creation_task_deterministic():
+    actor = ActorID.of(JobID.from_random())
+    assert (TaskID.for_actor_creation(actor)
+            == TaskID.for_actor_creation(actor))
+
+
+def test_config_env_override(monkeypatch):
+    reg = ConfigRegistry()
+    reg.define("some_flag", 10)
+    reg.define("some_bool", True)
+    assert reg.some_flag == 10
+    monkeypatch.setenv("RAY_TPU_SOME_FLAG", "42")
+    assert reg.some_flag == 42
+    monkeypatch.setenv("RAY_TPU_SOME_BOOL", "false")
+    assert reg.some_bool is False
+    reg.set("some_flag", 5)
+    assert reg.some_flag == 5
+
+
+def test_serialization_round_trip():
+    value = {"x": np.arange(100), "y": "hello", "z": [1, (2, 3)]}
+    blob = serialization.dumps(value)
+    out = serialization.loads(blob)
+    np.testing.assert_array_equal(out["x"], value["x"])
+    assert out["y"] == "hello"
+
+
+def test_serialization_zero_copy_buffers():
+    arr = np.arange(10000, dtype=np.float64)
+    sobj = serialization.serialize(arr)
+    assert sobj.total_bytes >= arr.nbytes
+    frame = sobj.to_bytes()
+    meta, views = serialization.parse_frame(memoryview(frame))
+    assert sum(v.nbytes for v in views) >= arr.nbytes
+    out = serialization.deserialize_frame(memoryview(frame))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_shm_store_put_get(tmp_path):
+    from ray_tpu._private.object_store import ShmStore
+    store = ShmStore(str(tmp_path / "shm"), capacity=10 << 20,
+                     spill_dir=str(tmp_path / "spill"))
+    oid = ObjectID.from_random().binary()
+    arr = np.arange(1000)
+    store.put_serialized(oid, serialization.serialize(arr))
+    out = store.get_object(oid)
+    np.testing.assert_array_equal(out, arr)
+    assert store.delete(oid)
+    assert store.get_object(oid) is None
+
+
+def test_shm_store_eviction_spill(tmp_path):
+    from ray_tpu._private.object_store import ShmStore
+    store = ShmStore(str(tmp_path / "shm"), capacity=1 << 20,
+                     spill_dir=str(tmp_path / "spill"))
+    ids = []
+    for i in range(8):
+        oid = ObjectID.from_random().binary()
+        data = np.full(40_000, i, dtype=np.float64)  # ~320KB each
+        store.put_serialized(oid, serialization.serialize(data))
+        store.release_mappings()
+        ids.append(oid)
+    # earliest objects were spilled; they must still be readable
+    out = store.get_object(ids[0])
+    np.testing.assert_array_equal(out, np.full(40_000, 0, dtype=np.float64))
