@@ -1,0 +1,87 @@
+"""Headline benchmark: GPT-2 (124M) training throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference's north-star (BASELINE.json) is per-device training
+throughput matching H100+NCCL.  Baseline constant below is the per-H100
+GPT-2-small bf16 DDP throughput (~255k tokens/s/GPU ≈ 190 TFLOP/s
+effective at 6*N FLOPs/token); vs_baseline = ours / that.  Measured on
+whatever accelerator jax exposes (TPU chip under axon; CPU fallback for
+smoke runs scales the model down).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+H100_GPT2_TOKENS_PER_SEC = 255_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    on_accel = platform not in ("cpu",)
+    quick = "--quick" in sys.argv or not on_accel
+
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 4
+    else:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=True)
+        batch, seq, steps = 4, 1024, 10
+
+    mesh = make_mesh(dp=len(devices), devices=devices)
+    fns = training.build_gpt_train(cfg, mesh)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch_data = training.synthetic_lm_batch(
+        jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+
+    # warmup / compile (float() forces a device round-trip: the axon
+    # tunnel's block_until_ready does not actually block)
+    for _ in range(2):
+        state, metrics = fns["step_fn"](state, batch_data)
+        float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = fns["step_fn"](state, batch_data)
+    # fetching the last loss forces the whole state-dependency chain
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = steps * tokens_per_step / dt
+    tok_s_chip = tok_s / len(devices)
+
+    from ray_tpu.models.gpt import init_params, num_params
+    n_params = num_params(state.params)
+    flops_per_token = 6 * n_params
+    tflops = tok_s_chip * flops_per_token / 1e12
+
+    result = {
+        "metric": "gpt2_train_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_s_chip / H100_GPT2_TOKENS_PER_SEC, 4),
+        "platform": platform,
+        "n_devices": len(devices),
+        "model_params": n_params,
+        "achieved_tflops_per_chip": round(tflops, 2),
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,9 @@ class ActorHandle:
                            method_num_returns or {})
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        # __ray_call__ runs an arbitrary fn against the actor instance;
+        # other dunder/private names are real attribute errors.
+        if name.startswith("_") and name != "__ray_call__":
             raise AttributeError(name)
         return ActorMethod(self, name,
                            self._method_num_returns.get(name, 1))

@@ -1,0 +1,127 @@
+"""Device-mesh construction — the substrate of every parallelism strategy.
+
+TPU-native replacement for the reference's NCCL communicator world: instead
+of process groups + communicator objects (reference
+``python/ray/util/collective/collective_group/nccl_collective_group.py``),
+parallelism is expressed as named axes of a ``jax.sharding.Mesh`` and XLA
+inserts the collectives.  Axis convention (see scaling-book recipe):
+
+    dp    data parallelism (gradient psum)
+    fsdp  parameter/optimizer sharding (ZeRO-3-style)
+    tp    tensor parallelism (megatron-style sharded matmuls)
+    sp    sequence/context parallelism (ring attention)
+    pp    pipeline stages
+    ep    expert parallelism (MoE all-to-all), usually folded over dp
+
+ICI topology note: axes earlier in the tuple change slowest; put the axis
+with the heaviest collective traffic (tp) innermost so it rides the
+densest ICI links.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A named logical mesh shape, resolvable against any device set."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def create(cls, **sizes: int) -> "MeshSpec":
+        unknown = set(sizes) - set(AXIS_ORDER)
+        if unknown:
+            raise ValueError(f"unknown mesh axes: {sorted(unknown)}; "
+                             f"valid: {AXIS_ORDER}")
+        axes = tuple((a, int(sizes[a])) for a in AXIS_ORDER if a in sizes)
+        return cls(axes)
+
+    @property
+    def size(self) -> int:
+        return math.prod(s for _, s in self.axes) if self.axes else 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.axes)
+
+    def resolve(self, num_devices: int) -> "MeshSpec":
+        """Fill at most one ``-1`` axis from the device count."""
+        wild = [a for a, s in self.axes if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for _, s in self.axes if s != -1)
+        if wild:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            fill = num_devices // fixed
+            return MeshSpec(tuple((a, fill if s == -1 else s)
+                                  for a, s in self.axes))
+        if fixed > num_devices:
+            raise ValueError(
+                f"mesh size {fixed} exceeds device count {num_devices}")
+        return self  # smaller meshes use the first `fixed` devices
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, devices=None,
+              **sizes: int):
+    """Build a ``jax.sharding.Mesh`` from a spec or axis sizes.
+
+    ``make_mesh(dp=2, tp=4)``; pass one ``-1`` to absorb remaining devices:
+    ``make_mesh(dp=-1, tp=2)``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if spec is None:
+        if not sizes:
+            sizes = {"dp": -1}
+        spec = MeshSpec.create(**sizes)
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    shape = [s for _, s in spec.axes]
+    import numpy as np
+    dev_array = np.asarray(devices[: spec.size]).reshape(shape)
+    return Mesh(dev_array, spec.axis_names)
+
+
+def single_device_mesh(device=None):
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1), ("dp",))
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if hasattr(mesh, "shape") else 1
+
+
+def validate_divisibility(mesh, *, batch: Optional[int] = None,
+                          seq: Optional[int] = None,
+                          d_model: Optional[int] = None,
+                          n_heads: Optional[int] = None) -> None:
+    """Fail fast on shape/axis mismatches instead of inside XLA."""
+    checks = [
+        (batch, ("dp", "fsdp"), "batch"),
+        (seq, ("sp",), "sequence length"),
+        (n_heads, ("tp",), "attention heads"),
+        (d_model, ("tp",), "d_model"),
+    ]
+    for value, axes, label in checks:
+        if value is None:
+            continue
+        div = math.prod(mesh.shape.get(a, 1) for a in axes)
+        if value % div:
+            raise ValueError(
+                f"{label}={value} not divisible by mesh axes {axes} "
+                f"(product {div})")

@@ -1,0 +1,143 @@
+"""Ring attention — sequence/context parallelism over an ICI ring.
+
+The reference has NO native sequence parallelism (verified in SURVEY.md
+§2.4: Ray delegates long-context to DeepSpeed/Lightning inside the user
+fn).  Here it is first-class: K/V shards rotate around the ``sp`` mesh
+axis via ``ppermute`` while each device accumulates blockwise attention
+for its resident Q shard with an online (streaming) softmax — attention
+over sequences of length ``sp * S_local`` with O(S_local^2) memory.
+
+Design (Liu et al. ring attention + flash-attention online softmax):
+- one ring step per sp-rank; compute for the resident block overlaps the
+  ppermute of the next K/V block (XLA schedules the collective async);
+- numerics: scores/stats accumulate in f32 regardless of input dtype;
+  masked logits use a large-negative finite value so fully-masked blocks
+  stay NaN-free (every causal row owns its diagonal, so the final result
+  is exact);
+- the per-block kernel is pluggable: defaults to an einsum path XLA fuses
+  well; ``ray_tpu.ops.attention`` provides the Pallas flash kernel for the
+  resident-block case.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e9
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One blockwise attention step returning (out, row_max, row_sum).
+
+    q: [B, Sq, H, D]  k/v: [B, Sk, H, D]  mask: [Sq, Sk] bool or None.
+    Stats in f32: out [B, Sq, H, D], m/l [B, Sq, H].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # [B, H, Sq]
+    p = jnp.exp(scores - m[..., None])                # [B, H, Sq, Sk]
+    l = jnp.sum(p, axis=-1)                           # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    # reshape stats to [B, Sq, H]
+    return o, jnp.transpose(m, (0, 2, 1)), jnp.transpose(l, (0, 2, 1))
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None,
+                   block_attn: Callable = _block_attn):
+    """Ring attention over a sharded sequence axis.
+
+    Must run inside ``shard_map`` (or pjit-manual) with ``axis_name``
+    bound.  q, k, v: ``[B, S_local, H, D]`` — the local sequence shard.
+    Returns ``[B, S_local, H, D]`` in q's dtype.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+
+    q_pos = my * S + jnp.arange(S)                    # global q positions
+
+    def step(carry, step_idx):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - step_idx) % n
+        if causal:
+            kv_pos = src * S + jnp.arange(S)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = None
+        bo, bm, bl = block_attn(q, k_blk, v_blk, mask, scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)                    # rescale old state
+        beta = jnp.exp(bm - m_new)                    # rescale new block
+        l_new = l * alpha + bl * beta
+        o_new = (o * alpha[..., None]
+                 + bo * beta[..., None])
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    m0 = jnp.full((B, S, H), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, S, H), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def local_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None):
+    """Single-device reference attention (same signature, no ring)."""
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    mask = (jnp.tril(jnp.ones((S, S), bool)) if causal else None)
+    o, m, l = _block_attn(q, k, v, mask, scale)
+    return (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh, *, causal: bool = True,
+                           rules=None):
+    """shard_map-wrapped ring attention for a given mesh.
+
+    Shards: batch over (dp, fsdp), seq over sp, heads over tp.  Falls back
+    to plain local attention when the mesh has no sp axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.compat import shard_map
+
+    sp = mesh.shape.get("sp", 1)
+    if sp <= 1:
+        return functools.partial(local_attention, causal=causal)
+
+    def drop_missing(spec_axes):
+        out = []
+        for a in spec_axes:
+            if isinstance(a, tuple):
+                a = tuple(x for x in a if mesh.shape.get(x, 1) >= 1
+                          and x in mesh.axis_names) or None
+            elif a is not None and a not in mesh.axis_names:
+                a = None
+            out.append(a)
+        return P(*out)
+
+    spec = drop_missing([("dp", "fsdp"), "sp", "tp", None])
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=causal)
+
+    return fn

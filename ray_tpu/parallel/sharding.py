@@ -1,0 +1,113 @@
+"""Logical-axis sharding rules.
+
+The flax-style "logical axis name → mesh axis" indirection: model code
+annotates arrays with logical names (``("batch", "seq", "embed")``); a rule
+table maps those to mesh axes, producing ``PartitionSpec`` /
+``NamedSharding``.  This is how DP/FSDP/TP/SP become *config*, not code —
+the reference needed a different wrapper per strategy
+(``train_loop_utils.py`` prepare_model ddp/fsdp); here the same model runs
+under any mesh by swapping rules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+Rules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
+
+# Default rule table for transformer training on a (pp, dp, fsdp, sp, ep,
+# tp) mesh.  fsdp shards parameters along their largest dim (ZeRO-3); tp
+# follows megatron sharding; activations shard batch over (dp, fsdp) and
+# sequence over sp.
+DEFAULT_RULES: Rules = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("kv_seq", None),
+    ("embed", None),
+    ("embed_fsdp", "fsdp"),
+    ("vocab", "tp"),
+    ("heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("experts", "ep"),
+    ("expert_mlp", "tp"),
+    ("stage", "pp"),
+    ("conv_in", None),
+    ("conv_out", "tp"),
+)
+
+
+def rules_dict(rules: Optional[Rules] = None) -> Dict[str, object]:
+    return dict(rules if rules is not None else DEFAULT_RULES)
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]],
+                    rules: Optional[Rules] = None,
+                    mesh=None) -> P:
+    """Map logical axis names to a PartitionSpec.
+
+    Axes mapped to mesh axes that don't exist in ``mesh`` (or have size 1)
+    degrade to replication, so one rule table serves every mesh shape.
+    """
+    table = rules_dict(rules)
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        target = table.get(name)
+        if target is None:
+            out.append(None)
+            continue
+        if mesh is not None:
+            if isinstance(target, tuple):
+                target = tuple(a for a in target
+                               if mesh.shape.get(a, 1) > 1) or None
+                if isinstance(target, tuple) and len(target) == 1:
+                    target = target[0]
+            elif mesh.shape.get(target, 1) <= 1:
+                target = None
+        out.append(target)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def named_sharding(mesh, logical_axes: Sequence[Optional[str]],
+                   rules: Optional[Rules] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules, mesh))
+
+
+def tree_shardings(mesh, logical_tree, rules: Optional[Rules] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: named_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(a, (str, type(None))) for a in x))
+
+
+def constrain(x, logical_axes: Sequence[Optional[str]],
+              rules: Optional[Rules] = None, mesh=None):
+    """``with_sharding_constraint`` by logical axes (inside jit)."""
+    from jax.lax import with_sharding_constraint
+    if mesh is None:
+        try:
+            mesh = jax.sharding.get_abstract_mesh()
+            if mesh is None or not mesh.axis_names:
+                return x
+        except Exception:  # noqa: BLE001
+            return x
+    spec = logical_to_spec(logical_axes, rules,
+                           mesh if hasattr(mesh, "shape") else None)
+    return with_sharding_constraint(x, NamedSharding(mesh, spec) if
+                                    hasattr(mesh, "devices") else spec)
+
+
+def shard_params(params, mesh, logical_tree, rules: Optional[Rules] = None):
+    """Device_put a param pytree according to its logical axes."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(params, shardings)

@@ -1,0 +1,17 @@
+"""``ray_tpu.util`` — utility APIs (parity: ``python/ray/util``)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (PlacementGroup,
+                                          get_placement_group,
+                                          placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "ActorPool", "PlacementGroup", "placement_group",
+    "remove_placement_group", "get_placement_group",
+    "placement_group_table", "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

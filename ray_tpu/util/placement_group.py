@@ -1,0 +1,214 @@
+"""Placement groups — gang resource reservation.
+
+Parity: ``python/ray/util/placement_group.py`` + the raylet bundle 2PC
+(``PrepareBundleResources``/``CommitBundleResources``).  Bundles reserve
+resources on nodes and expose them as ``pg_<id>_<index>_<resource>``
+custom resources that PG-scheduled tasks/actors consume (the reference's
+formatted-resource mechanism).
+
+Strategies: PACK (prefer one node), SPREAD (prefer distinct nodes),
+STRICT_PACK (must be one node), STRICT_SPREAD (must be distinct nodes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.protocol import RpcClient
+from ray_tpu._private.worker import global_worker
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def ready(self):
+        """ObjectRef resolving when the group is created (or failed)."""
+        import ray_tpu
+
+        pg_id = self.id
+
+        @ray_tpu.remote(num_cpus=0)
+        def _pg_ready():
+            worker = global_worker()
+            info = worker.cp.wait_placement_group(pg_id.binary(), 300.0)
+            if info is None or info.get("state") != "CREATED":
+                raise TimeoutError("placement group was not created")
+            return True
+
+        return _pg_ready.remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        worker = global_worker()
+        info = worker.cp.wait_placement_group(self.id.binary(),
+                                              timeout_seconds)
+        return bool(info and info.get("state") == "CREATED")
+
+    def __reduce__(self):
+        return (_rebuild_pg, (self.id.binary(), self.bundle_specs))
+
+
+def _rebuild_pg(pg_id_bin: bytes, bundles):
+    return PlacementGroup(PlacementGroupID(pg_id_bin), bundles)
+
+
+def _nm_client_for(worker, node_info):
+    if (worker.nm is not None
+            and getattr(worker.nm, "sock_path", None)
+            == node_info["sock_path"]):
+        return worker.nm
+    client = RpcClient(node_info["sock_path"])
+    client.sock_path = node_info["sock_path"]
+    return client
+
+
+def _call(nm, method: str, *args):
+    if hasattr(nm, "call"):
+        return nm.call(method, *args)
+    return getattr(nm, method)(*args)
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b}")
+    worker = global_worker()
+    pg_id = PlacementGroupID.of(worker.job_id)
+    worker.cp.register_placement_group(pg_id.binary(), {
+        "bundles": bundles, "strategy": strategy, "name": name,
+        "state": "PENDING",
+    })
+    pg = PlacementGroup(pg_id, bundles)
+    # Reserve asynchronously so pending groups don't block the driver
+    # (parity: GCS placement group manager retries until resources exist).
+    t = threading.Thread(target=_reserve_loop,
+                         args=(pg_id.binary(), bundles, strategy),
+                         daemon=True, name="pg-reserve")
+    t.start()
+    return pg
+
+
+def _reserve_loop(pg_id: bytes, bundles, strategy: str,
+                  timeout: float = 300.0):
+    worker = global_worker()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if _try_reserve(worker, pg_id, bundles, strategy):
+            worker.cp.update_placement_group(pg_id, state="CREATED")
+            return
+        time.sleep(0.2)
+    worker.cp.update_placement_group(pg_id, state="FAILED")
+
+
+def _try_reserve(worker, pg_id: bytes, bundles, strategy: str) -> bool:
+    nodes = [n for n in worker.cp.list_nodes() if n["state"] == "ALIVE"]
+    if not nodes:
+        return False
+    placements: List[Optional[dict]] = []
+    from ray_tpu._private.task_spec import fits
+    avail = {n["node_id"]: dict(n.get("resources_available", {}))
+             for n in nodes}
+    by_id = {n["node_id"]: n for n in nodes}
+
+    def place(bundle, candidates):
+        for nid in candidates:
+            if fits(avail[nid], bundle):
+                for k, v in bundle.items():
+                    avail[nid][k] = avail[nid].get(k, 0) - v
+                return nid
+        return None
+
+    node_ids = list(avail.keys())
+    chosen: List[Optional[bytes]] = []
+    if strategy in ("PACK", "STRICT_PACK"):
+        for i, bundle in enumerate(bundles):
+            order = ([chosen[0]] + node_ids) if chosen and chosen[0] \
+                else node_ids
+            nid = place(bundle, order)
+            chosen.append(nid)
+        if strategy == "STRICT_PACK" and len(
+                {c for c in chosen if c}) > 1:
+            return False
+    elif strategy in ("SPREAD", "STRICT_SPREAD"):
+        used = set()
+        for bundle in bundles:
+            fresh = [n for n in node_ids if n not in used]
+            nid = place(bundle, fresh + ([] if strategy == "STRICT_SPREAD"
+                                         else node_ids))
+            chosen.append(nid)
+            if nid:
+                used.add(nid)
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
+    if any(c is None for c in chosen):
+        return False
+    # commit reservations; roll back on partial failure
+    committed = []
+    for index, (bundle, nid) in enumerate(zip(bundles, chosen)):
+        nm = _nm_client_for(worker, by_id[nid])
+        ok = _call(nm, "reserve_bundle", pg_id, index, bundle)
+        if not ok:
+            for done_index, done_nid, done_bundle in committed:
+                nm2 = _nm_client_for(worker, by_id[done_nid])
+                _call(nm2, "return_bundle", pg_id, done_index, done_bundle)
+            return False
+        committed.append((index, nid, bundle))
+    worker.cp.update_placement_group(
+        pg_id, bundle_nodes=[c.hex() for c in chosen])
+    return True
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    worker = global_worker()
+    info = worker.cp.get_placement_group(pg.id.binary())
+    if not info:
+        return
+    nodes = {n["node_id"].hex(): n for n in worker.cp.list_nodes()}
+    for index, (bundle, nid_hex) in enumerate(
+            zip(info.get("bundles", []), info.get("bundle_nodes", []))):
+        node = nodes.get(nid_hex)
+        if node is None:
+            continue
+        nm = _nm_client_for(worker, node)
+        try:
+            _call(nm, "return_bundle", pg.id.binary(), index, bundle)
+        except (OSError, ConnectionError):
+            pass
+    worker.cp.update_placement_group(pg.id.binary(), state="REMOVED")
+
+
+def get_placement_group(name: str) -> Optional[PlacementGroup]:
+    worker = global_worker()
+    for info in worker.cp.list_placement_groups():
+        if info.get("name") == name and info.get("state") != "REMOVED":
+            return PlacementGroup(PlacementGroupID(info["pg_id"]),
+                                  info.get("bundles", []))
+    return None
+
+
+def placement_group_table() -> List[dict]:
+    worker = global_worker()
+    out = []
+    for info in worker.cp.list_placement_groups():
+        out.append({
+            "placement_group_id": info["pg_id"].hex(),
+            "name": info.get("name", ""),
+            "state": info.get("state"),
+            "strategy": info.get("strategy"),
+            "bundles": info.get("bundles", []),
+        })
+    return out

@@ -1,0 +1,24 @@
+"""Scheduling strategy types (parity:
+``python/ray/util/scheduling_strategies.py``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str            # hex node id
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "object"
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: Optional[bool] = None
+
+
+SPREAD = "SPREAD"
+DEFAULT = "DEFAULT"

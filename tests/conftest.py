@@ -10,12 +10,19 @@ paths compile without TPU hardware (the driver bench runs on the real chip).
 import os
 import sys
 
-# Must run before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    (os.environ.get("XLA_FLAGS", "") +
-     " --xla_force_host_platform_device_count=8").strip())
+# Must run before jax initializes its backend: tests always run on the
+# virtual 8-device CPU mesh, never on the real chip (bench.py owns that).
+# The environment's sitecustomize may have already imported jax with
+# JAX_PLATFORMS latched to the TPU platform, so update the live config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,123 @@
+"""Collective verbs + placement groups + actor pool."""
+
+import numpy as np
+import pytest
+
+
+def test_host_collective_allreduce(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Worker:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+            collective.init_collective_group(world, rank, backend="host",
+                                             group_name="g1")
+            self.rank = rank
+
+        def do_allreduce(self):
+            from ray_tpu.util import collective
+            out = collective.allreduce(np.full(4, self.rank + 1.0),
+                                       group_name="g1")
+            return out
+
+        def do_allgather(self):
+            from ray_tpu.util import collective
+            return collective.allgather(np.array([self.rank]),
+                                        group_name="g1")
+
+        def do_broadcast(self):
+            from ray_tpu.util import collective
+            return collective.broadcast(
+                np.arange(3) if self.rank == 0 else np.zeros(3),
+                src_rank=0, group_name="g1")
+
+    world = 3
+    workers = [Worker.remote(r, world) for r in range(world)]
+    outs = ray.get([w.do_allreduce.remote() for w in workers], timeout=60)
+    for out in outs:
+        np.testing.assert_array_equal(out, np.full(4, 1.0 + 2.0 + 3.0))
+    gathered = ray.get([w.do_allgather.remote() for w in workers],
+                       timeout=60)
+    for g in gathered:
+        assert [int(a[0]) for a in g] == [0, 1, 2]
+    bcast = ray.get([w.do_broadcast.remote() for w in workers], timeout=60)
+    for b in bcast:
+        np.testing.assert_array_equal(b, np.arange(3))
+
+
+def test_host_collective_send_recv(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class P2P:
+        def __init__(self, rank):
+            from ray_tpu.util import collective
+            collective.init_collective_group(2, rank, backend="host",
+                                             group_name="p2p")
+            self.rank = rank
+
+        def run(self):
+            from ray_tpu.util import collective
+            if self.rank == 0:
+                collective.send(np.array([42.0]), dst_rank=1,
+                                group_name="p2p")
+                return None
+            return collective.recv(src_rank=0, group_name="p2p")
+
+    a, b = P2P.remote(0), P2P.remote(1)
+    _, received = ray.get([a.run.remote(), b.run.remote()], timeout=60)
+    np.testing.assert_array_equal(received, np.array([42.0]))
+
+
+def test_placement_group_pack(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util import placement_group, remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray.remote(num_cpus=1,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=0))
+    def in_bundle():
+        return "ok"
+
+    assert ray.get(in_bundle.remote(), timeout=60) == "ok"
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_pends(ray_start_regular):
+    ray = ray_start_regular
+    from ray_tpu.util import placement_group
+    pg = placement_group([{"CPU": 1000}])
+    assert not pg.wait(1.0)
+
+
+def test_placement_group_strict_spread_multinode(ray_start_cluster):
+    node = ray_start_cluster
+    import ray_tpu
+    node.add_node(num_cpus=2)
+    from ray_tpu.util import placement_group
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+    info = ray_tpu._private.worker.global_worker().cp.get_placement_group(
+        pg.id.binary())
+    nodes = info.get("bundle_nodes", [])
+    assert len(set(nodes)) == 2
+
+
+def test_actor_pool(ray_start_regular):
+    ray = ray_start_regular
+
+    @ray.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]

@@ -1,0 +1,101 @@
+"""Model tests: GPT forward/train under various meshes, graft entry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import training
+from ray_tpu.models.gpt import (GPTConfig, forward, init_params, loss_fn,
+                                num_params, param_logical_axes)
+from ray_tpu.parallel.mesh import make_mesh
+
+
+def test_gpt_forward_shapes():
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits, aux = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_gpt_logical_axes_match_params():
+    cfg = GPTConfig.tiny(n_experts=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    axes = param_logical_axes(cfg)
+    pl = jax.tree.leaves_with_path(params)
+    al = jax.tree.leaves_with_path(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(pl) == len(al)
+    for (ppath, leaf), (apath, ax) in zip(pl, al):
+        assert ppath == apath
+        assert leaf.ndim == len(ax), f"{ppath}: {leaf.shape} vs {ax}"
+
+
+def test_gpt_causality():
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 100)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 100)
+    l1, _ = forward(params, t1, cfg)
+    l2, _ = forward(params, t2, cfg)
+    # changing the last token must not affect earlier logits
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+    assert float(jnp.abs(l1[0, -1] - l2[0, -1]).max()) > 1e-6
+
+
+def test_gpt_train_loss_decreases_dp_tp_sp():
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    fns = training.build_gpt_train(
+        cfg, mesh, optimizer=training.default_optimizer(lr=1e-2, warmup=1))
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 8, 64,
+                                        cfg.vocab_size)
+    first = None
+    for i in range(8):
+        state, m = fns["step_fn"](state, batch)
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first
+
+
+def test_gpt_moe_trains():
+    mesh = make_mesh(dp=2, ep=2, tp=2)
+    cfg = GPTConfig.tiny(n_experts=4, dtype=jnp.float32)
+    fns = training.build_gpt_train(cfg, mesh)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 8, 32,
+                                        cfg.vocab_size)
+    state, m = fns["step_fn"](state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_ring_vs_local_full_model():
+    """Same params, sp mesh vs single device: identical loss."""
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 4, 64,
+                                        cfg.vocab_size)
+    loss_local = float(loss_fn(params, batch, cfg))
+    mesh = make_mesh(sp=4)
+    from ray_tpu.parallel.ring_attention import make_ring_attention_fn
+    attn = make_ring_attention_fn(mesh, causal=True)
+    loss_ring = float(loss_fn(params, batch, cfg, attn_fn=attn))
+    assert abs(loss_local - loss_ring) < 1e-4
+
+
+def test_graft_entry():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape[-1] == 32768
+    mod.dryrun_multichip(8)
