@@ -1,0 +1,20 @@
+"""``ray_tpu.train`` — distributed training (parity: ``ray.train``)."""
+
+from ray_tpu.train.backend import (Backend, BackendConfig, BackendExecutor,
+                                   TrainingFailedError)
+from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                  RunConfig, ScalingConfig)
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend", "BackendConfig", "BackendExecutor", "TrainingFailedError",
+    "Checkpoint", "save_pytree", "load_pytree", "CheckpointConfig",
+    "FailureConfig", "RunConfig", "ScalingConfig", "DataParallelTrainer",
+    "Result", "get_checkpoint", "get_context", "get_dataset_shard",
+    "report", "WorkerGroup",
+]

@@ -1,0 +1,140 @@
+"""Checkpoint — a directory of files plus metadata.
+
+Parity: ``python/ray/train/_checkpoint.py`` (from_directory/to_directory/
+as_directory, metadata).  Storage is a filesystem path (local or fsspec-
+mountable); jax pytrees get helpers built on orbax when available, with a
+numpy fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_METADATA_FILE = ".metadata.json"
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # ------------------------------------------------------------ builders
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        """Convenience for small state dicts (pickled into the dir)."""
+        import cloudpickle
+        path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        with open(os.path.join(path, "dict_checkpoint.pkl"), "wb") as f:
+            cloudpickle.dump(data, f)
+        return cls(path)
+
+    def to_dict(self) -> Dict[str, Any]:
+        import cloudpickle
+        with open(os.path.join(self.path, "dict_checkpoint.pkl"),
+                  "rb") as f:
+            return cloudpickle.load(f)
+
+    # ------------------------------------------------------------ metadata
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    # ------------------------------------------------------------ movement
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(dest, exist_ok=True)
+        for name in os.listdir(self.path):
+            src = os.path.join(self.path, name)
+            dst = os.path.join(dest, name)
+            if os.path.isdir(src):
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, dst)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def persist(self, storage_dir: str, name: Optional[str] = None) -> \
+            "Checkpoint":
+        """Copy into durable storage; returns the persisted checkpoint."""
+        name = name or f"checkpoint_{uuid.uuid4().hex[:8]}"
+        dest = os.path.join(storage_dir, name)
+        os.makedirs(storage_dir, exist_ok=True)
+        if os.path.abspath(self.path) == os.path.abspath(dest):
+            return self
+        self.to_directory(dest)
+        return Checkpoint(dest)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+# ---------------------------------------------------------------- pytrees
+def save_pytree(tree, path: str, *, name: str = "state") -> None:
+    """Save a jax pytree: orbax if importable, else npz + structure pickle."""
+    os.makedirs(path, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        target = os.path.join(path, name)
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ckptr.save(target, tree)
+        ckptr.wait_until_finished()
+        return
+    except Exception:  # noqa: BLE001 - fall back to numpy
+        pass
+    import cloudpickle
+    import jax
+    import numpy as np
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(os.path.join(path, f"{name}.npz"),
+             **{str(i): np.asarray(leaf) for i, leaf in enumerate(leaves)})
+    with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
+        cloudpickle.dump(treedef, f)
+
+
+def load_pytree(path: str, *, name: str = "state", target=None):
+    """Load a pytree saved by save_pytree.
+
+    ``target``: example pytree (for orbax restore typing / structure).
+    """
+    orbax_dir = os.path.join(path, name)
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        if target is not None:
+            import jax
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), target)
+            return ckptr.restore(orbax_dir, abstract)
+        return ckptr.restore(orbax_dir)
+    import cloudpickle
+    import jax
+    import numpy as np
+    data = np.load(os.path.join(path, f"{name}.npz"))
+    with open(os.path.join(path, f"{name}.treedef.pkl"), "rb") as f:
+        treedef = cloudpickle.load(f)
+    leaves = [data[str(i)] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)

@@ -1,0 +1,62 @@
+"""Keep-top-k checkpoint bookkeeping.
+
+Parity: ``python/ray/train/_internal/checkpoint_manager.py`` driven by
+``CheckpointConfig`` (keep num_to_keep best by score attribute).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, storage_dir: str, config: CheckpointConfig):
+        self.storage_dir = storage_dir
+        self.config = config
+        self._index = 0
+        # list of (score, index, checkpoint, metrics)
+        self.best: List[Tuple[float, int, Checkpoint, Dict]] = []
+        self.latest: Optional[Checkpoint] = None
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        persisted = checkpoint.persist(
+            self.storage_dir, f"checkpoint_{self._index:06d}")
+        self._index += 1
+        self.latest = persisted
+        attr = self.config.checkpoint_score_attribute
+        if attr is not None and attr in metrics:
+            score = float(metrics[attr])
+        else:
+            score = float(self._index)  # fall back to recency
+        sign = 1.0 if self.config.checkpoint_score_order == "max" else -1.0
+        self.best.append((sign * score, self._index, persisted,
+                          dict(metrics)))
+        self.best.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        keep = self.config.num_to_keep
+        if keep is not None and len(self.best) > keep:
+            for _, _, ckpt, _ in self.best[keep:]:
+                if self.latest is not None and \
+                        ckpt.path == self.latest.path:
+                    continue
+                shutil.rmtree(ckpt.path, ignore_errors=True)
+            self.best = self.best[:keep] + [
+                b for b in self.best[keep:]
+                if self.latest is not None and b[2].path ==
+                self.latest.path]
+        return persisted
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        for _, _, ckpt, _ in self.best:
+            if os.path.exists(ckpt.path):
+                return ckpt
+        return self.latest
+
+    def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict]]:
+        return [(c, m) for _, _, c, m in self.best
+                if os.path.exists(c.path)]

@@ -1,0 +1,152 @@
+"""DataParallelTrainer — N workers, one train loop each.
+
+Parity: ``python/ray/train/data_parallel_trainer.py`` +
+``base_trainer.py``: ``fit()`` starts the worker group through the
+BackendExecutor, streams reports, manages checkpoints (keep-top-k), and
+restarts the whole group from the latest checkpoint on failure
+(FailureConfig.max_failures) — the reference's elastic-training story.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend import (Backend, BackendConfig, BackendExecutor,
+                                   TrainingFailedError)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+
+
+class DataParallelTrainer:
+    _default_backend_config: BackendConfig = BackendConfig()
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or \
+            self._default_backend_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        if self.run_config.name is None:
+            self.run_config.name = (
+                f"{type(self).__name__}_{uuid.uuid4().hex[:8]}")
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------
+    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(n)
+                for i in range(n):
+                    shards[i][name] = parts[i]
+            else:
+                for i in range(n):
+                    shards[i][name] = ds
+        return shards
+
+    def fit(self) -> Result:
+        storage = self.run_config.resolved_storage_path()
+        os.makedirs(storage, exist_ok=True)
+        ckpt_mgr = CheckpointManager(
+            os.path.join(storage, "checkpoints"),
+            self.run_config.checkpoint_config)
+        max_failures = self.run_config.failure_config.max_failures
+        attempts = (max_failures + 1) if max_failures >= 0 else 10**6
+        history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+        error: Optional[BaseException] = None
+        start_ckpt = self.resume_from_checkpoint
+
+        for attempt in range(attempts):
+            executor = BackendExecutor(
+                self.backend_config,
+                self.scaling_config.num_workers,
+                self.scaling_config._resources,
+                self.scaling_config.placement_strategy)
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_loop_per_worker, self.train_loop_config,
+                    checkpoint=start_ckpt or ckpt_mgr.latest,
+                    dataset_shards=self._dataset_shards(),
+                    experiment_name=self.run_config.name,
+                    trial_id=self.run_config.name)
+                for round_results in executor.iter_results():
+                    rank0 = next((r for r in round_results
+                                  if r is not None), None)
+                    if rank0 is None:
+                        continue
+                    metrics, checkpoint = rank0
+                    metrics = dict(metrics)
+                    metrics["_attempt"] = attempt
+                    history.append(metrics)
+                    last_metrics = metrics
+                    if checkpoint is not None:
+                        ckpt_mgr.register(checkpoint, metrics)
+                error = None
+                break
+            except TrainingFailedError as e:
+                error = e
+                if attempt == attempts - 1 or \
+                        self.run_config.failure_config.fail_fast:
+                    break
+                time.sleep(0.5)
+            finally:
+                executor.shutdown()
+
+        result = Result(
+            metrics=last_metrics,
+            checkpoint=ckpt_mgr.best_checkpoint(),
+            path=storage,
+            error=error,
+            metrics_history=history,
+            best_checkpoints=ckpt_mgr.best_checkpoints(),
+        )
+        if error is not None and \
+                self.run_config.failure_config.fail_fast:
+            raise error
+        return result
+
+    @classmethod
+    def restore(cls, path: str, **kwargs) -> "DataParallelTrainer":
+        raise NotImplementedError(
+            "restore() lands with the Tune experiment-state integration")
+
+    def as_trainable(self):
+        """Adapter so Tune can run this trainer as a trial."""
+        trainer = self
+
+        def trainable(config):
+            import ray_tpu.train as train_mod
+            merged = dict(trainer.train_loop_config)
+            merged.update(config)
+            trainer2 = type(trainer)(
+                trainer.train_loop_per_worker,
+                train_loop_config=merged,
+                backend_config=trainer.backend_config,
+                scaling_config=trainer.scaling_config,
+                run_config=trainer.run_config,
+                datasets=trainer.datasets)
+            result = trainer2.fit()
+            if result.error:
+                raise result.error
+            return result.metrics
+
+        return trainable

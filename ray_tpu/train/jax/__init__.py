@@ -1,0 +1,3 @@
+from ray_tpu.train.jax.config import JaxConfig, JaxTrainer
+
+__all__ = ["JaxConfig", "JaxTrainer"]

@@ -1,0 +1,3 @@
+from ray_tpu.train.torch.config import TorchConfig, TorchTrainer
+
+__all__ = ["TorchConfig", "TorchTrainer"]

@@ -1,0 +1,114 @@
+"""TorchTrainer — torch.distributed (gloo) data-parallel backend.
+
+Parity: ``python/ray/train/torch/config.py``
+(``_setup_torch_process_group``): worker 0 picks MASTER_ADDR/PORT, every
+worker sets RANK/WORLD_SIZE and calls ``init_process_group``.  CPU/gloo
+here (no CUDA in this stack); the TPU path is JaxTrainer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_method: str = "env"
+    timeout_s: int = 180
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _setup_process_group(master_addr: str, master_port: int, rank: int,
+                         world_size: int, backend: str, timeout_s: int):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    if not dist.is_initialized():
+        dist.init_process_group(
+            backend=backend, rank=rank, world_size=world_size,
+            timeout=datetime.timedelta(seconds=timeout_s))
+    return True
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: TorchConfig):
+        n = len(worker_group)
+        if n == 0:
+            return
+        ip = ray_tpu.get(worker_group.workers[0].node_ip.remote(),
+                         timeout=30)
+        port = _free_port()
+        refs = [w.execute.remote(_setup_process_group, ip, port, rank, n,
+                                 backend_config.backend,
+                                 backend_config.timeout_s)
+                for rank, w in enumerate(worker_group.workers)]
+        ray_tpu.get(refs, timeout=backend_config.timeout_s + 60)
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        def teardown():
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+        try:
+            worker_group.execute(teardown)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def prepare_model(model, parallel_strategy: Optional[str] = "ddp"):
+    """Wrap a torch model for DP (parity: train_loop_utils.prepare_model)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel as DDP
+    if parallel_strategy == "ddp" and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        return DDP(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across workers via DistributedSampler."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(data_loader.dataset,
+                      batch_size=data_loader.batch_size,
+                      sampler=sampler,
+                      num_workers=0,
+                      collate_fn=data_loader.collate_fn,
+                      drop_last=data_loader.drop_last)
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(self, train_loop_per_worker, *,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         **kwargs)

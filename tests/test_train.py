@@ -1,0 +1,186 @@
+"""Ray Train parity tests: DataParallelTrainer, JaxTrainer, TorchTrainer,
+checkpointing, failure restart.  Modeled on
+``python/ray/train/tests/test_data_parallel_trainer.py`` et al."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_data_parallel_trainer_basic(ray_start_regular, tmp_path):
+    import ray_tpu.train as train
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(),
+                          "world": ctx.get_world_size()})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["world"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_trainer_checkpointing(ray_start_regular, tmp_path):
+    import ray_tpu.train as train
+    from ray_tpu.train import (Checkpoint, CheckpointConfig,
+                               DataParallelTrainer, RunConfig,
+                               ScalingConfig)
+
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(4):
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                ckpt = Checkpoint.from_dict({"step": step,
+                                             "weights": [step] * 3})
+            train.report({"loss": 10.0 - step}, checkpoint=ckpt)
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="ckpt", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="loss",
+                checkpoint_score_order="min")))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    state = result.checkpoint.to_dict()
+    assert state["step"] == 3  # best by min loss = last step
+    assert len(result.best_checkpoints) <= 2
+
+
+def test_trainer_failure_restart(ray_start_regular, tmp_path):
+    import ray_tpu.train as train
+    from ray_tpu.train import (Checkpoint, DataParallelTrainer,
+                               FailureConfig, RunConfig, ScalingConfig)
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 4):
+            if step == 2 and ckpt is None:
+                raise RuntimeError("simulated failure at step 2")
+            c = (Checkpoint.from_dict({"step": step})
+                 if ctx.get_world_rank() == 0 else None)
+            train.report({"step": step}, checkpoint=c)
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="restart", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    attempts = {m.get("_attempt") for m in result.metrics_history}
+    assert attempts == {0, 1}
+
+
+def test_jax_trainer_dp_allreduce(ray_start_regular, tmp_path):
+    """2-worker data-parallel jax training with host-collective grad sync."""
+    import ray_tpu.train as train
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.jax import JaxConfig, JaxTrainer
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.util import collective
+        ctx = train.get_context()
+        group = config["group_name"]
+        # toy linear regression, grads averaged across workers
+        w = jnp.zeros((4,))
+        rng = np.random.default_rng(ctx.get_world_rank())
+        X = jnp.asarray(rng.normal(size=(64, 4)))
+        true_w = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+        y = X @ true_w
+
+        def loss_fn(w):
+            return jnp.mean((X @ w - y) ** 2)
+
+        for step in range(30):
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            g_sum = collective.allreduce(np.asarray(g), group_name=group)
+            g_avg = jnp.asarray(g_sum) / ctx.get_world_size()
+            w = w - 0.1 * g_avg
+            train.report({"loss": float(loss), "step": step})
+        final = np.asarray(w)
+        train.report({"final_err": float(np.abs(
+            final - np.asarray(true_w)).max())})
+
+    cfg = JaxConfig(host_collective=True,
+                    collective_group_name="jax_dp_test")
+    trainer = JaxTrainer(
+        loop, jax_config=cfg,
+        train_loop_config={"group_name": "jax_dp_test"},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="jaxdp", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["final_err"] < 0.05
+
+
+@pytest.mark.skipif(
+    os.environ.get("RAY_TPU_SKIP_TORCH") == "1",
+    reason="torch distributed not available")
+def test_torch_trainer_ddp(ray_start_regular, tmp_path):
+    import ray_tpu.train as train
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu.train.torch.config import prepare_model
+        assert dist.is_initialized()
+        model = prepare_model(torch.nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        X = torch.randn(32, 4)
+        y = X @ torch.tensor([[1.0], [-1.0], [2.0], [0.0]])
+        for step in range(10):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), y)
+            loss.backward()
+            opt.step()
+            train.report({"loss": float(loss), "step": step,
+                          "world": dist.get_world_size()})
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torchddp", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+    assert result.metrics["loss"] < 2.0
+
+
+def test_pytree_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree
+    tree = {"w": jnp.arange(10.0), "nested": {"b": jnp.ones((3, 3))}}
+    save_pytree(tree, str(tmp_path / "ck"))
+    out = load_pytree(str(tmp_path / "ck"), target=tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.arange(10.0))
+    np.testing.assert_array_equal(np.asarray(out["nested"]["b"]),
+                                  np.ones((3, 3)))
