@@ -50,6 +50,10 @@ class CoreWorker:
         self._actor_nm_cache: Dict[bytes, Any] = {}
         self._seq_lock = threading.Lock()
         self._actor_seq: Dict[bytes, int] = {}
+        # Client-side buffering for calls to not-yet-ALIVE actors
+        # (reference: caller-side buffer in direct_actor_task_submitter).
+        self._actor_buffers: Dict[bytes, List] = {}
+        self._actor_buffer_lock = threading.Lock()
         self._gen_len_cache: Dict[bytes, int] = {}
         self.current_actor = None
         self.current_actor_id: Optional[bytes] = None
@@ -382,26 +386,88 @@ class CoreWorker:
             max_task_retries=opts.get("max_task_retries", 0),
             owner_id=self.worker_id.binary(),
         )
-        try:
-            nm = self._actor_nm(actor_id)
-            if nm is self.nm and self.mode == "driver":
-                nm.submit_actor_task(spec)
-            else:
-                nm.call("submit_actor_task", spec) if hasattr(nm, "call") \
-                    else nm.submit_actor_task(spec)
-        except ActorDiedError as e:
-            err = TaskError(e, "", task_id.hex())
-            data = serialization.dumps(err)
-            for oid in spec.return_object_ids():
-                self.cp.put_inline(oid, data, is_error=True)
-            if streaming:
-                self.commit_generator_done(task_id.binary(), 1)
-                self.commit_generator_item(task_id.binary(), 0, err,
-                                           is_error=True)
+        self._route_or_buffer(spec, streaming)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(o) for o in spec.return_object_ids()]
         return refs[0] if num_returns == 1 else refs
+
+    def _route_now(self, spec: TaskSpec) -> None:
+        nm = self._actor_nm(spec.actor_id, wait=False)
+        if nm is self.nm and self.mode == "driver":
+            nm.submit_actor_task(spec)
+        elif hasattr(nm, "call"):
+            nm.call("submit_actor_task", spec)
+        else:
+            nm.submit_actor_task(spec)
+
+    def _fail_actor_call(self, spec: TaskSpec, streaming: bool,
+                         error: BaseException) -> None:
+        err = TaskError(error, "", spec.task_id.hex())
+        data = serialization.dumps(err)
+        for oid in spec.return_object_ids():
+            self.cp.put_inline(oid, data, is_error=True)
+        if streaming:
+            self.commit_generator_done(spec.task_id, 1)
+            self.commit_generator_item(spec.task_id, 0, err, is_error=True)
+
+    def _route_or_buffer(self, spec: TaskSpec, streaming: bool) -> None:
+        """Route to the actor's node manager, or buffer until it's ALIVE.
+
+        Buffered calls preserve per-caller order: a single flusher thread
+        per actor drains the buffer FIFO once the actor starts.
+        """
+        actor_id = spec.actor_id
+        info = self.cp.get_actor_info(actor_id)
+        state = info.get("state") if info else None
+        with self._actor_buffer_lock:
+            buffer = self._actor_buffers.get(actor_id)
+            if state == "ALIVE" and buffer is None:
+                pass  # fall through to direct route below
+            elif state == "DEAD" or info is None:
+                self._fail_actor_call(spec, streaming, ActorDiedError(
+                    actor_id.hex() if actor_id else "",
+                    (info or {}).get("death_reason", "actor is dead")))
+                return
+            else:
+                if buffer is None:
+                    buffer = []
+                    self._actor_buffers[actor_id] = buffer
+                    threading.Thread(
+                        target=self._flush_actor_buffer,
+                        args=(actor_id,), daemon=True,
+                        name="actor-buffer-flush").start()
+                buffer.append((spec, streaming))
+                return
+        try:
+            self._route_now(spec)
+        except ActorDiedError as e:
+            self._fail_actor_call(spec, streaming, e)
+
+    def _flush_actor_buffer(self, actor_id: bytes) -> None:
+        info = self.cp.wait_actor_state(actor_id, ("ALIVE", "DEAD"),
+                                        timeout=600.0)
+        while True:
+            with self._actor_buffer_lock:
+                buffered = self._actor_buffers.get(actor_id, [])
+                if not buffered:
+                    self._actor_buffers.pop(actor_id, None)
+                    return
+                batch = list(buffered)
+                buffered.clear()
+            for spec, streaming in batch:
+                if info is None or info.get("state") != "ALIVE":
+                    self._fail_actor_call(
+                        spec, streaming, ActorDiedError(
+                            actor_id.hex(),
+                            "actor failed to start" if info is None
+                            else info.get("death_reason",
+                                          "actor is dead")))
+                else:
+                    try:
+                        self._route_now(spec)
+                    except ActorDiedError as e:
+                        self._fail_actor_call(spec, streaming, e)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         try:

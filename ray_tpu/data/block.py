@@ -1,0 +1,125 @@
+"""Blocks — the unit of data movement.
+
+Parity: ``python/ray/data/block.py``.  A block is a ``pyarrow.Table``
+(host memory, zero-copied through the shm object store); the
+BlockAccessor converts between formats and slices batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+def _to_table(data: Any) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        cols = {}
+        for k, v in data.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                # tensor column: store as fixed-size list
+                flat = arr.reshape(len(arr), -1)
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(flat.ravel()), flat.shape[1])
+            else:
+                cols[k] = pa.array(arr)
+        return pa.table(cols)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(data, list):
+        if data and isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({"item": pa.array(data)})
+    raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(_to_table(block))
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self.block
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None
+                 ) -> Dict[str, np.ndarray]:
+        cols = columns or self.block.column_names
+        out = {}
+        for name in cols:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                combined = col.combine_chunks()
+                flat = combined.flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(-1, width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pylist(self) -> List[Dict[str, Any]]:
+        return self.block.to_pylist()
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def take_rows(self, indices) -> Block:
+        return self.block.take(pa.array(indices))
+
+    def iter_batches(self, batch_size: Optional[int],
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        n = self.num_rows()
+        if batch_size is None or batch_size >= n:
+            ranges = [(0, n)] if n else []
+        else:
+            ranges = [(i, min(i + batch_size, n))
+                      for i in range(0, n, batch_size)]
+        for start, end in ranges:
+            chunk = BlockAccessor(self.slice(start, end))
+            yield format_batch(chunk.block, batch_format)
+
+
+def format_batch(block: Block, batch_format: str):
+    acc = BlockAccessor(block)
+    if batch_format in ("numpy", "default", None):
+        return acc.to_numpy()
+    if batch_format == "pandas":
+        return acc.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_to_block(batch: Any) -> Block:
+    return _to_table(batch)
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    tables = [b for b in blocks if b.num_rows > 0]
+    if not tables:
+        return blocks[0] if blocks else pa.table({})
+    return pa.concat_tables(tables, promote_options="default")

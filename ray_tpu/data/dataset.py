@@ -1,0 +1,419 @@
+"""Dataset — lazy, distributed data pipelines.
+
+Parity: ``python/ray/data/dataset.py`` (``Dataset``): a logical plan of
+operators over blocks; execution fans out ray_tpu tasks per block with a
+bounded in-flight window (streaming backpressure, the shape of the
+reference's ``StreamingExecutor``).  Blocks are pyarrow tables in the shm
+object store; ``iter_batches`` feeds accelerators from host blocks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Tuple,
+                    Union)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (Block, BlockAccessor, batch_to_block,
+                                concat_blocks, format_batch)
+from ray_tpu.object_ref import ObjectRef
+
+# bounded number of concurrently materializing blocks (backpressure)
+DEFAULT_WINDOW = 8
+
+
+# ---------------------------------------------------------------- remote ops
+@ray_tpu.remote(max_retries=3)
+def _map_block(block: Block, fns) -> Block:
+    for kind, fn, kwargs in fns:
+        acc = BlockAccessor.for_block(block)
+        if kind == "map_batches":
+            batch_size = kwargs.get("batch_size")
+            fmt = kwargs.get("batch_format", "numpy")
+            out = []
+            for batch in acc.iter_batches(batch_size, fmt):
+                res = fn(batch)
+                out.append(batch_to_block(res))
+            block = concat_blocks(out) if out else block.slice(0, 0)
+        elif kind == "map":
+            rows = [fn(r) for r in acc.to_pylist()]
+            block = batch_to_block(rows)
+        elif kind == "flat_map":
+            rows = list(itertools.chain.from_iterable(
+                fn(r) for r in acc.to_pylist()))
+            block = batch_to_block(rows) if rows else block.slice(0, 0)
+        elif kind == "filter":
+            rows = [r for r in acc.to_pylist() if fn(r)]
+            block = batch_to_block(rows) if rows else block.slice(0, 0)
+        else:
+            raise ValueError(kind)
+    return block
+
+
+@ray_tpu.remote(max_retries=3)
+def _split_block(block: Block, n: int, seed: Optional[int]) -> List[Block]:
+    """Split one block into n shards (for shuffle/repartition)."""
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    idx = np.arange(rows)
+    if seed is not None:
+        rng = np.random.default_rng(seed)
+        rng.shuffle(idx)
+    parts = np.array_split(idx, n)
+    return [acc.take_rows(p) if len(p) else block.slice(0, 0)
+            for p in parts]
+
+
+@ray_tpu.remote(max_retries=3)
+def _merge_blocks(*blocks: Block) -> Block:
+    return concat_blocks(list(blocks))
+
+
+# ------------------------------------------------------------------- plan
+class _Op:
+    pass
+
+
+class _MapOp(_Op):
+    def __init__(self, kind: str, fn: Callable, **kwargs):
+        self.kind = kind
+        self.fn = fn
+        self.kwargs = kwargs
+
+
+class _AllToAllOp(_Op):
+    def __init__(self, kind: str, **kwargs):
+        self.kind = kind
+        self.kwargs = kwargs
+
+
+class Dataset:
+    def __init__(self, block_refs: List[ObjectRef],
+                 ops: Optional[List[_Op]] = None):
+        self._block_refs = block_refs
+        self._ops: List[_Op] = ops or []
+
+    # -------------------------------------------------------- transforms
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [op])
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy", **ignored) -> "Dataset":
+        return self._with_op(_MapOp("map_batches", fn,
+                                    batch_size=batch_size,
+                                    batch_format=batch_format))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_op(_MapOp("map", fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_op(_MapOp("flat_map", fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_op(_MapOp("filter", fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+        return self.map_batches(select)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with_op(_AllToAllOp("shuffle", seed=seed))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(_AllToAllOp("repartition",
+                                         num_blocks=num_blocks))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(_AllToAllOp("sort", key=key,
+                                         descending=descending))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()
+        right = other.materialize()
+        return Dataset(left._block_refs + right._block_refs)
+
+    def limit(self, n: int) -> "Dataset":
+        rows = self.take(n)
+        from ray_tpu.data import from_items
+        return from_items(rows)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        import pyarrow as pa
+        a = self.materialize()._to_table()
+        b = other.materialize()._to_table()
+        if a.num_rows != b.num_rows:
+            raise ValueError("zip requires equal row counts")
+        cols = {name: a.column(name) for name in a.column_names}
+        for name in b.column_names:
+            key = name if name not in cols else f"{name}_1"
+            cols[key] = b.column(name)
+        from ray_tpu.data import from_arrow
+        return from_arrow(pa.table(cols))
+
+    # --------------------------------------------------------- execution
+    def _execute(self, window: int = DEFAULT_WINDOW
+                 ) -> Iterator[ObjectRef]:
+        """Stream transformed block refs with bounded in-flight tasks."""
+        refs = list(self._block_refs)
+        ops = list(self._ops)
+        # collapse consecutive map ops into fused stages (the reference
+        # fuses map chains into one task per block)
+        stages: List[Tuple[str, Any]] = []
+        fused: List[Tuple[str, Callable, Dict]] = []
+        for op in ops:
+            if isinstance(op, _MapOp):
+                fused.append((op.kind, op.fn, op.kwargs))
+            else:
+                if fused:
+                    stages.append(("map", fused))
+                    fused = []
+                stages.append((op.kind, op.kwargs))
+        if fused:
+            stages.append(("map", fused))
+
+        def apply_stage(refs_in: List[ObjectRef], stage) -> List[ObjectRef]:
+            kind, arg = stage
+            if kind == "map":
+                return [_map_block.remote(r, arg) for r in refs_in]
+            if kind == "shuffle":
+                seed = arg.get("seed")
+                n = max(1, len(refs_in))
+                parts = [_split_block.options(num_returns=n).remote(
+                    r, n, (seed + i) if seed is not None else None)
+                    for i, r in enumerate(refs_in)]
+                parts = [p if isinstance(p, list) else [p] for p in parts]
+                return [_merge_blocks.remote(
+                    *[parts[j][i] for j in range(len(refs_in))])
+                    for i in range(n)]
+            if kind == "repartition":
+                n = arg["num_blocks"]
+                parts = [_split_block.options(num_returns=n).remote(
+                    r, n, None) for r in refs_in]
+                parts = [p if isinstance(p, list) else [p] for p in parts]
+                return [_merge_blocks.remote(
+                    *[parts[j][i] for j in range(len(refs_in))])
+                    for i in range(n)]
+            if kind == "sort":
+                table = _sorted_table(refs_in, arg["key"],
+                                      arg["descending"])
+                return [ray_tpu.put(table)]
+            raise ValueError(kind)
+
+        for stage in stages:
+            refs = apply_stage(refs, stage)
+        yield from refs
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._execute())
+        # force completion (and surface errors) before declaring it
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=600) \
+            if refs else None
+        return Dataset(refs)
+
+    def _to_table(self):
+        blocks = ray_tpu.get(list(self._execute()), timeout=600)
+        return concat_blocks(blocks)
+
+    # ------------------------------------------------------- consumption
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 2) -> Iterator[Any]:
+        carry: Optional[Block] = None
+        for ref in self._execute():
+            block = ray_tpu.get(ref, timeout=600)
+            if carry is not None and carry.num_rows > 0:
+                block = concat_blocks([carry, block])
+                carry = None
+            acc = BlockAccessor.for_block(block)
+            n = acc.num_rows()
+            if batch_size is None:
+                if n:
+                    yield format_batch(block, batch_format)
+                continue
+            start = 0
+            while n - start >= batch_size:
+                yield format_batch(acc.slice(start, start + batch_size),
+                                   batch_format)
+                start += batch_size
+            if start < n:
+                carry = acc.slice(start, n)
+        if carry is not None and carry.num_rows > 0 and not drop_last:
+            yield format_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._execute():
+            block = ray_tpu.get(ref, timeout=600)
+            yield from BlockAccessor.for_block(block).to_pylist()
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(BlockAccessor.for_block(b).num_rows()
+                   for b in ray_tpu.get(list(self._execute()),
+                                        timeout=600))
+
+    def schema(self):
+        for ref in self._execute():
+            block = ray_tpu.get(ref, timeout=600)
+            return BlockAccessor.for_block(block).schema()
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def to_pandas(self):
+        return self._to_table().to_pandas()
+
+    def to_arrow(self):
+        return self._to_table()
+
+    # ------------------------------------------------------- aggregation
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def _agg(self, col: Optional[str], how: str):
+        import pyarrow.compute as pc
+        table = self._to_table()
+        if col is None:
+            col = table.column_names[0]
+        fn = {"sum": pc.sum, "min": pc.min, "max": pc.max,
+              "mean": pc.mean, "count": pc.count}[how]
+        return fn(table.column(col)).as_py()
+
+    def sum(self, col: Optional[str] = None):
+        return self._agg(col, "sum")
+
+    def min(self, col: Optional[str] = None):
+        return self._agg(col, "min")
+
+    def max(self, col: Optional[str] = None):
+        return self._agg(col, "max")
+
+    def mean(self, col: Optional[str] = None):
+        return self._agg(col, "mean")
+
+    # ------------------------------------------------------------ split
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        """Split into n datasets (Train ingest sharding)."""
+        table = self._to_table()
+        rows = table.num_rows
+        bounds = np.linspace(0, rows, n + 1).astype(int)
+        out = []
+        for i in range(n):
+            shard = table.slice(bounds[i], bounds[i + 1] - bounds[i])
+            out.append(Dataset([ray_tpu.put(shard)]))
+        return out
+
+    def train_test_split(self, test_size: float = 0.25,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        table = ds._to_table()
+        n_test = int(table.num_rows * test_size)
+        n_train = table.num_rows - n_test
+        return (Dataset([ray_tpu.put(table.slice(0, n_train))]),
+                Dataset([ray_tpu.put(table.slice(n_train, n_test))]))
+
+    # ------------------------------------------------------------ write
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref, timeout=600)
+            pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pyarrow.csv as pacsv
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref, timeout=600)
+            pacsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._block_refs)}, "
+                f"ops={len(self._ops)})")
+
+
+def _sorted_table(refs: List[ObjectRef], key: str, descending: bool):
+    import pyarrow.compute as pc
+    table = concat_blocks(ray_tpu.get(list(refs), timeout=600))
+    order = "descending" if descending else "ascending"
+    idx = pc.sort_indices(table, sort_keys=[(key, order)])
+    return table.take(idx)
+
+
+class GroupedData:
+    def __init__(self, ds: Dataset, key: str):
+        self.ds = ds
+        self.key = key
+
+    def _agg(self, col: str, how: str) -> Dataset:
+        table = self.ds._to_table()
+        out = table.group_by(self.key).aggregate([(col, how)])
+        return Dataset([ray_tpu.put(out)])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg(col, "sum")
+
+    def min(self, col: str) -> Dataset:
+        return self._agg(col, "min")
+
+    def max(self, col: str) -> Dataset:
+        return self._agg(col, "max")
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg(col, "mean")
+
+    def count(self) -> Dataset:
+        table = self.ds._to_table()
+        out = table.group_by(self.key).aggregate([([], "count_all")])
+        return Dataset([ray_tpu.put(out)])
+
+    def map_groups(self, fn: Callable,
+                   batch_format: str = "numpy") -> Dataset:
+        import pyarrow.compute as pc
+        table = self.ds._to_table()
+        keys = pc.unique(table.column(self.key))
+        blocks = []
+        for k in keys:
+            mask = pc.equal(table.column(self.key), k)
+            group = table.filter(mask)
+            res = fn(format_batch(group, batch_format))
+            blocks.append(ray_tpu.put(batch_to_block(res)))
+        return Dataset(blocks)

@@ -1,0 +1,141 @@
+"""Datasource read/create APIs.
+
+Parity: ``python/ray/data/read_api.py`` — range/from_items/from_numpy/
+from_pandas/from_arrow + file readers (parquet, csv, json, text, binary,
+images) on pyarrow.  Reads are lazy-ish: file reads happen in tasks at
+execution time.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import batch_to_block
+from ray_tpu.data.dataset import Dataset
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**", "*"),
+                                      recursive=True)
+                if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            files.extend(sorted(_glob.glob(p)))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return files
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    blocks = override_num_blocks or min(max(1, n // 1000), 64)
+    bounds = np.linspace(0, n, blocks + 1).astype(int)
+    refs = []
+    for i in np.arange(blocks):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        refs.append(ray_tpu.put(
+            pa.table({"id": pa.array(np.arange(lo, hi))})))
+    return Dataset(refs)
+
+
+def from_items(items: List[Any], *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    if items and not isinstance(items[0], dict):
+        items = [{"item": x} for x in items]
+    blocks = override_num_blocks or min(max(1, len(items) // 1000), 16)
+    parts = np.array_split(np.arange(len(items)), blocks)
+    refs = [ray_tpu.put(pa.Table.from_pylist(
+        [items[i] for i in part])) for part in parts if len(part)]
+    if not refs:
+        refs = [ray_tpu.put(pa.table({"item": pa.array([])}))]
+    return Dataset(refs)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return Dataset([ray_tpu.put(batch_to_block({column: arr}))])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return Dataset([ray_tpu.put(table)])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([ray_tpu.put(
+        pa.Table.from_pandas(df, preserve_index=False))])
+
+
+# ------------------------------------------------------------ file readers
+@ray_tpu.remote(max_retries=3)
+def _read_file_task(path: str, fmt: str, kwargs: Dict[str, Any]):
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        return pq.read_table(path, **kwargs)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        return pacsv.read_csv(path, **kwargs)
+    if fmt == "json":
+        import pyarrow.json as pajson
+        return pajson.read_json(path, **kwargs)
+    if fmt == "text":
+        with open(path) as f:
+            lines = [line.rstrip("\n") for line in f]
+        return pa.table({"text": pa.array(lines)})
+    if fmt == "binary":
+        with open(path, "rb") as f:
+            data = f.read()
+        return pa.table({"bytes": pa.array([data], pa.binary()),
+                         "path": pa.array([path])})
+    if fmt == "numpy":
+        arr = np.load(path)
+        return batch_to_block({"data": arr})
+    if fmt == "image":
+        from PIL import Image
+        img = np.asarray(Image.open(path))
+        return batch_to_block({"image": img[None, ...]})
+    raise ValueError(f"unknown format {fmt}")
+
+
+def _read_files(paths, fmt: str, **kwargs) -> Dataset:
+    files = _expand_paths(paths)
+    refs = [_read_file_task.remote(f, fmt, kwargs) for f in files]
+    return Dataset(refs)
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "parquet", **kwargs)
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "csv", **kwargs)
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "json", **kwargs)
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "text", **kwargs)
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "binary", **kwargs)
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "numpy", **kwargs)
+
+
+def read_images(paths, **kwargs) -> Dataset:
+    return _read_files(paths, "image", **kwargs)

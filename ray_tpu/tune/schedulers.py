@@ -1,0 +1,77 @@
+"""Trial schedulers (parity: ``python/ray/tune/schedulers/``).
+
+FIFOScheduler runs everything to completion; ASHAScheduler implements
+async successive halving (``async_hyperband.py``): rungs at
+grace_period * reduction_factor^k, trials below the rung's top-1/rf
+quantile are stopped at that rung.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class ASHAScheduler:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung milestones: grace * rf^k below max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        # rung -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = {}
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric) if self.metric else None
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for rung_idx, milestone in enumerate(self.milestones):
+            if t == milestone and \
+                    self._trial_rung.get(trial_id, -1) < rung_idx:
+                self._trial_rung[trial_id] = rung_idx
+                values = self._rungs[milestone]
+                values.append(self._norm(float(metric)))
+                if len(values) >= self.rf:
+                    cutoff_index = max(
+                        0, int(math.ceil(len(values) / self.rf)) - 1)
+                    cutoff = sorted(values, reverse=True)[cutoff_index]
+                    if self._norm(float(metric)) < cutoff:
+                        decision = STOP
+        return decision
+
+    def on_trial_complete(self, trial_id: str):
+        self._trial_rung.pop(trial_id, None)
+
+
+AsyncHyperBandScheduler = ASHAScheduler

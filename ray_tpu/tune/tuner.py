@@ -1,0 +1,262 @@
+"""Tuner + controller (parity: ``python/ray/tune/tuner.py`` +
+``tune/execution/tune_controller.py``).
+
+Each trial is one actor executing the trainable with its config; the
+controller polls reports, feeds the scheduler (ASHA early stopping), and
+collects Results.  Trial-actor creation queues naturally on cluster
+resources, giving max-concurrency-by-resources like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import TrainContext
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search.sample import resolve
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    search_alg: Any = None
+    seed: int = 0
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Runs one trial's function in a thread; streams reports."""
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+
+    def run(self, fn: Callable, config: Dict[str, Any],
+            context: TrainContext, checkpoint):
+        from ray_tpu.train.session import init_session
+        session = init_session(context, checkpoint)
+
+        def runner():
+            try:
+                import inspect
+                out = fn(config)
+                if isinstance(out, dict):
+                    session.queue.put(("report", out, None))
+            except BaseException as e:  # noqa: BLE001
+                session.error = e
+            finally:
+                session.finished.set()
+                session.queue.put(("done", None, None))
+
+        threading.Thread(target=runner, daemon=True,
+                         name=f"trial-{self.trial_id}").start()
+        return True
+
+    def next_report(self, timeout: float = 1.0):
+        import queue as _q
+
+        from ray_tpu.train.session import get_session
+        session = get_session()
+        if session is None:
+            return ("done", None, None)
+        try:
+            item = session.queue.get(timeout=timeout)
+        except _q.Empty:
+            return None
+        if item[0] == "done" and session.error is not None:
+            from ray_tpu.exceptions import format_remote_traceback
+            return ("error", {"message": str(session.error),
+                              "traceback": format_remote_traceback(
+                                  session.error)}, None)
+        return item
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = "PENDING"
+    actor: Any = None
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    iterations: int = 0
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        valid = [r for r in self._results
+                 if r.error is None and metric in r.metrics]
+        if not valid:
+            raise ValueError(f"no completed trial reported {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(valid, key=key) if mode == "max" else min(valid,
+                                                             key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row["error"] = str(r.error) if r.error else None
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        if self.run_config.name is None:
+            self.run_config.name = f"tune_{uuid.uuid4().hex[:8]}"
+        self._resources = getattr(trainable, "_tune_resources",
+                                  {"CPU": 1.0})
+
+    def fit(self) -> ResultGrid:
+        configs = resolve(self.param_space, self.tune_config.num_samples,
+                          self.tune_config.seed)
+        scheduler = self.tune_config.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and \
+                hasattr(scheduler, "metric"):
+            scheduler.metric = self.tune_config.metric
+        storage = self.run_config.resolved_storage_path()
+        os.makedirs(storage, exist_ok=True)
+
+        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                  for i, cfg in enumerate(configs)]
+        max_concurrent = (self.tune_config.max_concurrent_trials
+                          or len(trials))
+
+        pending = list(trials)
+        running: List[Trial] = []
+        finished: List[Trial] = []
+
+        def launch(trial: Trial):
+            opts = {"num_cpus": self._resources.get("CPU", 1.0)}
+            if self._resources.get("TPU"):
+                opts["num_tpus"] = self._resources["TPU"]
+            trial.actor = _TrialActor.options(**opts).remote(
+                trial.trial_id)
+            ctx = TrainContext(experiment_name=self.run_config.name,
+                               trial_name=trial.trial_id,
+                               trial_id=trial.trial_id)
+            # fire-and-forget: the call is buffered client-side until the
+            # trial actor is scheduled (it may queue behind resources)
+            trial.actor.run.remote(self.trainable, trial.config, ctx,
+                                   None)
+            trial.status = "RUNNING"
+            running.append(trial)
+
+        def actor_alive(trial: Trial) -> bool:
+            from ray_tpu._private.worker import global_worker
+            info = global_worker().cp.get_actor_info(
+                trial.actor._actor_id)
+            return bool(info) and info.get("state") == "ALIVE"
+
+        from ray_tpu.exceptions import GetTimeoutError
+
+        while pending or running:
+            while pending and len(running) < max_concurrent:
+                launch(pending.pop(0))
+            progressed = False
+            for trial in list(running):
+                if not actor_alive(trial):
+                    continue  # still queued on resources
+                try:
+                    item = ray_tpu.get(
+                        trial.actor.next_report.remote(0.2), timeout=60)
+                except GetTimeoutError:
+                    continue
+                if item is None:
+                    continue
+                progressed = True
+                kind = item[0]
+                if kind == "error":
+                    trial.status = "ERROR"
+                    trial.error = item[1]["traceback"]
+                    running.remove(trial)
+                    finished.append(trial)
+                    scheduler.on_trial_complete(trial.trial_id)
+                    ray_tpu.kill(trial.actor)
+                elif kind == "done":
+                    trial.status = "TERMINATED"
+                    running.remove(trial)
+                    finished.append(trial)
+                    scheduler.on_trial_complete(trial.trial_id)
+                    ray_tpu.kill(trial.actor)
+                else:
+                    metrics, checkpoint = item[1], item[2]
+                    trial.iterations += 1
+                    metrics.setdefault("training_iteration",
+                                       trial.iterations)
+                    metrics["trial_id"] = trial.trial_id
+                    metrics["config"] = trial.config
+                    trial.last_result = metrics
+                    trial.history.append(metrics)
+                    if checkpoint is not None:
+                        trial.checkpoint = checkpoint.persist(
+                            os.path.join(storage, trial.trial_id))
+                    decision = scheduler.on_result(trial.trial_id,
+                                                   metrics)
+                    if decision == STOP:
+                        trial.status = "TERMINATED"
+                        running.remove(trial)
+                        finished.append(trial)
+                        scheduler.on_trial_complete(trial.trial_id)
+                        ray_tpu.kill(trial.actor)
+            if not progressed:
+                time.sleep(0.05)
+
+        results = []
+        for trial in trials:
+            err = None
+            if trial.error:
+                err = RuntimeError(
+                    f"trial {trial.trial_id} failed:\n{trial.error}")
+            results.append(Result(
+                metrics=trial.last_result,
+                checkpoint=trial.checkpoint,
+                path=os.path.join(storage, trial.trial_id),
+                error=err,
+                metrics_history=trial.history))
+        return ResultGrid(results, self.tune_config.metric,
+                          self.tune_config.mode)
