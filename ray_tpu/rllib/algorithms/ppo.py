@@ -1,0 +1,238 @@
+"""PPO — proximal policy optimization on JAX.
+
+Parity: reference ``rllib/algorithms/ppo/`` (new stack): Algorithm drives
+env-runner actors (sampling) and a Learner (jitted clipped-surrogate SGD).
+TPU-first: the learner's update is one pjit-compiled function over a
+device mesh (dp axis for minibatch sharding) rather than a DDP wrapper.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule, MLPModuleConfig
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+
+@dataclass
+class PPOConfig:
+    """Builder-style config (parity: AlgorithmConfig/PPOConfig)."""
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 2
+    rollout_length: int = 256
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_sgd_epochs: int = 6
+    minibatch_size: int = 128
+    grad_clip: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    # builder methods mirror the reference's fluent API
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if rollout_length:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def _compute_gae(batch: Dict[str, np.ndarray], gamma: float,
+                 lam: float) -> Dict[str, np.ndarray]:
+    rewards = batch["rewards"]
+    values = batch["values"]
+    terminateds = batch["terminateds"]
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    last_gae = 0.0
+    next_value = float(batch["bootstrap_value"])
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - terminateds[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    out = dict(batch)
+    out["advantages"] = adv
+    out["value_targets"] = adv + values
+    return out
+
+
+class PPOLearner:
+    """Jitted PPO update (parity: rllib/core/learner + ppo_learner)."""
+
+    def __init__(self, module: DiscreteMLPModule, config: PPOConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.module = module
+        self.config = config
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr))
+        cfg = config
+
+        def loss_fn(params, batch):
+            logits, values = module.forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], -1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - cfg.clip_param,
+                               1 + cfg.clip_param) * adv
+            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = jnp.mean((values - batch["value_targets"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, -1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_ratio": ratio.mean()}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+            params = _optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = update
+
+    def init_state(self, key):
+        params = self.module.init_params(key)
+        return params, self.tx.init(params)
+
+    def update(self, params, opt_state, train_batch: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+        cfg = self.config
+        n = len(train_batch["obs"])
+        metrics = {}
+        rng = np.random.default_rng(0)
+        for _ in range(cfg.num_sgd_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n, cfg.minibatch_size):
+                idx = perm[start:start + cfg.minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in
+                      train_batch.items() if k != "bootstrap_value"}
+                params, opt_state, metrics = self._update(
+                    params, opt_state, mb)
+        return params, opt_state, {k: float(v)
+                                   for k, v in metrics.items()}
+
+
+class PPO:
+    """Algorithm driver (parity: ``Algorithm.train()`` loop)."""
+
+    def __init__(self, config: PPOConfig):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module = DiscreteMLPModule(MLPModuleConfig(
+            obs_dim=obs_dim, num_actions=num_actions,
+            hidden=tuple(config.hidden)))
+        self.learner = PPOLearner(self.module, config)
+        self.params, self.opt_state = self.learner.init_state(
+            jax.random.PRNGKey(config.seed))
+        blob = cloudpickle.dumps(self.module)
+        self.env_runners = [
+            SingleAgentEnvRunner.remote(
+                config.env, blob, config.rollout_length,
+                seed=config.seed + i, env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self.iteration = 0
+        self.timesteps_total = 0
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        params_np = ray_tpu.put(
+            __import__("jax").tree.map(np.asarray, self.params))
+        batches = ray_tpu.get(
+            [runner.sample.remote(params_np)
+             for runner in self.env_runners], timeout=600)
+        processed = [
+            _compute_gae(b, self.config.gamma, self.config.lambda_)
+            for b in batches]
+        train_batch = {
+            k: np.concatenate([p[k] for p in processed])
+            for k in processed[0] if k != "bootstrap_value"}
+        self.params, self.opt_state, learner_metrics = \
+            self.learner.update(self.params, self.opt_state, train_batch)
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners],
+            timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if not np.isnan(m["episode_return_mean"])]
+        self.iteration += 1
+        self.timesteps_total += len(train_batch["obs"])
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "num_episodes": sum(m["num_episodes"]
+                                for m in runner_metrics),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def stop(self):
+        for runner in self.env_runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # Tune integration: PPO as a function trainable
+    @staticmethod
+    def as_trainable(config_dict: Dict[str, Any],
+                     stop_iters: int = 10) -> Callable:
+        def trainable(tune_config):
+            import ray_tpu.tune as tune
+            merged = dict(config_dict)
+            merged.update(tune_config)
+            cfg = PPOConfig(**merged)
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+        return trainable

@@ -1,0 +1,76 @@
+"""RLModule — the neural policy/value abstraction (JAX).
+
+Parity: reference new-stack ``rllib/core/rl_module/rl_module.py``: one
+object owning forward passes for exploration/inference/training.  Pure
+functional JAX: params are a pytree, forward fns are jittable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class MLPModuleConfig:
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+class DiscreteMLPModule:
+    """Categorical policy + value MLP (CartPole-class tasks)."""
+
+    def __init__(self, config: MLPModuleConfig):
+        self.config = config
+
+    def init_params(self, key) -> Dict[str, Any]:
+        cfg = self.config
+        sizes = (cfg.obs_dim,) + tuple(cfg.hidden)
+        params: Dict[str, Any] = {"layers": []}
+        keys = jax.random.split(key, len(sizes) + 1)
+        layers = []
+        for i in range(len(sizes) - 1):
+            w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * \
+                (2.0 / sizes[i]) ** 0.5
+            layers.append({"w": w.astype(cfg.dtype),
+                           "b": jnp.zeros(sizes[i + 1], cfg.dtype)})
+        params["layers"] = layers
+        params["pi"] = {
+            "w": (jax.random.normal(keys[-2],
+                                    (sizes[-1], cfg.num_actions))
+                  * 0.01).astype(cfg.dtype),
+            "b": jnp.zeros(cfg.num_actions, cfg.dtype)}
+        params["vf"] = {
+            "w": (jax.random.normal(keys[-1], (sizes[-1], 1))
+                  * 1.0).astype(cfg.dtype),
+            "b": jnp.zeros(1, cfg.dtype)}
+        return params
+
+    def _trunk(self, params, obs):
+        x = obs
+        for layer in params["layers"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward(self, params, obs):
+        """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+        x = self._trunk(params, obs)
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    def action_dist(self, logits):
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def sample_actions(self, params, obs, key):
+        logits, value = self.forward(params, obs)
+        actions = jax.random.categorical(key, logits)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), actions[..., None], -1)[..., 0]
+        return actions, logp, value

@@ -1,0 +1,93 @@
+"""EnvRunner — sampling actor.
+
+Parity: reference ``rllib/env/single_agent_env_runner.py``: owns gym envs,
+rolls out the current policy, returns batched trajectories (numpy host
+arrays; the learner moves them to device).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class SingleAgentEnvRunner:
+    def __init__(self, env_name: str, module_blob: bytes,
+                 rollout_length: int = 256, seed: int = 0,
+                 env_config: Optional[Dict[str, Any]] = None):
+        import cloudpickle
+        import gymnasium as gym
+        self.env = gym.make(env_name, **(env_config or {}))
+        self.module = cloudpickle.loads(module_blob)
+        self.rollout_length = rollout_length
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._episode_len = 0
+        self.completed_returns: List[float] = []
+        self.completed_lengths: List[int] = []
+        self._jit_sample = None
+        self._key = None
+
+    def _sampler(self):
+        if self._jit_sample is None:
+            import jax
+            self._jit_sample = jax.jit(self.module.sample_actions)
+            self._key = jax.random.PRNGKey(int(self.rng.integers(2**31)))
+        return self._jit_sample
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Roll out ``rollout_length`` steps; returns trajectory arrays."""
+        import jax
+        sampler = self._sampler()
+        T = self.rollout_length
+        obs_buf = np.zeros((T,) + np.shape(self.obs), np.float32)
+        act_buf = np.zeros((T,), np.int64)
+        logp_buf = np.zeros((T,), np.float32)
+        val_buf = np.zeros((T,), np.float32)
+        rew_buf = np.zeros((T,), np.float32)
+        done_buf = np.zeros((T,), np.float32)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            a, logp, v = sampler(params, self.obs[None, :], sub)
+            a = int(a[0])
+            obs_buf[t] = self.obs
+            act_buf[t] = a
+            logp_buf[t] = float(logp[0])
+            val_buf[t] = float(v[0])
+            nxt, rew, terminated, truncated, _ = self.env.step(a)
+            rew_buf[t] = rew
+            done = terminated or truncated
+            done_buf[t] = float(terminated)
+            self._episode_return += rew
+            self._episode_len += 1
+            if done:
+                self.completed_returns.append(self._episode_return)
+                self.completed_lengths.append(self._episode_len)
+                self._episode_return = 0.0
+                self._episode_len = 0
+                nxt, _ = self.env.reset()
+            self.obs = nxt
+        # bootstrap value for the final state
+        _, _, last_v = sampler(params, self.obs[None, :],
+                               jax.random.PRNGKey(0))
+        return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "values": val_buf, "rewards": rew_buf,
+                "terminateds": done_buf,
+                "bootstrap_value": np.float32(last_v[0])}
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_return_mean": (float(np.mean(
+                self.completed_returns[-100:]))
+                if self.completed_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(
+                self.completed_lengths[-100:]))
+                if self.completed_lengths else float("nan")),
+            "num_episodes": len(self.completed_returns),
+        }
+        return out

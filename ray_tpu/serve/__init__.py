@@ -1,0 +1,232 @@
+"""``ray_tpu.serve`` — model serving (parity: ``ray.serve``).
+
+``@serve.deployment`` → ``.bind(...)`` → ``serve.run(app)`` → handle or
+HTTP.  Controller actor reconciles replica actors; handles route with
+power-of-two-choices; an aiohttp proxy serves HTTP.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve._private.controller import (CONTROLLER_NAME,
+                                               ServeController)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    max_ongoing_requests: int = 8
+    user_config: Optional[Dict[str, Any]] = None
+
+    def options(self, **kwargs) -> "Deployment":
+        import dataclasses
+        return dataclasses.replace(self, **kwargs)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+class Application:
+    def __init__(self, deployment: Deployment, args: Tuple,
+                 kwargs: Dict[str, Any]):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               ray_actor_options: Optional[Dict] = None,
+               max_ongoing_requests: int = 8,
+               user_config: Optional[Dict] = None, **ignored):
+    """``@serve.deployment`` decorator (parity: serve/api.py:244)."""
+    def wrap(target):
+        return Deployment(
+            target, name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config)
+
+    if func_or_class is not None:
+        return wrap(func_or_class)
+    return wrap
+
+
+# ------------------------------------------------------------------ run
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        try:
+            return ServeController.options(
+                name=CONTROLLER_NAME, lifetime="detached",
+                max_concurrency=16).remote()
+        except ValueError:
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def _collect_deployments(app: Application, app_name: str,
+                         out: List[Dict[str, Any]]) -> str:
+    """DFS the bind graph; nested Applications become handles."""
+    dep = app.deployment
+
+    def resolve(value):
+        if isinstance(value, Application):
+            child_name = _collect_deployments(value, app_name, out)
+            return DeploymentHandle(app_name, child_name)
+        return value
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    if not any(d["name"] == dep.name for d in out):
+        out.append({
+            "name": dep.name,
+            "cls_blob": cloudpickle.dumps(dep.func_or_class),
+            "init_args": args,
+            "init_kwargs": kwargs,
+            "num_replicas": dep.num_replicas,
+            "actor_options": dep.ray_actor_options,
+            "max_ongoing": dep.max_ongoing_requests,
+        })
+    return dep.name
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str = "/", blocking: bool = False,
+        http_port: Optional[int] = None) -> DeploymentHandle:
+    controller = _get_or_create_controller()
+    deployments: List[Dict[str, Any]] = []
+    ingress = _collect_deployments(app, name, deployments)
+    ray_tpu.get(controller.deploy_application.remote(
+        name, deployments, ingress), timeout=300)
+    if http_port is not None:
+        start_http_proxy(http_port)
+    return DeploymentHandle(name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_applications.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    apps = ray_tpu.get(controller.list_applications.remote(), timeout=30)
+    for app in list(apps):
+        ray_tpu.get(controller.delete_application.remote(app),
+                    timeout=60)
+    proxy = ray_tpu.get(controller.get_proxy.remote(), timeout=10)
+    if proxy is not None:
+        ray_tpu.kill(proxy)
+    ray_tpu.kill(ray_tpu.get_actor(CONTROLLER_NAME))
+
+
+# ------------------------------------------------------------------ http
+def start_http_proxy(port: int = 8000):
+    from ray_tpu.serve._private.proxy import HTTPProxy
+    controller = _get_or_create_controller()
+    existing = ray_tpu.get(controller.get_proxy.remote(), timeout=10)
+    if existing is not None:
+        return existing
+    proxy = HTTPProxy.options(max_concurrency=64).remote(port)
+    ray_tpu.get(proxy.ready.remote(), timeout=60)
+    ray_tpu.get(controller.set_proxy.remote(proxy), timeout=10)
+    return proxy
+
+
+# ------------------------------------------------------------- batching
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` — coalesce concurrent calls into one batch call.
+
+    Parity: ``python/ray/serve/batching.py``.  The wrapped method receives
+    a list of inputs and must return a list of outputs.
+    """
+    import asyncio
+    import functools
+
+    def wrap(fn):
+        # single-event-loop state: no awaits between mutations, so no lock
+        state: Dict[str, Any] = {"queue": [], "timer": None}
+
+        async def flush(owner):
+            if state["timer"] is not None:
+                state["timer"].cancel()
+                state["timer"] = None
+            items = state["queue"][:max_batch_size]
+            del state["queue"][:max_batch_size]
+            if not items:
+                return
+            inputs = [p for p, _ in items]
+            try:
+                outs = await (fn(owner, inputs) if owner is not None
+                              else fn(inputs))
+                for (_, fut), out in zip(items, outs):
+                    if not fut.done():
+                        fut.set_result(out)
+            except Exception as e:  # noqa: BLE001
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+            if state["queue"]:
+                asyncio.ensure_future(flush(owner))
+
+        @functools.wraps(fn)
+        async def wrapper(self_or_arg, *args):
+            # support bound methods (self) and free functions
+            if args:
+                owner, payload = self_or_arg, args[0]
+            else:
+                owner, payload = None, self_or_arg
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+            state["queue"].append((payload, fut))
+            if len(state["queue"]) >= max_batch_size:
+                asyncio.ensure_future(flush(owner))
+            elif state["timer"] is None:
+                state["timer"] = loop.call_later(
+                    batch_wait_timeout_s,
+                    lambda: asyncio.ensure_future(flush(owner)))
+            return await fut
+
+        return wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "get_app_handle",
+    "get_deployment_handle", "status", "delete", "shutdown",
+    "DeploymentHandle", "DeploymentResponse", "batch",
+    "start_http_proxy",
+]

@@ -1,0 +1,111 @@
+"""ServeController — declarative app reconciliation.
+
+Parity: reference ``serve/_private/controller.py`` + ``deployment_state.py``
+(compressed): the controller is a detached actor holding the desired state
+of every application; deploying reconciles replica actors to the target
+count; handles query it for routing tables (pull-based instead of the
+reference's long-poll push, same information flow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        # app -> deployment -> {"config":..., "replicas": [handles],
+        #                       "version": int}
+        self.apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self.ingress: Dict[str, str] = {}  # app -> ingress deployment
+        self.proxy = None
+
+    async def deploy_application(self, app_name: str,
+                                 deployments: List[Dict[str, Any]],
+                                 ingress_name: str):
+        """deployments: [{name, cls_blob, init_args, init_kwargs,
+        num_replicas, actor_options, max_ongoing}]"""
+        import cloudpickle
+        app = self.apps.setdefault(app_name, {})
+        desired = {d["name"] for d in deployments}
+        # tear down removed deployments
+        for name in list(app):
+            if name not in desired:
+                for replica in app[name]["replicas"]:
+                    ray_tpu.kill(replica)
+                del app[name]
+        from ray_tpu.serve._private.replica import ServeReplica
+        for d in deployments:
+            entry = app.get(d["name"])
+            version = (entry["version"] + 1) if entry else 1
+            if entry:  # in-place update: replace replicas
+                for replica in entry["replicas"]:
+                    ray_tpu.kill(replica)
+            replicas = []
+            for i in range(d["num_replicas"]):
+                opts = dict(d.get("actor_options") or {})
+                opts.setdefault("num_cpus", 0)
+                opts["max_concurrency"] = max(
+                    d.get("max_ongoing", 8), 1)
+                replicas.append(ServeReplica.options(**opts).remote(
+                    app_name, d["name"], d["cls_blob"],
+                    d.get("init_args") or (),
+                    d.get("init_kwargs") or {}))
+            app[d["name"]] = {"config": {k: v for k, v in d.items()
+                                         if k != "cls_blob"},
+                              "replicas": replicas,
+                              "version": version}
+        self.ingress[app_name] = ingress_name
+        # wait for all replicas to be live
+        pings = []
+        for name in desired:
+            for replica in app[name]["replicas"]:
+                pings.append(replica.ping.remote())
+        for ref in pings:
+            await ref
+        return True
+
+    def get_routing(self, app_name: str,
+                    deployment: Optional[str] = None):
+        app = self.apps.get(app_name)
+        if app is None:
+            return None
+        name = deployment or self.ingress.get(app_name)
+        entry = app.get(name)
+        if entry is None:
+            return None
+        return {"deployment": name, "replicas": entry["replicas"],
+                "version": entry["version"],
+                "max_ongoing": entry["config"].get("max_ongoing", 8)}
+
+    def list_applications(self):
+        return {app: {"deployments": {
+            name: {"num_replicas": len(e["replicas"]),
+                   "version": e["version"]}
+            for name, e in deps.items()},
+            "ingress": self.ingress.get(app)}
+            for app, deps in self.apps.items()}
+
+    def delete_application(self, app_name: str):
+        app = self.apps.pop(app_name, None)
+        self.ingress.pop(app_name, None)
+        if app:
+            for entry in app.values():
+                for replica in entry["replicas"]:
+                    ray_tpu.kill(replica)
+        return True
+
+    def set_proxy(self, proxy):
+        self.proxy = proxy
+
+    def get_proxy(self):
+        return self.proxy
+
+    def ping(self):
+        return "pong"

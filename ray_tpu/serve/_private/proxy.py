@@ -1,0 +1,71 @@
+"""HTTP proxy actor (parity: reference ``serve/_private/proxy.py``).
+
+aiohttp server inside an async actor: routes ``/<app>`` (and ``/`` to the
+default app) to the app's ingress deployment handle; JSON bodies become
+the callable's argument, JSON-able returns become the response.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class HTTPProxy:
+    def __init__(self, port: int = 8000):
+        # NOTE: __init__ runs before the actor's event loop starts; the
+        # server is brought up lazily from the first ready() call.
+        self.port = port
+        self._runner = None
+        self._ready = False
+        self._starting = False
+
+    async def _start(self):
+        from aiohttp import web
+
+        async def handle(request: "web.Request"):
+            from ray_tpu.serve.handle import DeploymentHandle
+            path = request.path.strip("/")
+            app_name = path.split("/")[0] if path else "default"
+            try:
+                body: Any = None
+                if request.can_read_body:
+                    raw = await request.read()
+                    if raw:
+                        try:
+                            body = json.loads(raw)
+                        except json.JSONDecodeError:
+                            body = raw.decode()
+                handle = DeploymentHandle(app_name)
+                loop = asyncio.get_running_loop()
+                response = await loop.run_in_executor(
+                    None, lambda: handle.remote(body).result(60.0))
+                if isinstance(response, (dict, list, int, float, bool)) \
+                        or response is None:
+                    return web.json_response(response)
+                return web.Response(text=str(response))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": str(e)}, status=500)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        await site.start()
+        self._ready = True
+
+    async def ready(self):
+        if not self._starting:
+            self._starting = True
+            asyncio.ensure_future(self._start())
+        for _ in range(200):
+            if self._ready:
+                return self.port
+            await asyncio.sleep(0.05)
+        raise RuntimeError("proxy failed to start")

@@ -1,0 +1,58 @@
+"""Replica actor wrapping the user's deployment callable.
+
+Parity: reference ``serve/_private/replica.py`` (compressed): executes
+requests against the user class, tracks in-flight count for
+power-of-two-choices routing, supports async and sync callables.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Tuple
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    def __init__(self, app_name: str, deployment_name: str,
+                 cls_blob: bytes, init_args: Tuple, init_kwargs: Dict):
+        import cloudpickle
+        cls = cloudpickle.loads(cls_blob)
+        if inspect.isfunction(cls):
+            self.instance = cls
+        else:
+            self.instance = cls(*init_args, **init_kwargs)
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._ongoing = 0
+
+    def ping(self):
+        return "pong"
+
+    def num_ongoing(self) -> int:
+        return self._ongoing
+
+    async def handle_request(self, method_name: str, args, kwargs):
+        self._ongoing += 1
+        try:
+            target = (self.instance if method_name == "__call__"
+                      and not hasattr(self.instance, "__call__")
+                      else None)
+            if callable(self.instance) and method_name == "__call__":
+                fn = self.instance
+            else:
+                fn = getattr(self.instance, method_name)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    async def reconfigure(self, user_config):
+        if hasattr(self.instance, "reconfigure"):
+            out = self.instance.reconfigure(user_config)
+            if inspect.iscoroutine(out):
+                await out
+        return True

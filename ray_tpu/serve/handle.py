@@ -1,0 +1,96 @@
+"""DeploymentHandle — the client API for calling deployments.
+
+Parity: reference ``serve/handle.py`` + the power-of-two-choices replica
+scheduler (``replica_scheduler/pow_2_scheduler.py``): pick two random
+replicas, probe queue lengths, send to the shorter queue.  The routing
+table is pulled from the controller and cached (refreshed on version
+bump or failure).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like response (parity: serve.handle.DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = 60.0):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        return self._ref.__await__()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: Optional[str] = None,
+                 method_name: str = "__call__"):
+        self._app = app_name
+        self._deployment = deployment_name
+        self._method = method_name
+        self._routing: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    # handle.method.remote(...) sugar
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._app, self._deployment, name)
+
+    def options(self, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self._app, self._deployment,
+                                method_name or self._method)
+
+    def _controller(self):
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _get_routing(self, refresh: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            if self._routing is None or refresh:
+                routing = ray_tpu.get(
+                    self._controller().get_routing.remote(
+                        self._app, self._deployment), timeout=30)
+                if routing is None:
+                    raise RuntimeError(
+                        f"no deployment "
+                        f"{self._deployment or '(ingress)'} in app "
+                        f"{self._app!r}")
+                self._routing = routing
+            return self._routing
+
+    def _pick_replica(self):
+        routing = self._get_routing()
+        replicas = routing["replicas"]
+        if len(replicas) == 1:
+            return replicas[0]
+        # power of two choices on queue length
+        a, b = random.sample(replicas, 2)
+        try:
+            qa, qb = ray_tpu.get([a.num_ongoing.remote(),
+                                  b.num_ongoing.remote()], timeout=5)
+        except Exception:  # noqa: BLE001 - refresh and fall back
+            self._get_routing(refresh=True)
+            return random.choice(self._get_routing()["replicas"])
+        return a if qa <= qb else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._pick_replica()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app, self._deployment,
+                                   self._method))

@@ -1,0 +1,54 @@
+"""RLlib parity tests: PPO learning on CartPole, GAE math, Tune integration."""
+
+import numpy as np
+import pytest
+
+
+def test_gae_computation():
+    from ray_tpu.rllib.algorithms.ppo import _compute_gae
+    batch = {
+        "rewards": np.array([1.0, 1.0, 1.0], np.float32),
+        "values": np.array([0.5, 0.5, 0.5], np.float32),
+        "terminateds": np.array([0.0, 0.0, 1.0], np.float32),
+        "bootstrap_value": np.float32(0.0),
+    }
+    out = _compute_gae(batch, gamma=1.0, lam=1.0)
+    # terminal step: adv = r - v = 0.5; step1: 1 + 0.5 - 0.5 + ... telescoping
+    np.testing.assert_allclose(out["advantages"], [2.5, 1.5, 0.5])
+    np.testing.assert_allclose(out["value_targets"], [3.0, 2.0, 1.0])
+
+
+def test_ppo_learns_cartpole(ray_start_regular):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, rollout_length=256)
+            .training(lr=3e-4, minibatch_size=128, num_sgd_epochs=6,
+                      seed=1)
+            .build())
+    try:
+        first = algo.train()
+        last = None
+        for _ in range(11):
+            last = algo.train()
+        assert last["episode_return_mean"] > first["episode_return_mean"]
+        assert last["timesteps_total"] == 12 * 2 * 256
+        assert np.isfinite(last["learner/total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_ppo_in_tune(ray_start_regular, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.rllib.algorithms.ppo import PPO
+    from ray_tpu.train.config import RunConfig
+
+    trainable = PPO.as_trainable(
+        {"env": "CartPole-v1", "num_env_runners": 1,
+         "rollout_length": 128}, stop_iters=2)
+    results = tune.run(trainable,
+                       config={"lr": tune.grid_search([3e-4, 1e-3])},
+                       metric="episode_return_mean", mode="max",
+                       storage_path=str(tmp_path))
+    assert len(results) == 2
+    assert results.get_best_result().metrics["training_iteration"] == 2
