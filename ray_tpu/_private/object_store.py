@@ -65,6 +65,9 @@ class _MappedObject:
 class ShmStore:
     """Per-node object store rooted at a tmpfs directory."""
 
+    # objects at or below this size go to the native arena when available
+    ARENA_MAX_OBJECT = 4 * 1024 * 1024
+
     def __init__(self, root: str, capacity: Optional[int] = None,
                  spill_dir: Optional[str] = None):
         self.root = root
@@ -77,6 +80,18 @@ class ShmStore:
         self._used = 0
         # Sealed mmaps cached per process so repeated gets share one mapping.
         self._mapped: Dict[bytes, _MappedObject] = {}
+        # Native C++ arena fastpath (src/shmstore): one mmap shared by all
+        # node processes; first process creates, the rest attach.
+        self._arena = None
+        if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
+            try:
+                from ray_tpu._private.shmstore_native import NativeArena
+                arena_cap = min(self.capacity // 4, 2 << 30)
+                self._arena = NativeArena(
+                    os.path.join(root, "arena"), capacity=arena_cap,
+                    create=True)
+            except Exception:  # noqa: BLE001 - python file path still works
+                self._arena = None
 
     # -------------------------------------------------------- paths -----
     def _path(self, object_id: bytes) -> str:
@@ -91,6 +106,9 @@ class ShmStore:
                        obj: "serialization.SerializedObject") -> int:
         """Create + seal an object; returns its sealed size."""
         size = obj.total_bytes
+        if self._arena is not None and size <= self.ARENA_MAX_OBJECT:
+            if self._arena.put(object_id, obj.write_into, size):
+                return size
         self._ensure_capacity(size)
         path = self._path(object_id)
         tmp = path + f".tmp.{os.getpid()}"
@@ -118,12 +136,18 @@ class ShmStore:
 
     # --------------------------------------------------------- read -----
     def contains(self, object_id: bytes) -> bool:
+        if self._arena is not None and self._arena.contains(object_id):
+            return True
         return os.path.exists(self._path(object_id)) or (
             self.spill_dir is not None
             and os.path.exists(self._spill_path(object_id)))
 
     def get_view(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy view of a sealed object; None if absent."""
+        if self._arena is not None:
+            view = self._arena.get(object_id)
+            if view is not None:
+                return view
         with self._lock:
             mapped = self._mapped.get(object_id)
             if mapped is not None:
@@ -156,12 +180,14 @@ class ShmStore:
 
     # ------------------------------------------------------- delete -----
     def delete(self, object_id: bytes) -> bool:
+        arena_removed = (self._arena is not None
+                         and self._arena.delete(object_id))
         with self._lock:
             self._mapped.pop(object_id, None)
             entry = self._index.pop(object_id, None)
             if entry:
                 self._used -= entry[0]
-        removed = False
+        removed = arena_removed
         for path in ([self._path(object_id)]
                      + ([self._spill_path(object_id)] if self.spill_dir
                         else [])):
@@ -240,9 +266,13 @@ class ShmStore:
     # -------------------------------------------------------- stats -----
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {"used_bytes": self._used, "capacity_bytes": self.capacity,
-                    "num_objects": len(self._index),
-                    "num_mapped": len(self._mapped)}
+            out = {"used_bytes": self._used,
+                   "capacity_bytes": self.capacity,
+                   "num_objects": len(self._index),
+                   "num_mapped": len(self._mapped)}
+        if self._arena is not None:
+            out["arena"] = self._arena.stats()
+        return out
 
     def release_mappings(self) -> None:
         with self._lock:
@@ -250,4 +280,7 @@ class ShmStore:
 
     def destroy(self) -> None:
         self.release_mappings()
+        if self._arena is not None:
+            self._arena.detach()
+            self._arena = None
         shutil.rmtree(self.root, ignore_errors=True)

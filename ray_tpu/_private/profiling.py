@@ -1,0 +1,53 @@
+"""Task-event timeline — Chrome/Perfetto trace export.
+
+Parity: reference ``python/ray/_private/profiling.py``
+(``chrome_tracing_dump``) fed by the task-event backbone (GCS task
+manager).  Load the output in ``chrome://tracing`` / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def chrome_tracing_dump(task_events: List[Dict[str, Any]],
+                        filename: Optional[str] = None) -> str:
+    """Convert task state transitions into Chrome trace events."""
+    # group by task: RUNNING -> FINISHED/FAILED becomes a complete event
+    by_task: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in task_events:
+        by_task.setdefault(ev.get("task_id", "?"), []).append(ev)
+    trace = []
+    for task_id, events in by_task.items():
+        events.sort(key=lambda e: e.get("time", 0))
+        name = next((e.get("name") for e in events if e.get("name")),
+                    task_id[:8])
+        start = None
+        worker = None
+        for ev in events:
+            state = ev.get("state")
+            if state == "RUNNING":
+                start = ev.get("time")
+                worker = ev.get("worker", ev.get("node", "driver"))
+            elif state in ("FINISHED", "FAILED") and start is not None:
+                trace.append({
+                    "cat": "task", "name": name, "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": (ev["time"] - start) * 1e6,
+                    "pid": ev.get("node", "node")[:8],
+                    "tid": (worker or "worker")[:8],
+                    "args": {"task_id": task_id, "state": state},
+                })
+                start = None
+    out = json.dumps(trace)
+    if filename:
+        with open(filename, "w") as f:
+            f.write(out)
+    return out
+
+
+def timeline(filename: Optional[str] = None) -> str:
+    from ray_tpu._private.worker import global_worker
+    events = global_worker().cp.list_task_events()
+    return chrome_tracing_dump(events, filename)

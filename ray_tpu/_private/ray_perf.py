@@ -1,0 +1,108 @@
+"""Microbenchmark driver.
+
+Parity: reference ``python/ray/_private/ray_perf.py`` — same metric names
+so numbers are comparable line-for-line (`ray microbenchmark`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn: Callable, multiplier: int = 1,
+           duration: float = 2.0) -> Dict[str, float]:
+    # warmup
+    fn()
+    count = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < duration:
+        fn()
+        count += 1
+    dt = time.perf_counter() - t0
+    rate = count * multiplier / dt
+    print(f"{name} per second {rate:.2f}")
+    return {"name": name, "rate": rate}
+
+
+def main(duration: float = 2.0) -> List[Dict[str, float]]:
+    results = []
+    value = np.zeros(16 * 1024, dtype=np.uint8)  # small object
+    big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)  # 100MB
+
+    # --- object store ---
+    ref = ray_tpu.put(value)
+    results.append(timeit(
+        "single client get calls (shm store)",
+        lambda: ray_tpu.get(ref), duration=duration))
+    results.append(timeit(
+        "single client put calls (shm store)",
+        lambda: ray_tpu.put(value), duration=duration))
+
+    def put_gb():
+        ray_tpu.get(ray_tpu.put(big))
+    results.append(timeit("single client put gigabytes",
+                          put_gb, multiplier=big.nbytes // 2**30 or 1,
+                          duration=duration))
+
+    # --- tasks ---
+    @ray_tpu.remote
+    def tiny(x):
+        return x
+
+    results.append(timeit(
+        "single client tasks sync",
+        lambda: ray_tpu.get(tiny.remote(0)), duration=duration))
+
+    def batch_tasks():
+        ray_tpu.get([tiny.remote(i) for i in range(100)])
+    results.append(timeit("single client tasks and get batch",
+                          batch_tasks, multiplier=100,
+                          duration=duration))
+
+    # --- wait ---
+    refs_1k = [ray_tpu.put(i) for i in range(1000)]
+    results.append(timeit(
+        "single client wait 1k refs",
+        lambda: ray_tpu.wait(refs_1k, num_returns=1000, timeout=10),
+        duration=duration))
+
+    # --- actors ---
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    actor = Echo.remote()
+    ray_tpu.get(actor.ping.remote())
+    results.append(timeit(
+        "1:1 actor calls sync",
+        lambda: ray_tpu.get(actor.ping.remote()), duration=duration))
+
+    def async_batch():
+        ray_tpu.get([actor.ping.remote(i) for i in range(100)])
+    results.append(timeit("1:1 actor calls async", async_batch,
+                          multiplier=100, duration=duration))
+
+    actors = [Echo.remote() for _ in range(4)]
+    for a in actors:
+        ray_tpu.get(a.ping.remote())
+
+    def nn_batch():
+        ray_tpu.get([a.ping.remote(i) for a in actors
+                     for i in range(25)])
+    results.append(timeit("n:n actor calls async", nn_batch,
+                          multiplier=100, duration=duration))
+    return results
+
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    try:
+        main()
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,130 @@
+"""DAG building (parity: ``python/ray/dag``): ``fn.bind(...)`` /
+``Cls.bind(...)`` build a lazy graph; ``.execute()`` submits it.
+
+The reference's *compiled* DAGs additionally reuse mutable plasma channels
+per invocation; here execute() submits regular tasks (the object store is
+already cheap on-node) — channel reuse is a later optimization tracked in
+ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+
+class DAGNode:
+    def execute(self, *args):
+        refs = self._resolve({}, args)
+        return refs
+
+    def _resolve(self, cache: Dict[int, Any], exec_args: Tuple):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time arguments: ``with InputNode() as x``."""
+
+    _CURRENT: List["InputNode"] = []
+
+    def __init__(self, index: int = 0):
+        self.index = index
+
+    def __enter__(self):
+        InputNode._CURRENT.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        InputNode._CURRENT.pop()
+
+    def _resolve(self, cache, exec_args):
+        return exec_args[self.index]
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def _resolve(self, cache, exec_args):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+
+        def value_of(a):
+            return (a._resolve(cache, exec_args)
+                    if isinstance(a, DAGNode) else a)
+
+        ref = self.remote_fn.remote(
+            *[value_of(a) for a in self.args],
+            **{k: value_of(v) for k, v in self.kwargs.items()})
+        cache[key] = ref
+        return ref
+
+    def execute(self, *args):
+        return self._resolve({}, args)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args: Tuple, kwargs: Dict[str, Any]):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+        self._handle = None
+
+    def _get_handle(self, cache, exec_args):
+        if self._handle is None:
+            def value_of(a):
+                return (a._resolve(cache, exec_args)
+                        if isinstance(a, DAGNode) else a)
+            self._handle = self.actor_cls.remote(
+                *[value_of(a) for a in self.args],
+                **{k: value_of(v) for k, v in self.kwargs.items()})
+        return self._handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodNodeFactory(self, name)
+
+    def _resolve(self, cache, exec_args):
+        return self._get_handle(cache, exec_args)
+
+
+class _MethodNodeFactory:
+    def __init__(self, class_node: ClassNode, method: str):
+        self.class_node = class_node
+        self.method = method
+
+    def bind(self, *args, **kwargs) -> "MethodNode":
+        return MethodNode(self.class_node, self.method, args, kwargs)
+
+
+class MethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        self.class_node = class_node
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+    def _resolve(self, cache, exec_args):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        handle = self.class_node._get_handle(cache, exec_args)
+
+        def value_of(a):
+            return (a._resolve(cache, exec_args)
+                    if isinstance(a, DAGNode) else a)
+
+        ref = getattr(handle, self.method).remote(
+            *[value_of(a) for a in self.args],
+            **{k: value_of(v) for k, v in self.kwargs.items()})
+        cache[key] = ref
+        return ref
+
+    def execute(self, *args):
+        return self._resolve({}, args)
+
+
+MultiOutputNode = list  # API stub: DAGs with several outputs

@@ -1,0 +1,113 @@
+"""Dashboard — HTTP observability API.
+
+Parity (compressed): reference ``dashboard/head.py`` + modules: REST
+endpoints over control-plane state (nodes/actors/tasks/objects/cluster),
+Prometheus ``/metrics``, Chrome-trace ``/api/timeline``, and a minimal
+HTML index.  Runs as an aiohttp server thread in the head process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+_INDEX_HTML = """<!doctype html>
+<title>ray_tpu dashboard</title>
+<h1>ray_tpu dashboard</h1>
+<ul>
+<li><a href="/api/cluster">cluster</a></li>
+<li><a href="/api/nodes">nodes</a></li>
+<li><a href="/api/actors">actors</a></li>
+<li><a href="/api/tasks">tasks</a></li>
+<li><a href="/api/objects">objects</a></li>
+<li><a href="/api/placement_groups">placement groups</a></li>
+<li><a href="/api/timeline">timeline (chrome trace)</a></li>
+<li><a href="/metrics">prometheus metrics</a></li>
+</ul>"""
+
+
+class Dashboard:
+    def __init__(self, port: int = 8265):
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def start(self) -> int:
+        started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            loop.run_until_complete(self._serve(started))
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="dashboard")
+        self._thread.start()
+        if not started.wait(10):
+            raise RuntimeError("dashboard failed to start")
+        return self.port
+
+    async def _serve(self, started: threading.Event):
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.util import state as state_api
+
+        def json_response(data):
+            return web.json_response(data)
+
+        async def index(request):
+            return web.Response(text=_INDEX_HTML,
+                                content_type="text/html")
+
+        async def nodes(request):
+            return json_response(state_api.list_nodes())
+
+        async def actors(request):
+            return json_response(state_api.list_actors())
+
+        async def tasks(request):
+            return json_response(state_api.list_tasks())
+
+        async def objects(request):
+            return json_response(state_api.summarize_objects())
+
+        async def pgs(request):
+            return json_response(state_api.list_placement_groups())
+
+        async def cluster(request):
+            return json_response({
+                "resources_total": ray_tpu.cluster_resources(),
+                "resources_available": ray_tpu.available_resources(),
+                "task_summary": state_api.summarize_tasks(),
+                "actor_summary": state_api.summarize_actors(),
+            })
+
+        async def timeline(request):
+            from ray_tpu._private.profiling import timeline as tl
+            return web.Response(text=tl(), content_type="application/json")
+
+        async def metrics(request):
+            from ray_tpu.util.metrics import prometheus_text
+            return web.Response(text=prometheus_text(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/", index)
+        app.router.add_get("/api/nodes", nodes)
+        app.router.add_get("/api/actors", actors)
+        app.router.add_get("/api/tasks", tasks)
+        app.router.add_get("/api/objects", objects)
+        app.router.add_get("/api/placement_groups", pgs)
+        app.router.add_get("/api/cluster", cluster)
+        app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        await site.start()
+        started.set()

@@ -1,0 +1,65 @@
+"""MLP classifier — the fashion-MNIST baseline model (BASELINE config 1)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden: Sequence[int] = (128, 128)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def build_mlp_train(model: MLP, mesh, *, lr: float = 1e-3
+                    ) -> Dict[str, Callable]:
+    import functools
+
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tx = optax.adam(lr)
+    data_axes = tuple(a for a in ("dp", "fsdp")
+                      if mesh.shape.get(a, 1) > 1) or None
+    if isinstance(data_axes, tuple) and len(data_axes) == 1:
+        data_axes = data_axes[0]
+    batch_sh = NamedSharding(mesh, P(data_axes))
+    repl = NamedSharding(mesh, P())
+
+    def init(key, example):
+        params = model.init(key, example)["params"]
+        return {"params": params, "opt_state": tx.init(params)}
+
+    def loss_fn(params, images, labels):
+        logits = model.apply({"params": params}, images)
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, acc
+
+    @functools.partial(jax.jit,
+                       in_shardings=(repl, (batch_sh, batch_sh)),
+                       out_shardings=(repl, None),
+                       donate_argnums=(0,))
+    def step(state, batch):
+        images, labels = batch
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], images, labels)
+        updates, opt_state = tx.update(grads, state["opt_state"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state},
+                {"loss": loss, "accuracy": acc})
+
+    return {"init_fn": jax.jit(init, out_shardings=repl),
+            "step_fn": step, "batch_sharding": batch_sh}

@@ -1,0 +1,166 @@
+"""ResNet (v1.5) in flax linen — the reference's Train benchmark model
+(``release/train_tests`` ResNet-50/ImageNet; BASELINE config 3).
+
+TPU-first: NHWC layout (XLA's preferred conv layout on TPU), bf16 compute
+with f32 batch-norm stats, channels sharded over tp via logical axes when
+a mesh is provided.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False,
+                                 dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not
+                                 train, momentum=0.9, epsilon=1e-5,
+                                 dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            self.strides, name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False,
+                                 dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not
+                                 train, momentum=0.9, epsilon=1e-5,
+                                 dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False,
+                                 dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not
+                                 train, momentum=0.9, epsilon=1e-5,
+                                 dtype=jnp.float32)
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i,
+                                   strides=strides,
+                                   dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2],
+                             block_cls=ResNetBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=ResNetBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                              block_cls=BottleneckBlock)
+
+
+def build_resnet_train(model: nn.Module, mesh, *, lr: float = 0.1,
+                       momentum: float = 0.9,
+                       image_size: int = 224) -> Dict[str, Callable]:
+    """Sharded train-step builder: batch over dp/fsdp, params replicated
+    (DP) — swap the rules for channel-sharded tp later."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tx = optax.sgd(lr, momentum=momentum, nesterov=True)
+    data_axes = tuple(a for a in ("dp", "fsdp")
+                      if mesh.shape.get(a, 1) > 1) or None
+    if isinstance(data_axes, tuple) and len(data_axes) == 1:
+        data_axes = data_axes[0]
+    batch_sh = NamedSharding(mesh, P(data_axes))
+    repl = NamedSharding(mesh, P())
+
+    def init(key):
+        variables = model.init(key, jnp.zeros(
+            (1, image_size, image_size, 3), model.dtype), train=False)
+        return {"params": variables["params"],
+                "batch_stats": variables.get("batch_stats", {}),
+                "opt_state": tx.init(variables["params"])}
+
+    init_fn = jax.jit(init, out_shardings=repl)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, (updates["batch_stats"], acc)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(repl, (batch_sh, batch_sh)),
+        out_shardings=(repl, None),
+        donate_argnums=(0,))
+    def step(state, batch):
+        images, labels = batch
+        (loss, (bs, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"],
+                                   state["batch_stats"], images, labels)
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "batch_stats": bs,
+                 "opt_state": opt_state},
+                {"loss": loss, "accuracy": acc})
+
+    return {"init_fn": init_fn, "step_fn": step,
+            "batch_sharding": batch_sh}

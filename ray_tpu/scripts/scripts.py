@@ -1,0 +1,181 @@
+"""CLI (parity: ``python/ray/scripts/scripts.py``): status, list, summary,
+timeline, memory, microbenchmark, dashboard against a live session.
+
+Usage: ``python -m ray_tpu.scripts <command> [...]`` (also installed as
+the ``ray-tpu`` entrypoint).  Commands attach to the newest live session's
+control-plane socket, so they work from any terminal on the node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+
+def _find_session_cp_sock() -> Optional[str]:
+    import getpass
+    root = os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu_{getpass.getuser()}")
+    sessions = sorted(glob.glob(os.path.join(root, "session_*")),
+                      key=os.path.getmtime, reverse=True)
+    for session in sessions:
+        sock = os.path.join(session, "sockets", "cp.sock")
+        if os.path.exists(sock):
+            return sock
+    return None
+
+
+def _connect_cp():
+    from ray_tpu._private.protocol import RpcClient
+    sock = _find_session_cp_sock()
+    if sock is None:
+        print("No live ray_tpu session found on this node.",
+              file=sys.stderr)
+        sys.exit(1)
+    client = RpcClient(sock)
+    try:
+        client.call("ping")
+    except (OSError, ConnectionError):
+        print("Session socket exists but the control plane is not "
+              "responding.", file=sys.stderr)
+        sys.exit(1)
+    return client
+
+
+def cmd_status(args):
+    cp = _connect_cp()
+    nodes = cp.call("list_nodes")
+    print(f"{'NODE':34} {'STATE':8} {'CPU':>10} {'TPU':>8} PENDING")
+    for n in nodes:
+        total = n.get("resources_total", {})
+        avail = n.get("resources_available", {})
+        cpu = f"{avail.get('CPU', 0):.0f}/{total.get('CPU', 0):.0f}"
+        tpu = f"{avail.get('TPU', 0):.0f}/{total.get('TPU', 0):.0f}" \
+            if total.get("TPU") else "-"
+        load = n.get("load", {}).get("num_pending", 0)
+        print(f"{n['node_id'].hex():34} {n['state']:8} {cpu:>10} "
+              f"{tpu:>8} {load}")
+    counters = cp.call("counters")
+    if counters:
+        print("\ncounters:")
+        for k, v in sorted(counters.items())[:20]:
+            print(f"  {k}: {v}")
+
+
+def cmd_list(args):
+    cp = _connect_cp()
+    kind = args.kind
+    if kind == "nodes":
+        rows = [{**n, "node_id": n["node_id"].hex()}
+                for n in cp.call("list_nodes")]
+    elif kind == "actors":
+        rows = []
+        for a in cp.call("list_actors"):
+            rows.append({"actor_id": a["actor_id"].hex(),
+                         "class": a.get("class_name"),
+                         "state": a.get("state"),
+                         "name": a.get("name"),
+                         "pid": a.get("pid")})
+    elif kind == "tasks":
+        events = cp.call("list_task_events", 1000)
+        latest = {}
+        for ev in events:
+            latest[ev["task_id"]] = ev
+        rows = list(latest.values())
+    elif kind == "objects":
+        rows = cp.call("list_objects")[:100]
+    elif kind == "placement-groups":
+        rows = [{**p, "pg_id": p["pg_id"].hex()}
+                for p in cp.call("list_placement_groups")]
+    else:
+        print(f"unknown kind {kind}", file=sys.stderr)
+        sys.exit(1)
+    for row in rows:
+        print(json.dumps(row, default=str))
+
+
+def cmd_summary(args):
+    cp = _connect_cp()
+    events = cp.call("list_task_events", 100000)
+    states = {}
+    for ev in events:
+        states[ev.get("state")] = states.get(ev.get("state"), 0) + 1
+    actors = cp.call("list_actors")
+    astates = {}
+    for a in actors:
+        astates[a.get("state")] = astates.get(a.get("state"), 0) + 1
+    print("task events:", json.dumps(states))
+    print("actors:", json.dumps(astates))
+    print("objects:", json.dumps(cp.call("objects_summary")))
+
+
+def cmd_timeline(args):
+    cp = _connect_cp()
+    from ray_tpu._private.profiling import chrome_tracing_dump
+    events = cp.call("list_task_events", 100000)
+    out = args.output or "timeline.json"
+    chrome_tracing_dump(events, out)
+    print(f"wrote {out} ({len(events)} events); open in "
+          "chrome://tracing or https://ui.perfetto.dev")
+
+
+def cmd_memory(args):
+    cp = _connect_cp()
+    objs = cp.call("list_objects")
+    total = sum(o.get("size", 0) for o in objs)
+    print(f"{len(objs)} objects, {total / 2**20:.1f} MiB")
+    for o in sorted(objs, key=lambda o: -o.get("size", 0))[:20]:
+        print(f"  {o['object_id'][:16]}  {o.get('size', 0):>12}  "
+              f"{o.get('where')}")
+
+
+def cmd_microbenchmark(args):
+    import ray_tpu
+    from ray_tpu._private import ray_perf
+    ray_tpu.init()
+    try:
+        ray_perf.main(duration=args.duration)
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_dashboard(args):
+    import ray_tpu
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu.dashboard.app import Dashboard
+    port = Dashboard(args.port).start()
+    print(f"dashboard at http://127.0.0.1:{port}")
+    import time
+    while True:
+        time.sleep(3600)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("status")
+    p_list = sub.add_parser("list")
+    p_list.add_argument("kind", choices=["nodes", "actors", "tasks",
+                                         "objects", "placement-groups"])
+    sub.add_parser("summary")
+    p_tl = sub.add_parser("timeline")
+    p_tl.add_argument("--output", "-o", default=None)
+    sub.add_parser("memory")
+    p_mb = sub.add_parser("microbenchmark")
+    p_mb.add_argument("--duration", type=float, default=2.0)
+    p_db = sub.add_parser("dashboard")
+    p_db.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args(argv)
+    {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
+     "timeline": cmd_timeline, "memory": cmd_memory,
+     "microbenchmark": cmd_microbenchmark,
+     "dashboard": cmd_dashboard}[args.command](args)
+
+
+if __name__ == "__main__":
+    main()

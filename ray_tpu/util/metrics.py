@@ -1,0 +1,99 @@
+"""User-defined metrics (parity: ``python/ray/util/metrics.py``).
+
+Counter / Gauge / Histogram recorded through the control plane;
+exported in Prometheus text format by the dashboard's ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _cp():
+    from ray_tpu._private.worker import global_worker
+    return global_worker().cp
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> str:
+    return json.dumps(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tag_keys
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]):
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return merged
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        _cp().kv_put(
+            f"metric:counter:{self._name}:{_tag_key(self._merged(tags))}"
+            .encode(),
+            repr(value).encode(), namespace="_metrics_inc")
+        _cp().incr(f"user_counter:{self._name}"
+                   f":{_tag_key(self._merged(tags))}",
+                   int(value) if float(value).is_integer() else 1)
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _cp().kv_put(
+            f"gauge:{self._name}:{_tag_key(self._merged(tags))}".encode(),
+            repr(float(value)).encode(), namespace="_metrics")
+
+
+_DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0]
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = boundaries or _DEFAULT_BOUNDARIES
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        idx = bisect.bisect_left(self.boundaries, value)
+        label = (f"le_{self.boundaries[idx]}"
+                 if idx < len(self.boundaries) else "le_inf")
+        _cp().incr(f"user_histogram:{self._name}:{label}"
+                   f":{_tag_key(self._merged(tags))}")
+        _cp().incr(f"user_histogram:{self._name}:count")
+
+
+def prometheus_text() -> str:
+    """Render counters + gauges in Prometheus exposition format."""
+    cp = _cp()
+    lines = []
+    for name, value in sorted(cp.counters().items()):
+        safe = name.replace(":", "_").replace("{", "").replace("}", "")
+        safe = "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in safe)
+        lines.append(f"# TYPE {safe} counter")
+        lines.append(f"{safe} {value}")
+    for key in cp.kv_keys(b"gauge:", namespace="_metrics"):
+        raw = cp.kv_get(key, namespace="_metrics")
+        parts = key.decode().split(":")
+        safe = "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in parts[1])
+        lines.append(f"# TYPE {safe} gauge")
+        lines.append(f"{safe} {float(raw)}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,101 @@
+"""State API (parity: ``python/ray/util/state``): programmatic listing of
+cluster entities, backed by the control plane tables."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import global_worker
+
+
+def _cp():
+    return global_worker().cp
+
+
+def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+    out = []
+    for info in _cp().list_nodes()[:limit]:
+        out.append({
+            "node_id": info["node_id"].hex(),
+            "state": info["state"],
+            "ip": info.get("ip"),
+            "resources_total": info.get("resources_total", {}),
+            "resources_available": info.get("resources_available", {}),
+            "labels": info.get("labels", {}),
+        })
+    return out
+
+
+def list_actors(limit: int = 1000,
+                filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    out = []
+    for info in _cp().list_actors()[:limit]:
+        row = {
+            "actor_id": info["actor_id"].hex(),
+            "class_name": info.get("class_name"),
+            "state": info.get("state"),
+            "name": info.get("name"),
+            "pid": info.get("pid"),
+            "node_id": (info.get("node_id").hex()
+                        if info.get("node_id") else None),
+            "num_restarts": info.get("num_restarts", 0),
+        }
+        out.append(row)
+    if filters:
+        for key, op, value in filters:
+            assert op == "=", "only equality filters supported"
+            out = [r for r in out if str(r.get(key)) == str(value)]
+    return out
+
+
+def list_tasks(limit: int = 10000) -> List[Dict[str, Any]]:
+    events = _cp().list_task_events(limit=limit)
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        tid = ev.get("task_id")
+        cur = latest.setdefault(tid, {"task_id": tid})
+        cur["state"] = ev.get("state")
+        if ev.get("name"):
+            cur["name"] = ev["name"]
+        if ev.get("node"):
+            cur["node_id"] = ev["node"]
+        cur.setdefault("events", []).append(
+            {"state": ev.get("state"), "time": ev.get("time")})
+    return list(latest.values())[:limit]
+
+
+def list_objects(limit: int = 10000) -> List[Dict[str, Any]]:
+    return _cp().list_objects()[:limit]
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    out = []
+    for info in _cp().list_placement_groups()[:limit]:
+        out.append({
+            "placement_group_id": info["pg_id"].hex(),
+            "name": info.get("name", ""),
+            "state": info.get("state"),
+            "strategy": info.get("strategy"),
+            "bundles": info.get("bundles", []),
+        })
+    return out
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for task in list_tasks():
+        counts[task.get("state", "?")] = counts.get(
+            task.get("state", "?"), 0) + 1
+    return counts
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for actor in list_actors():
+        counts[actor.get("state", "?")] = counts.get(
+            actor.get("state", "?"), 0) + 1
+    return counts
+
+
+def summarize_objects() -> Dict[str, Any]:
+    return _cp().objects_summary()

@@ -1,0 +1,118 @@
+"""Durable workflows (parity: ``python/ray/workflow``): every task's
+result is persisted; ``resume`` replays completed steps from storage and
+re-executes only the rest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode
+
+_storage_root: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    global _storage_root
+    _storage_root = storage or os.path.expanduser("~/ray_tpu_workflows")
+    os.makedirs(_storage_root, exist_ok=True)
+
+
+def _store_dir(workflow_id: str) -> str:
+    if _storage_root is None:
+        init()
+    return os.path.join(_storage_root, workflow_id)
+
+
+def _step_key(node: FunctionNode, resolved_args) -> str:
+    name = getattr(node.remote_fn.func, "__qualname__", "step")
+    blob = cloudpickle.dumps((name, resolved_args))
+    return f"{name.replace('.', '_')}-{hashlib.sha1(blob).hexdigest()[:12]}"
+
+
+def _run_node(node: Any, wf_dir: str, cache: Dict[int, Any]):
+    if not isinstance(node, DAGNode):
+        return node
+    if id(node) in cache:
+        return cache[id(node)]
+    if not isinstance(node, FunctionNode):
+        raise TypeError("workflows support function DAGs")
+    args = [_run_node(a, wf_dir, cache) for a in node.args]
+    kwargs = {k: _run_node(v, wf_dir, cache)
+              for k, v in node.kwargs.items()}
+    key = _step_key(node, (args, kwargs))
+    result_path = os.path.join(wf_dir, f"{key}.pkl")
+    if os.path.exists(result_path):
+        with open(result_path, "rb") as f:
+            value = cloudpickle.load(f)
+    else:
+        value = ray_tpu.get(node.remote_fn.remote(*args, **kwargs),
+                            timeout=600)
+        tmp = result_path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, result_path)  # durable commit
+    cache[id(node)] = value
+    return value
+
+
+def run(dag: FunctionNode, *, workflow_id: str) -> Any:
+    """Execute a DAG durably; completed steps are checkpointed."""
+    wf_dir = _store_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    with open(os.path.join(wf_dir, "status.json"), "w") as f:
+        json.dump({"status": "RUNNING"}, f)
+    try:
+        result = _run_node(dag, wf_dir, {})
+    except BaseException:
+        with open(os.path.join(wf_dir, "status.json"), "w") as f:
+            json.dump({"status": "FAILED"}, f)
+        raise
+    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
+        cloudpickle.dump(result, f)
+    with open(os.path.join(wf_dir, "status.json"), "w") as f:
+        json.dump({"status": "SUCCESSFUL"}, f)
+    return result
+
+
+def resume(workflow_id: str, dag: Optional[FunctionNode] = None) -> Any:
+    """Resume: replay persisted steps, run the rest (dag required unless
+    the workflow finished, in which case the stored output is returned)."""
+    wf_dir = _store_dir(workflow_id)
+    out_path = os.path.join(wf_dir, "output.pkl")
+    if os.path.exists(out_path):
+        with open(out_path, "rb") as f:
+            return cloudpickle.load(f)
+    if dag is None:
+        raise ValueError(
+            f"workflow {workflow_id!r} is incomplete; pass its dag to "
+            "resume execution")
+    return run(dag, workflow_id=workflow_id)
+
+
+def get_status(workflow_id: str) -> str:
+    path = os.path.join(_store_dir(workflow_id), "status.json")
+    if not os.path.exists(path):
+        return "NOT_FOUND"
+    with open(path) as f:
+        return json.load(f)["status"]
+
+
+def list_all() -> Dict[str, str]:
+    if _storage_root is None:
+        init()
+    out = {}
+    for wf in os.listdir(_storage_root):
+        out[wf] = get_status(wf)
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    shutil.rmtree(_store_dir(workflow_id), ignore_errors=True)

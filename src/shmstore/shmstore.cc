@@ -1,0 +1,422 @@
+// shmstore — arena-based shared-memory object store (plasma-equivalent).
+//
+// TPU-native counterpart of the reference's plasma store
+// (src/ray/object_manager/plasma/{store.cc,plasma_allocator.cc,dlmalloc.cc}):
+// immutable sealed objects in one mmap'd arena shared by every process on
+// the node.  Differences by design: no store daemon and no UDS protocol —
+// the arena lives in tmpfs, a process-shared mutex guards the header, and
+// clients attach directly.  The daemonless design removes a context switch
+// from every create/get; crash-safety comes from the sealed-bit protocol
+// (readers only ever see fully written objects).
+//
+// Layout:
+//   [Header | buckets | entries | data heap ...]
+//   - fixed open-addressing hash index (id -> entry)
+//   - first-fit free list allocator with coalescing on free
+//
+// C ABI for the Python ctypes binding (ray_tpu/_private/shmstore.py).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254505553484d31ULL;  // "RTPUSHM1"
+constexpr uint32_t kIdSize = 20;
+constexpr uint32_t kEntryFree = 0;
+constexpr uint32_t kEntryWriting = 1;
+constexpr uint32_t kEntrySealed = 2;
+constexpr uint32_t kEntryTomb = 3;  // deleted; slot reusable
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  uint64_t offset;   // from arena base
+  uint64_t size;
+  int64_t refcount;  // process-agnostic pin count (advisory)
+  uint64_t access_clock;  // LRU clock value at last touch
+};
+
+struct FreeNode {
+  uint64_t offset;
+  uint64_t size;
+  int64_t next;  // index into free node pool, -1 = end
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;       // total file size
+  uint64_t data_offset;    // start of heap
+  uint64_t data_size;
+  uint32_t num_buckets;
+  uint32_t max_entries;
+  pthread_mutex_t mutex;
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t clock;          // LRU clock
+  uint64_t num_puts;
+  uint64_t num_gets;
+  uint64_t num_evictions;
+  int64_t free_head;       // free-list head (index into node pool)
+  int64_t node_free_head;  // free node-pool slots
+  // followed by: uint32_t buckets[num_buckets];
+  //              Entry entries[max_entries];
+  //              FreeNode nodes[max_entries + 8];
+};
+
+struct Store {
+  int fd;
+  uint8_t* base;
+  uint64_t mapped_size;
+  Header* hdr;
+  uint32_t* buckets;
+  Entry* entries;
+  FreeNode* nodes;
+};
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 20-byte id
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+class MutexGuard {
+ public:
+  explicit MutexGuard(pthread_mutex_t* m) : m_(m) {
+    int rc = pthread_mutex_lock(m_);
+    if (rc == EOWNERDEAD) {
+      // previous owner died mid-critical-section; the header may be
+      // mid-update but all mutations are order-safe enough to continue
+      // (worst case: a leaked allocation). Mark consistent so the mutex
+      // stays usable for every other process.
+      pthread_mutex_consistent(m_);
+    }
+  }
+  ~MutexGuard() { pthread_mutex_unlock(m_); }
+
+ private:
+  pthread_mutex_t* m_;
+};
+
+uint64_t align8(uint64_t n) { return (n + 63) & ~uint64_t(63); }
+
+void free_list_insert(Store* s, uint64_t offset, uint64_t size) {
+  // pop a node slot
+  int64_t slot = s->hdr->node_free_head;
+  if (slot < 0) return;  // node pool exhausted: leak (bounded)
+  s->hdr->node_free_head = s->nodes[slot].next;
+  s->nodes[slot].offset = offset;
+  s->nodes[slot].size = size;
+  // insert sorted by offset for coalescing
+  int64_t* link = &s->hdr->free_head;
+  while (*link >= 0 && s->nodes[*link].offset < offset) {
+    link = &s->nodes[*link].next;
+  }
+  s->nodes[slot].next = *link;
+  *link = slot;
+  // coalesce with next
+  int64_t next = s->nodes[slot].next;
+  if (next >= 0 &&
+      s->nodes[slot].offset + s->nodes[slot].size == s->nodes[next].offset) {
+    s->nodes[slot].size += s->nodes[next].size;
+    s->nodes[slot].next = s->nodes[next].next;
+    s->nodes[next].next = s->hdr->node_free_head;
+    s->hdr->node_free_head = next;
+  }
+  // coalesce with prev: walk again (cheap relative to object sizes)
+  link = &s->hdr->free_head;
+  while (*link >= 0) {
+    int64_t cur = *link;
+    int64_t nxt = s->nodes[cur].next;
+    if (nxt >= 0 &&
+        s->nodes[cur].offset + s->nodes[cur].size == s->nodes[nxt].offset) {
+      s->nodes[cur].size += s->nodes[nxt].size;
+      s->nodes[cur].next = s->nodes[nxt].next;
+      s->nodes[nxt].next = s->hdr->node_free_head;
+      s->hdr->node_free_head = nxt;
+      continue;
+    }
+    link = &s->nodes[cur].next;
+  }
+}
+
+int64_t free_list_alloc(Store* s, uint64_t size) {
+  int64_t* link = &s->hdr->free_head;
+  while (*link >= 0) {
+    int64_t cur = *link;
+    if (s->nodes[cur].size >= size) {
+      uint64_t offset = s->nodes[cur].offset;
+      s->nodes[cur].offset += size;
+      s->nodes[cur].size -= size;
+      if (s->nodes[cur].size == 0) {
+        *link = s->nodes[cur].next;
+        s->nodes[cur].next = s->hdr->node_free_head;
+        s->hdr->node_free_head = cur;
+      }
+      return (int64_t)offset;
+    }
+    link = &s->nodes[cur].next;
+  }
+  return -1;
+}
+
+Entry* find_entry(Store* s, const uint8_t* id, bool for_insert) {
+  uint32_t nb = s->hdr->num_buckets;
+  uint64_t h = hash_id(id);
+  Entry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < nb; probe++) {
+    uint32_t bucket = (uint32_t)((h + probe) % nb);
+    uint32_t idx = s->buckets[bucket];
+    if (idx == UINT32_MAX) {
+      if (!for_insert) return nullptr;
+      if (first_tomb) return first_tomb;
+      // claim a fresh entry slot = bucket index maps to entry directly
+      Entry* e = &s->entries[bucket];
+      if (e->state == kEntryFree) {
+        s->buckets[bucket] = bucket;
+        return e;
+      }
+      return nullptr;
+    }
+    Entry* e = &s->entries[idx];
+    if (e->state == kEntryTomb) {
+      if (for_insert && !first_tomb) first_tomb = e;
+      continue;
+    }
+    if (memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+bool evict_lru(Store* s, uint64_t need) {
+  // evict unsealed-refcount-0 sealed objects in LRU order until `need`
+  // bytes are free-able. Returns true if anything was evicted.
+  bool any = false;
+  while (true) {
+    Entry* victim = nullptr;
+    for (uint32_t i = 0; i < s->hdr->max_entries; i++) {
+      Entry* e = &s->entries[i];
+      if (e->state == kEntrySealed && e->refcount <= 0) {
+        if (!victim || e->access_clock < victim->access_clock) victim = e;
+      }
+    }
+    if (!victim) return any;
+    free_list_insert(s, victim->offset, align8(victim->size));
+    s->hdr->used_bytes -= align8(victim->size);
+    s->hdr->num_objects--;
+    s->hdr->num_evictions++;
+    victim->state = kEntryTomb;
+    any = true;
+    // check if a hole of `need` exists now
+    for (int64_t n = s->hdr->free_head; n >= 0; n = s->nodes[n].next) {
+      if (s->nodes[n].size >= need) return true;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new arena at `path` with `capacity` bytes. Returns handle or 0.
+void* shmstore_create(const char* path, uint64_t capacity,
+                      uint32_t max_entries) {
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  uint32_t num_buckets = max_entries;  // 1:1 open addressing
+  uint64_t meta = sizeof(Header) + num_buckets * sizeof(uint32_t) +
+                  max_entries * sizeof(Entry) +
+                  (max_entries + 8) * sizeof(FreeNode);
+  meta = align8(meta);
+  uint64_t total = meta + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  Header* hdr = (Header*)base;
+  hdr->capacity = total;
+  hdr->data_offset = meta;
+  hdr->data_size = capacity;
+  hdr->num_buckets = num_buckets;
+  hdr->max_entries = max_entries;
+  hdr->used_bytes = 0;
+  hdr->num_objects = 0;
+  hdr->clock = 0;
+  hdr->free_head = -1;
+  hdr->node_free_head = -1;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &attr);
+
+  Store* s = new Store{fd, base, total, hdr, nullptr, nullptr, nullptr};
+  s->buckets = (uint32_t*)(base + sizeof(Header));
+  s->entries = (Entry*)((uint8_t*)s->buckets + num_buckets * sizeof(uint32_t));
+  s->nodes = (FreeNode*)((uint8_t*)s->entries + max_entries * sizeof(Entry));
+  memset(s->buckets, 0xff, num_buckets * sizeof(uint32_t));
+  memset(s->entries, 0, max_entries * sizeof(Entry));
+  // node pool free list
+  for (uint32_t i = 0; i < max_entries + 8; i++) {
+    s->nodes[i].next = (i + 1 < max_entries + 8) ? (int64_t)(i + 1) : -1;
+  }
+  hdr->node_free_head = 0;
+  free_list_insert(s, meta, capacity);
+  hdr->magic = kMagic;  // publish last
+  return s;
+}
+
+void* shmstore_attach(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  uint8_t* base = (uint8_t*)mmap(nullptr, (size_t)st.st_size,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* hdr = (Header*)base;
+  if (hdr->magic != kMagic) {
+    munmap(base, (size_t)st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store{fd, base, (uint64_t)st.st_size, hdr,
+                       nullptr, nullptr, nullptr};
+  s->buckets = (uint32_t*)(base + sizeof(Header));
+  s->entries =
+      (Entry*)((uint8_t*)s->buckets + hdr->num_buckets * sizeof(uint32_t));
+  s->nodes =
+      (FreeNode*)((uint8_t*)s->entries + hdr->max_entries * sizeof(Entry));
+  return s;
+}
+
+// Reserve space for an object; returns writable offset or -1 (full/-2 exists).
+int64_t shmstore_create_object(void* handle, const uint8_t* id,
+                               uint64_t size) {
+  Store* s = (Store*)handle;
+  uint64_t need = align8(size);
+  MutexGuard g(&s->hdr->mutex);
+  Entry* existing = find_entry(s, id, false);
+  if (existing && existing->state != kEntryTomb) return -2;
+  int64_t off = free_list_alloc(s, need);
+  if (off < 0) {
+    if (!evict_lru(s, need)) return -1;
+    off = free_list_alloc(s, need);
+    if (off < 0) return -1;
+  }
+  Entry* e = find_entry(s, id, true);
+  if (!e) {
+    free_list_insert(s, (uint64_t)off, need);
+    return -1;  // index full
+  }
+  memcpy(e->id, id, kIdSize);
+  e->state = kEntryWriting;
+  e->offset = (uint64_t)off;
+  e->size = size;
+  e->refcount = 1;  // creator holds a pin until seal
+  e->access_clock = ++s->hdr->clock;
+  s->hdr->used_bytes += need;
+  s->hdr->num_objects++;
+  s->hdr->num_puts++;
+  return off;
+}
+
+int shmstore_seal(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kEntryWriting) return -1;
+  e->state = kEntrySealed;
+  e->refcount = 0;
+  return 0;
+}
+
+// Returns offset of sealed object (and size via out param), or -1.
+int64_t shmstore_get(void* handle, const uint8_t* id, uint64_t* size_out,
+                     int pin) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kEntrySealed) return -1;
+  *size_out = e->size;
+  e->access_clock = ++s->hdr->clock;
+  s->hdr->num_gets++;
+  if (pin) e->refcount++;
+  return (int64_t)e->offset;
+}
+
+int shmstore_release(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  if (!e) return -1;
+  if (e->refcount > 0) e->refcount--;
+  return 0;
+}
+
+int shmstore_delete(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state == kEntryTomb || e->state == kEntryFree) return -1;
+  free_list_insert(s, e->offset, align8(e->size));
+  s->hdr->used_bytes -= align8(e->size);
+  s->hdr->num_objects--;
+  e->state = kEntryTomb;
+  return 0;
+}
+
+int shmstore_contains(void* handle, const uint8_t* id) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  return (e && e->state == kEntrySealed) ? 1 : 0;
+}
+
+void shmstore_stats(void* handle, uint64_t* out6) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  out6[0] = s->hdr->used_bytes;
+  out6[1] = s->hdr->data_size;
+  out6[2] = s->hdr->num_objects;
+  out6[3] = s->hdr->num_puts;
+  out6[4] = s->hdr->num_gets;
+  out6[5] = s->hdr->num_evictions;
+}
+
+uint8_t* shmstore_base(void* handle) { return ((Store*)handle)->base; }
+
+void shmstore_detach(void* handle) {
+  Store* s = (Store*)handle;
+  munmap(s->base, s->mapped_size);
+  close(s->fd);
+  delete s;
+}
+
+}  // extern "C"
