@@ -209,9 +209,9 @@ def _moe_ffn(lp, x, cfg: GPTConfig):
     return out.reshape(B, S, d), aux
 
 
-def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
-            attn_fn: Optional[Callable] = None, mesh=None):
-    """tokens [B, S] int32 -> logits [B, S, V] (f32).
+def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
+                   attn_fn: Optional[Callable] = None, mesh=None):
+    """tokens [B, S] int32 -> (final hidden [B, S, d], moe aux loss).
 
     ``attn_fn(q, k, v) -> out`` defaults to causal local attention; pass a
     ring-attention fn (``make_ring_attention_fn``) for sp>1 meshes.
@@ -255,23 +255,73 @@ def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
                         params["layers"])
     x = _norm(x, params["ln_f"], cfg.norm)
-    head = (params["embed"].T if cfg.tie_embeddings
+    return x, jnp.sum(auxes)
+
+
+def lm_head(params, cfg: GPTConfig):
+    return (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"]).astype(cfg.dtype)
-    logits = jnp.einsum("bsd,dv->bsv", x, head)
+
+
+def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
+            attn_fn: Optional[Callable] = None, mesh=None):
+    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    constrain = functools.partial(shd.constrain, mesh=mesh)
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn=attn_fn,
+                            mesh=mesh)
+    logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params, cfg))
     logits = constrain(logits, ("batch", "seq", "vocab"))
-    return logits.astype(jnp.float32), jnp.sum(auxes)
+    return logits.astype(jnp.float32), aux
+
+
+# Cross-entropy over a 50k vocab dominates activation memory if the
+# [B, S, V] logits (and log-softmax residuals) are materialized and saved.
+# Chunk tokens and rematerialize: backward recomputes each chunk's logits
+# from (x, head) — one extra matmul per chunk for O(chunk * V) transient
+# memory instead of O(B * S * V) resident.
+_CE_CHUNK = 4096
+
+
+def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
+    """x [N, d] (bf16 ok), head [d, V], targets [N] -> (sum_nll, n_valid)."""
+    N, d = x.shape
+
+    @jax.checkpoint
+    def chunk_loss(xc, tc):
+        logits = jnp.einsum("nd,dv->nv", xc, head,
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        true = jnp.take_along_axis(
+            logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]
+        mask = (tc >= 0).astype(jnp.float32)
+        return jnp.sum((lse - true) * mask), jnp.sum(mask)
+
+    if N <= chunk:
+        return chunk_loss(x, targets)
+    full = (N // chunk) * chunk
+    xs = x[:full].reshape(N // chunk, chunk, d)
+    ts = targets[:full].reshape(N // chunk, chunk)
+
+    def body(carry, xt):
+        s, n = chunk_loss(*xt)
+        return (carry[0] + s, carry[1] + n), None
+
+    (s, n), _ = lax.scan(body, (jnp.float32(0), jnp.float32(0)), (xs, ts))
+    if full < N:
+        rs, rn = chunk_loss(x[full:], targets[full:])
+        s, n = s + rs, n + rn
+    return s, n
 
 
 def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
             aux_weight: float = 0.01):
     """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss."""
-    logits, aux = forward(params, batch["tokens"], cfg, attn_fn=attn_fn,
-                          mesh=mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    tgt = batch["targets"]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    mask = (tgt >= 0).astype(jnp.float32)
-    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    x, aux = forward_hidden(params, batch["tokens"], cfg, attn_fn=attn_fn,
+                            mesh=mesh)
+    B, S, d = x.shape
+    s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
+                       batch["targets"].reshape(B * S))
+    loss = s / jnp.maximum(n, 1.0)
     return loss + aux_weight * aux
 
 

@@ -41,12 +41,8 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
 
 
 def _batch_sharding(mesh):
-    data_axes = tuple(a for a in ("dp", "fsdp")
-                      if mesh.shape.get(a, 1) > 1) or None
     seq_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
-    if isinstance(data_axes, tuple) and len(data_axes) == 1:
-        data_axes = data_axes[0]
-    return NamedSharding(mesh, P(data_axes, seq_axis))
+    return NamedSharding(mesh, P(shd.data_axes(mesh), seq_axis))
 
 
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
@@ -56,11 +52,14 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
     (state, metrics); batch = dict(tokens, targets) [B, S] int32.
     """
+    from ray_tpu.ops.attention import make_flash_attention_fn
+
     tx = optimizer or default_optimizer()
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
     attn_fn = (make_ring_attention_fn(mesh, causal=True)
-               if mesh.shape.get("sp", 1) > 1 else None)
+               if mesh.shape.get("sp", 1) > 1
+               else make_flash_attention_fn(mesh, causal=True))
     batch_sh = _batch_sharding(mesh)
 
     def loss(params, batch):

@@ -1,0 +1,365 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+The compute heart of the flagship model path.  The reference delegates
+fused attention to torch/CUDA inside the user's train fn; here it is a
+first-class TPU kernel: blockwise online-softmax attention that never
+materializes the [S, S] score matrix in HBM.  Backward recomputes scores
+per block from the saved (o, logsumexp) residuals — activation memory is
+O(B*S*H*D) instead of O(B*H*S^2).
+
+Layouts: public API takes ``[B, S, H, D]`` (model layout, matches
+``ray_tpu.parallel.ring_attention``); kernels run over ``[B, H, S, D]``.
+
+Numerics: scores/stats in f32 regardless of input dtype; probability
+blocks are cast back to the value dtype for the MXU matmuls.  A
+numerics test vs the einsum path lives in ``tests/test_ops.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+STATS_LANES = 8   # lse/delta stored [B, H, num_q, bq, 8] for tiling
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _masked_scores(q, k, i, j, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    """f32 scaled q@k^T for blocks (i, j) with the causal mask applied."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [bq, bk]
+    if causal:
+        q_idx = (i * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+        k_idx = (j * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+        s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+    return s
+
+
+def _block_live(i, j, *, causal: bool, block_q: int, block_k: int):
+    """Whether kv block j contributes anything to q block i."""
+    return (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale: float, causal: bool,
+                block_q: int, block_k: int, num_kv: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k))
+    def _compute():
+        q = q_ref[0, 0]                      # [bq, D]
+        k = k_ref[0, 0]                      # [bk, D]
+        v = v_ref[0, 0]
+        s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        m_prev = m_sc[:]                      # [bq, 128] (col-bcast)
+        m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])                      # [bq, bk]
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, 1, keepdims=True)
+        acc_sc[:] = (acc_sc[:] * alpha[:, :1]
+                     + jax.lax.dot_general(
+                         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32))
+        m_sc[:] = m_new
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        o_ref[0, 0] = (acc_sc[:]
+                       / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = m_sc[:, :1] + jnp.log(jnp.maximum(l, 1e-30))   # [bq, 1]
+        lse_ref[0, 0, 0] = jnp.broadcast_to(lse, lse_ref.shape[3:])
+
+
+def _fwd(q, k, v, *, scale: float, causal: bool,
+         block_q: int, block_k: int):
+    """q,k,v: [B, H, S, D] -> (o [B, H, S, D],
+    lse [B, H, S // bq, bq, STATS_LANES] f32 — lane-padded row stats)."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = min(block_q, S), min(block_k, Sk)
+    grid = (B, H, S // bq, Sk // bk)
+    num_kv = grid[3]
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        num_kv=num_kv)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            # row stats as [B, H, num_q, bq, STATS_LANES]: a
+            # (.., bq, STATS_LANES) block satisfies the TPU tiling rule
+            # ((bq, 8): sublane div 8, lane equal to array dim) where a
+            # 1-D (.., bq) row cannot
+            pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                         lambda b, h, i, j: (b, h, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S // bq, bq, STATS_LANES),
+                                 jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, num_kv: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0][:, 0:1]                   # [bq, 1]
+        delta = delta_ref[0, 0, 0][:, 0:1]               # [bq, 1]
+        s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse)                             # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale: float,
+                    causal: bool, block_q: int, block_k: int,
+                    num_q: int):
+    j, i = pl.program_id(2), pl.program_id(3)   # kv outer, q inner
+
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0][:, 0:1]
+        delta = delta_ref[0, 0, 0][:, 0:1]
+        s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse)                             # [bq, bk]
+        dv_sc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta) * scale                    # [bq, bk]
+        dk_sc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
+         block_q: int, block_k: int):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = min(block_q, S), min(block_k, Sk)
+    num_q, num_kv = S // bq, Sk // bk
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1).reshape(B, H, num_q, bq, 1),
+        (B, H, num_q, bq, STATS_LANES))
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    r_spec = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                          lambda b, h, i, j: (b, h, i, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, num_kv=num_kv),
+        grid=(B, H, num_q, num_kv),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # kv-outer grid: index maps see (b, h, j, i)
+    q_spec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    k_spec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    r_spec2 = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                           lambda b, h, j, i: (b, h, i, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, num_q=num_q),
+        grid=(B, H, num_kv, num_q),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k)
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def supports(S: int, Sk: int, D: int, *, block_q: int = 512,
+             block_k: int = 1024) -> bool:
+    """Shapes the kernel grid can tile (fallback to einsum otherwise)."""
+    bq, bk = min(block_q, S), min(block_k, Sk)
+    return (S % bq == 0 and Sk % bk == 0 and D <= 256
+            and bq % 8 == 0 and bk % 128 == 0)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 1024):
+    """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
+
+    Drop-in for ``ray_tpu.parallel.ring_attention.local_attention``;
+    falls back to the einsum path for shapes the grid cannot tile.
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    if not supports(S, Sk, D, block_q=block_q, block_k=block_k):
+        from ray_tpu.parallel.ring_attention import local_attention
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def make_flash_attention_fn(mesh=None, *, causal: bool = True,
+                            block_q: int = 512, block_k: int = 1024):
+    """Mesh-aware flash attention (drop-in for ``make_ring_attention_fn``).
+
+    A ``pallas_call`` has no SPMD partitioning rule, so on a >1-device
+    mesh the kernel runs under ``shard_map``: batch over (dp, fsdp),
+    heads over tp — each device runs the kernel on its local shard.
+    Sequence stays unsharded (sp>1 uses ring attention instead).
+    """
+    fn = functools.partial(flash_attention, causal=causal,
+                           block_q=block_q, block_k=block_k)
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.compat import shard_map
+    from ray_tpu.parallel.sharding import data_axes
+
+    tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    spec = P(data_axes(mesh), None, tp, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec)
+    def sharded(q, k, v):
+        return fn(q, k, v)
+
+    return sharded

@@ -111,3 +111,14 @@ def shard_params(params, mesh, logical_tree, rules: Optional[Rules] = None):
     """Device_put a param pytree according to its logical axes."""
     shardings = tree_shardings(mesh, logical_tree, rules)
     return jax.device_put(params, shardings)
+
+
+def data_axes(mesh):
+    """The mesh axes a batch dimension shards over: (dp, fsdp) present in
+    the mesh with size > 1, collapsed to a single name when alone, or
+    ``None``.  Shared by batch shardings and shard_map in_specs so the
+    two conventions cannot diverge."""
+    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if not axes:
+        return None
+    return axes[0] if len(axes) == 1 else axes
