@@ -10,12 +10,18 @@ Layout
 ------
 JobID      4  bytes   random per driver
 ActorID   12  bytes   = job_id(4) + random(8)
-TaskID    16  bytes   = actor_id(12) + random(4)  for actor tasks,
-                        job_id(4) + random(12)     for normal tasks
-ObjectID  20  bytes   = task_id(16) + big-endian return index(4)
+TaskID    24  bytes   = actor_id(12) + random(12)  for actor tasks,
+                        job_id(4) + random(20)      for normal tasks
+ObjectID  28  bytes   = task_id(24) + big-endian return index(4)
 NodeID    16  bytes   random
 WorkerID  16  bytes   random
 PlacementGroupID 12 bytes = job_id(4) + random(8)
+
+The 12-byte random portion of actor TaskIDs keeps collision probability
+negligible over an actor's lifetime (the reference uses comparably wide
+random task components; 4 bytes would collide at ~1% per 10k calls).
+The native arena store (src/shmstore) must agree on ObjectID width —
+``kIdSize`` there equals ``_OBJECT_ID_SIZE``.
 """
 
 from __future__ import annotations
@@ -25,8 +31,8 @@ import threading
 
 _JOB_ID_SIZE = 4
 _ACTOR_ID_SIZE = 12
-_TASK_ID_SIZE = 16
-_OBJECT_ID_SIZE = 20
+_TASK_ID_SIZE = 24
+_OBJECT_ID_SIZE = 28
 _UNIQUE_ID_SIZE = 16
 _PG_ID_SIZE = 12
 

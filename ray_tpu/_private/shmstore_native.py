@@ -81,6 +81,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.shmstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.POINTER(ctypes.c_uint64),
                                      ctypes.c_int]
+        lib.shmstore_get_copy.restype = ctypes.c_int64
+        lib.shmstore_get_copy.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmstore_evict.restype = ctypes.c_int
+        lib.shmstore_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shmstore_release.restype = ctypes.c_int
         lib.shmstore_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shmstore_delete.restype = ctypes.c_int
@@ -110,10 +115,18 @@ class NativeArena:
             self.handle = lib.shmstore_create(path.encode(), capacity,
                                               max_entries)
             if not self.handle:
-                # lost a create race: attach instead
+                # lost a create race: attach instead (the C side retries
+                # until the winner's release-store publishes the magic)
                 self.handle = lib.shmstore_attach(path.encode())
         else:
-            self.handle = lib.shmstore_attach(path.encode())
+            # the creator may not have created the file yet; retry briefly
+            import time as _time
+            self.handle = None
+            for _ in range(100):
+                self.handle = lib.shmstore_attach(path.encode())
+                if self.handle:
+                    break
+                _time.sleep(0.01)
         if not self.handle:
             raise RuntimeError(f"cannot open arena at {path}")
         base = lib.shmstore_base(self.handle)
@@ -136,13 +149,28 @@ class NativeArena:
             slice(None), data), len(data))
 
     def get(self, object_id: bytes) -> Optional[memoryview]:
-        size = ctypes.c_uint64()
-        off = self.lib.shmstore_get(self.handle, object_id,
-                                    ctypes.byref(size), 0)
-        if off < 0:
-            return None
-        # sealed objects are immutable: readers get a read-only view
-        return self.mem[off:off + size.value].toreadonly()
+        """Copy the object out under the store mutex.
+
+        Deliberately NOT zero-copy: a borrowed view into the arena can
+        outlive the entry (delete + reallocate corrupts it from under the
+        reader — round-1 advisory).  Arena objects are small (see
+        ``ShmStore.ARENA_MAX_OBJECT``), so the locked memcpy is cheap;
+        large objects take the file-mmap path, which IS zero-copy and
+        unlink-safe.
+        """
+        while True:
+            size = self.lib.shmstore_get_copy(self.handle, object_id,
+                                              None, 0)
+            if size < 0:
+                return None
+            buf = ctypes.create_string_buffer(size)
+            rc = self.lib.shmstore_get_copy(self.handle, object_id, buf,
+                                            size)
+            if rc == -2:
+                continue  # recreated bigger between the two calls; retry
+            if rc < 0:
+                return None
+            return memoryview(buf)[:rc].toreadonly()
 
     def contains(self, object_id: bytes) -> bool:
         return bool(self.lib.shmstore_contains(self.handle, object_id))

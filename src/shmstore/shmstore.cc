@@ -30,7 +30,8 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x5254505553484d31ULL;  // "RTPUSHM1"
-constexpr uint32_t kIdSize = 20;
+// Must match ray_tpu/_private/ids.py _OBJECT_ID_SIZE.
+constexpr uint32_t kIdSize = 28;
 constexpr uint32_t kEntryFree = 0;
 constexpr uint32_t kEntryWriting = 1;
 constexpr uint32_t kEntrySealed = 2;
@@ -83,7 +84,7 @@ struct Store {
 };
 
 uint64_t hash_id(const uint8_t* id) {
-  // FNV-1a over the 20-byte id
+  // FNV-1a over the id bytes
   uint64_t h = 1469598103934665603ULL;
   for (uint32_t i = 0; i < kIdSize; i++) {
     h ^= id[i];
@@ -282,7 +283,9 @@ void* shmstore_create(const char* path, uint64_t capacity,
   }
   hdr->node_free_head = 0;
   free_list_insert(s, meta, capacity);
-  hdr->magic = kMagic;  // publish last
+  // Publish last with release ordering: attachers that observe the magic
+  // must also observe every initialized field above.
+  __atomic_store_n(&hdr->magic, kMagic, __ATOMIC_RELEASE);
   return s;
 }
 
@@ -301,7 +304,17 @@ void* shmstore_attach(const char* path) {
     return nullptr;
   }
   Header* hdr = (Header*)base;
-  if (hdr->magic != kMagic) {
+  // Acquire-load pairs with the creator's release-store; retry briefly so
+  // an attacher racing the creator's init does not permanently fall back.
+  bool ok = false;
+  for (int i = 0; i < 200; i++) {  // ~1s total
+    if (__atomic_load_n(&hdr->magic, __ATOMIC_ACQUIRE) == kMagic) {
+      ok = true;
+      break;
+    }
+    usleep(5000);
+  }
+  if (!ok) {
     munmap(base, (size_t)st.st_size);
     close(fd);
     return nullptr;
@@ -317,6 +330,10 @@ void* shmstore_attach(const char* path) {
 }
 
 // Reserve space for an object; returns writable offset or -1 (full/-2 exists).
+// The arena never auto-evicts: its objects are primary copies tracked by
+// the control plane, so a full arena fails the create and the caller falls
+// back to the file store (which spills instead of dropping).  Explicit
+// eviction for secondary/cache use lives in shmstore_evict below.
 int64_t shmstore_create_object(void* handle, const uint8_t* id,
                                uint64_t size) {
   Store* s = (Store*)handle;
@@ -325,11 +342,7 @@ int64_t shmstore_create_object(void* handle, const uint8_t* id,
   Entry* existing = find_entry(s, id, false);
   if (existing && existing->state != kEntryTomb) return -2;
   int64_t off = free_list_alloc(s, need);
-  if (off < 0) {
-    if (!evict_lru(s, need)) return -1;
-    off = free_list_alloc(s, need);
-    if (off < 0) return -1;
-  }
+  if (off < 0) return -1;
   Entry* e = find_entry(s, id, true);
   if (!e) {
     free_list_insert(s, (uint64_t)off, need);
@@ -369,6 +382,35 @@ int64_t shmstore_get(void* handle, const uint8_t* id, uint64_t* size_out,
   s->hdr->num_gets++;
   if (pin) e->refcount++;
   return (int64_t)e->offset;
+}
+
+// Copy a sealed object out under the store mutex.  This is the safe read
+// path: the mutex serializes the copy against delete/reallocate, so the
+// caller never holds a view into memory the allocator can recycle (the
+// round-1 advisory flagged exactly that use-after-free).  Call with
+// dst == nullptr to query the size.  Returns the object size, or -1 if
+// absent, or -2 if dst_cap is too small.
+int64_t shmstore_get_copy(void* handle, const uint8_t* id, uint8_t* dst,
+                          uint64_t dst_cap) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kEntrySealed) return -1;
+  if (dst == nullptr) return (int64_t)e->size;
+  if (dst_cap < e->size) return -2;
+  memcpy(dst, s->base + e->offset, e->size);
+  e->access_clock = ++s->hdr->clock;
+  s->hdr->num_gets++;
+  return (int64_t)e->size;
+}
+
+// Explicitly evict LRU refcount-0 sealed objects until `need` contiguous
+// bytes are available.  Not called on the primary-copy path (see
+// shmstore_create_object); exists for secondary-copy caches.
+int shmstore_evict(void* handle, uint64_t need) {
+  Store* s = (Store*)handle;
+  MutexGuard g(&s->hdr->mutex);
+  return evict_lru(s, need) ? 0 : -1;
 }
 
 int shmstore_release(void* handle, const uint8_t* id) {

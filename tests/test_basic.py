@@ -164,4 +164,5 @@ def test_runtime_context(ray_start_regular):
         return ray_tpu.get_runtime_context().get_task_id()
 
     tid = ray.get(whoami.remote())
-    assert tid is not None and len(tid) == 32
+    from ray_tpu._private.ids import TaskID
+    assert tid is not None and len(tid) == 2 * TaskID.SIZE

@@ -1,5 +1,7 @@
 """Unit tests for IDs, config, serialization, shm store (no cluster)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -89,3 +91,66 @@ def test_shm_store_eviction_spill(tmp_path):
     # earliest objects were spilled; they must still be readable
     out = store.get_object(ids[0])
     np.testing.assert_array_equal(out, np.full(40_000, 0, dtype=np.float64))
+
+
+def test_native_arena_semantics(tmp_path):
+    """Arena reads are safe copies; a full arena refuses (no silent evict);
+    the entry id width matches ObjectID."""
+    pytest.importorskip("ctypes")
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.shmstore_native import NativeArena
+    try:
+        arena = NativeArena(str(tmp_path / "arena"), capacity=1 << 20,
+                            max_entries=64, create=True)
+    except RuntimeError:
+        pytest.skip("native toolchain unavailable")
+
+    oid = ObjectID.from_random().binary()
+    assert arena.put_bytes(oid, b"x" * 1000)
+    view = arena.get(oid)
+    assert bytes(view) == b"x" * 1000
+    # the returned buffer is a private copy: deleting + overwriting the
+    # slot must not corrupt it
+    assert arena.delete(oid)
+    oid2 = ObjectID.from_random().binary()
+    assert arena.put_bytes(oid2, b"y" * 1000)
+    assert bytes(view) == b"x" * 1000
+
+    # primary copies are never silently evicted: an over-capacity put
+    # fails (python file store is the fallback) instead of dropping
+    # sealed objects
+    big = ObjectID.from_random().binary()
+    assert arena.put_bytes(big, b"z" * (900 << 10))
+    big2 = ObjectID.from_random().binary()
+    assert not arena.put_bytes(big2, b"w" * (900 << 10))
+    assert arena.contains(big)
+    arena.detach()
+
+
+def test_arena_attach_waits_for_creator(tmp_path):
+    """An attacher that races the creator retries instead of permanently
+    falling back (round-1 advisory: unfenced magic publish)."""
+    from ray_tpu._private.shmstore_native import NativeArena
+    import threading
+    path = str(tmp_path / "arena2")
+    errs = []
+
+    def attach():
+        try:
+            a = NativeArena(path, create=False)
+            a.detach()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=attach)
+    t.start()
+    time.sleep(0.05)
+    try:
+        creator = NativeArena(path, capacity=1 << 20, max_entries=64,
+                              create=True)
+    except RuntimeError:
+        t.join()
+        pytest.skip("native toolchain unavailable")
+    t.join(timeout=5)
+    assert not t.is_alive() and not errs
+    creator.detach()
