@@ -88,6 +88,13 @@ _d("object_spill_dir", "",
    "Directory for spilling evicted primary objects. '' = <session>/spill.")
 _d("object_store_mmap_threshold_bytes", 1024 * 1024,
    "Reads at or above this size return zero-copy views into shm.")
+_d("object_transfer_chunk_bytes", 5 * 1024 * 1024,
+   "Chunk size for node-to-node object pulls (reference: 5MiB chunks, "
+   "common/ray_config_def.h object_manager_default_chunk_size).")
+_d("object_gc_grace_s", 2.0,
+   "Seconds an unreferenced object survives before the control plane "
+   "frees it (covers the submit->deserialize ref handoff window).")
+_d("object_gc_period_s", 1.0, "Control-plane GC sweep period.")
 
 # --- scheduler -------------------------------------------------------------
 _d("worker_pool_min_workers", 0, "Prestarted workers per node.")

@@ -151,8 +151,14 @@ class HeadNode:
             "RAY_TPU_SESSION_DIR": self.session_dir,
             "RAY_TPU_CP_SOCK": self.cp_sock_path,
             "RAY_TPU_NODE_ID": node_id.hex(),
-            "RAY_TPU_SHM_ROOT": self.shm_root,
-            "RAY_TPU_SPILL_DIR": self.spill_dir,
+            # Every node owns a DISTINCT shm root: objects move between
+            # nodes only via the chunked pull protocol (node_manager
+            # fetch_object_chunk), never via a shared filesystem.  This is
+            # what makes the single-host simulation faithful to multi-host
+            # (reference: per-node plasma + object_manager Push/Pull).
+            "RAY_TPU_SHM_ROOT": f"{self.shm_root}_node_{node_id.hex()[:12]}",
+            "RAY_TPU_SPILL_DIR": os.path.join(
+                self.spill_dir, f"node_{node_id.hex()[:12]}"),
             "RAY_TPU_NODE_RESOURCES": json.dumps(res),
         })
         log = open(os.path.join(self.session_dir, "logs",
@@ -218,3 +224,7 @@ class HeadNode:
         self.cp_server.shutdown()
         self.store.destroy()
         shutil.rmtree(self.spill_dir, ignore_errors=True)
+        # extra-node stores (SIGKILLed nodes never ran their own cleanup)
+        import glob
+        for path in glob.glob(f"{self.shm_root}_node_*"):
+            shutil.rmtree(path, ignore_errors=True)

@@ -254,6 +254,20 @@ class NodeManager:
         self.stop()
 
     # ------------------------------------------------------------------
+    # Object pull protocol (node-to-node transfer; reference:
+    # object_manager.cc Push/Pull + chunk_object_reader.cc)
+    # ------------------------------------------------------------------
+    def fetch_object_meta(self, object_id: bytes) -> Optional[Dict[str, Any]]:
+        view = self.store.get_view(object_id)
+        if view is None:
+            return None
+        return {"size": len(view)}
+
+    def fetch_object_chunk(self, object_id: bytes, offset: int,
+                           length: int) -> Optional[bytes]:
+        return self.store.read_chunk(object_id, offset, length)
+
+    # ------------------------------------------------------------------
     # Worker channel (hijacked connection)
     # ------------------------------------------------------------------
     def stream_worker(self, conn: socket.socket, worker_id: bytes) -> None:

@@ -1,9 +1,11 @@
 """Extra node-manager process (multi-node simulation on one host).
 
 Started by :meth:`ray_tpu._private.node.HeadNode.add_node`; runs one
-NodeManager with its own worker pool against the shared control plane and
-shm store (same host, so the object plane is naturally shared — chunked
-cross-host transfer is a later-round feature tracked in ROADMAP.md).
+NodeManager with its own worker pool and its OWN shm store root against
+the shared control plane.  Objects created on other nodes arrive via the
+chunked pull protocol (``NodeManager.fetch_object_chunk``), mirroring the
+reference's node-to-node object manager
+(``src/ray/object_manager/object_manager.cc`` Push/Pull).
 """
 
 from __future__ import annotations
@@ -38,6 +40,7 @@ def main():
     signal.signal(signal.SIGINT, _term)
     stop.wait()
     nm.stop()
+    store.destroy()
 
 
 if __name__ == "__main__":

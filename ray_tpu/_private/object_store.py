@@ -134,6 +134,38 @@ class ShmStore:
             self._used += len(data)
         return len(data)
 
+    def put_stream(self, object_id: bytes, size: int, chunks) -> int:
+        """Create + seal an object from an iterator of byte chunks.
+
+        Write path of the node-to-node pull protocol: chunks arrive over
+        RPC and stream straight into the tmpfs file, sealed by rename.
+        """
+        self._ensure_capacity(size)
+        path = self._path(object_id)
+        tmp = path + f".tmp.{os.getpid()}"
+        written = 0
+        with open(tmp, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
+                written += len(chunk)
+        if written != size:
+            os.unlink(tmp)
+            raise IOError(f"object {object_id.hex()}: streamed {written} "
+                          f"bytes, expected {size}")
+        os.rename(tmp, path)
+        with self._lock:
+            self._index[object_id] = (size, time.monotonic())
+            self._used += size
+        return size
+
+    def read_chunk(self, object_id: bytes, offset: int,
+                   length: int) -> Optional[bytes]:
+        """Serve one chunk of a sealed object (pull-protocol read side)."""
+        view = self.get_view(object_id)
+        if view is None:
+            return None
+        return bytes(view[offset:offset + length])
+
     # --------------------------------------------------------- read -----
     def contains(self, object_id: bytes) -> bool:
         if self._arena is not None and self._arena.contains(object_id):

@@ -55,6 +55,8 @@ class CoreWorker:
         self._actor_buffers: Dict[bytes, List] = {}
         self._actor_buffer_lock = threading.Lock()
         self._gen_len_cache: Dict[bytes, int] = {}
+        self._nm_peers: Dict[str, Any] = {}
+        self.num_remote_pulls = 0
         self.current_actor = None
         self.current_actor_id: Optional[bytes] = None
         # Per-execution-context task id (contextvar: safe under threaded
@@ -123,9 +125,58 @@ class CoreWorker:
             value = serialization.deserialize_frame(memoryview(data))
         else:
             value = self.store.get_object(oid)
+            if value is None and self._pull_remote(oid, loc):
+                value = self.store.get_object(oid)
             if value is None:
                 raise KeyError(f"shm object {oid.hex()} missing from store")
         return value
+
+    # ------------------------------------------------------------------
+    # Node-to-node object transfer (pull side).  Reference:
+    # object_manager/pull_manager.cc — here the *consumer* worker pulls
+    # chunks from the node manager of the node holding the primary copy
+    # and seals a local secondary copy.
+    # ------------------------------------------------------------------
+    def _pull_remote(self, oid: bytes, loc: Dict[str, Any]) -> bool:
+        src_node = loc.get("node")
+        if not src_node or src_node == self.node_id:
+            return False
+        info = self.cp.get_node(src_node)
+        if info is None or info.get("state") != "ALIVE":
+            return False
+        peer = self._nm_peer(info["sock_path"])
+        try:
+            meta = peer.call("fetch_object_meta", oid)
+            if meta is None:
+                return False
+            size = meta["size"]
+            chunk_bytes = GLOBAL_CONFIG.object_transfer_chunk_bytes
+
+            def chunks():
+                off = 0
+                while off < size:
+                    n = min(chunk_bytes, size - off)
+                    data = peer.call("fetch_object_chunk", oid, off, n)
+                    if data is None or len(data) != n:
+                        raise IOError(
+                            f"short chunk pulling {oid.hex()} "
+                            f"({0 if data is None else len(data)}/{n})")
+                    yield data
+                    off += n
+
+            self.store.put_stream(oid, size, chunks())
+        except (OSError, IOError, ConnectionError):
+            return False
+        self.num_remote_pulls += 1
+        return True
+
+    def _nm_peer(self, sock_path: str):
+        from ray_tpu._private.protocol import RpcClient
+        client = self._nm_peers.get(sock_path)
+        if client is None:
+            client = RpcClient(sock_path)
+            self._nm_peers[sock_path] = client
+        return client
 
     def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
             timeout: Optional[float] = None) -> Any:

@@ -26,8 +26,14 @@ def test_state_api(ray_start_regular):
     assert len(state.list_nodes()) == 1
     actors = state.list_actors()
     assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
-    tasks = state.list_tasks()
-    assert any(t.get("state") == "FINISHED" for t in tasks)
+    # the FINISHED event is recorded when the node manager processes the
+    # worker's done message, slightly after the result object commits
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if any(t.get("state") == "FINISHED" for t in state.list_tasks()):
+            break
+        time.sleep(0.05)
+    assert any(t.get("state") == "FINISHED" for t in state.list_tasks())
     assert state.summarize_actors().get("ALIVE") == 1
 
 
