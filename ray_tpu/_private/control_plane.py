@@ -75,6 +75,17 @@ class ControlPlane:
         self._channels: Dict[str, List[Tuple[int, Any]]] = defaultdict(list)
         self._channel_seq: Dict[str, int] = defaultdict(int)
         self._pub_waiters = _Waiters()
+        # reference counting: per-holder counts + aggregate; an object is
+        # freeable once its aggregate sits at zero past the grace period
+        # (reference: core_worker/reference_count.cc, centralized here)
+        self._refs_by_holder: Dict[bytes, Dict[bytes, int]] = defaultdict(
+            lambda: defaultdict(int))
+        self._ref_totals: Dict[bytes, int] = defaultdict(int)
+        self._zero_since: Dict[bytes, float] = {}
+        # lineage: task_id -> TaskSpec for re-execution of lost objects
+        # (reference: task_manager.cc lineage + object_recovery_manager)
+        self._lineage: Dict[bytes, Any] = {}
+        self._lineage_cap = 20000
         # task events ring buffer
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_cap = 65536
@@ -175,6 +186,80 @@ class ControlPlane:
                     self._inline_data.pop(o, None)
                     freed += 1
         return freed
+
+    # ------------------------------------------------ refcounting / GC ----
+    def update_refs(self, holder_id: bytes, deltas: Dict[bytes, int]) -> None:
+        now = time.time()
+        with self._lock:
+            held = self._refs_by_holder[holder_id]
+            for oid, d in deltas.items():
+                oid = bytes(oid)
+                held[oid] += d
+                if held[oid] == 0:
+                    held.pop(oid)
+                total = self._ref_totals[oid] + d
+                if total:
+                    self._ref_totals[oid] = total
+                    self._zero_since.pop(oid, None)
+                else:
+                    # d == 0 (ref born and dropped within one flush
+                    # window) still marks the object as once-tracked and
+                    # now unreferenced
+                    self._ref_totals.pop(oid, None)
+                    self._zero_since.setdefault(oid, now)
+            if not held:
+                self._refs_by_holder.pop(holder_id, None)
+
+    def purge_holder(self, holder_id: bytes) -> None:
+        """Drop every count contributed by a dead holder (worker/pin)."""
+        with self._lock:
+            held = self._refs_by_holder.pop(holder_id, None)
+        if held:
+            # re-apply as negative deltas under a synthetic holder so the
+            # totals/zero bookkeeping stays in one code path
+            self.update_refs(b"_purge", {o: -d for o, d in held.items()})
+            with self._lock:
+                self._refs_by_holder.pop(b"_purge", None)
+
+    def gc_sweep(self, grace_s: float = 2.0) -> List[bytes]:
+        """Free committed objects unreferenced for longer than the grace.
+
+        Only objects that were tracked at least once are eligible — bare
+        commits without any ObjectRef holder (e.g. generator items not yet
+        iterated) are left alone.  Returns the freed ids so the caller can
+        fan out shm deletions to node stores.
+        """
+        cutoff = time.time() - grace_s
+        with self._lock:
+            victims = [oid for oid, t0 in self._zero_since.items()
+                       if t0 < cutoff and oid in self._objects]
+            for oid in victims:
+                self._objects.pop(oid, None)
+                self._inline_data.pop(oid, None)
+                self._zero_since.pop(oid, None)
+            # forget zero-marks for ids that were never committed
+            stale = [oid for oid, t0 in self._zero_since.items()
+                     if t0 < cutoff - 60.0]
+            for oid in stale:
+                self._zero_since.pop(oid, None)
+        return victims
+
+    def refs_summary(self) -> Dict[str, int]:
+        with self._lock:
+            return {"tracked_objects": len(self._ref_totals),
+                    "holders": len(self._refs_by_holder),
+                    "zero_pending": len(self._zero_since)}
+
+    # --------------------------------------------------------- lineage ----
+    def add_lineage(self, task_id: bytes, spec: Any) -> None:
+        with self._lock:
+            self._lineage[bytes(task_id)] = spec
+            while len(self._lineage) > self._lineage_cap:
+                self._lineage.pop(next(iter(self._lineage)))
+
+    def get_lineage(self, task_id: bytes) -> Optional[Any]:
+        with self._lock:
+            return self._lineage.get(bytes(task_id))
 
     def objects_summary(self) -> Dict[str, Any]:
         with self._lock:

@@ -124,11 +124,16 @@ class HeadNode:
             control_plane=self.control_plane,
             node_manager=self.node_manager, shm_store=self.store,
             session_dir=self.session_dir, namespace=namespace)
+        from ray_tpu._private.ref_tracker import install_tracker
+        install_tracker(self.worker.worker_id.binary(), self.control_plane)
         self._extra_nodes: list = []
         self._stopped = False
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="head-health")
         self._health_thread.start()
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, daemon=True, name="head-object-gc")
+        self._gc_thread.start()
         atexit.register(self.shutdown)
 
     # ------------------------------------------------------------------
@@ -209,10 +214,39 @@ class HeadNode:
                     self.control_plane.mark_node_dead(
                         info["node_id"], "missed heartbeats")
 
+    def _gc_loop(self):
+        """Periodic object GC: free unreferenced objects + fan out shm
+        deletions to every node's store (reference: owner-driven
+        free + plasma deletion)."""
+        period = GLOBAL_CONFIG.object_gc_period_s
+        grace = GLOBAL_CONFIG.object_gc_grace_s
+        while not self._stopped:
+            time.sleep(period)
+            if self._stopped:
+                return
+            try:
+                freed = self.control_plane.gc_sweep(grace)
+            except Exception:  # noqa: BLE001
+                continue
+            if not freed:
+                continue
+            self.node_manager.delete_objects(freed)
+            for info in self.control_plane.list_nodes():
+                if (info["state"] != "ALIVE"
+                        or info["node_id"] == self.node_id):
+                    continue
+                try:
+                    protocol.RpcClient(info["sock_path"]).call(
+                        "delete_objects", freed)
+                except (OSError, ConnectionError):
+                    pass
+
     def shutdown(self):
         if self._stopped:
             return
         self._stopped = True
+        from ray_tpu._private.ref_tracker import uninstall_tracker
+        uninstall_tracker()
         for nid, proc in self._extra_nodes:
             proc.terminate()
         for nid, proc in self._extra_nodes:

@@ -132,7 +132,31 @@ class NodeManager:
     # Public RPC surface (called by drivers/workers via RpcClient, or
     # in-process by the driver).
     # ------------------------------------------------------------------
+    def _pin_dependencies(self, spec: TaskSpec) -> None:
+        """Keep arg objects alive while the task is queued/running.
+
+        The pin is a refcount held under a per-task holder id, purged when
+        the task reaches a terminal state (reference: the submitting
+        worker's reference_count.cc holds deps until the task completes).
+        """
+        deps = spec.dependencies()
+        if deps:
+            try:
+                self.cp.update_refs(b"task:" + spec.task_id,
+                                    {d: 1 for d in deps})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _unpin_dependencies(self, spec: TaskSpec) -> None:
+        if spec.dependencies():
+            try:
+                self.cp.purge_holder(b"task:" + spec.task_id)
+            except Exception:  # noqa: BLE001
+                pass
+
     def submit_task(self, spec: TaskSpec) -> None:
+        self._pin_dependencies(spec)
+        self.cp.add_lineage(spec.task_id, spec)
         with self._lock:
             self._retries_left.setdefault(spec.task_id, spec.max_retries)
             self._pending.append(spec)
@@ -143,6 +167,7 @@ class NodeManager:
 
     def submit_actor_creation(self, spec: TaskSpec) -> None:
         assert spec.actor_creation and spec.actor_id
+        self._pin_dependencies(spec)
         with self._lock:
             self._actors[spec.actor_id] = _ActorState(spec)
             self._pending.append(spec)
@@ -150,6 +175,7 @@ class NodeManager:
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         """Queue a method call on an actor hosted by this node."""
+        self._pin_dependencies(spec)
         with self._lock:
             astate = self._actors.get(spec.actor_id)
             if astate is None or astate.state == "DEAD":
@@ -267,6 +293,14 @@ class NodeManager:
                            length: int) -> Optional[bytes]:
         return self.store.read_chunk(object_id, offset, length)
 
+    def delete_objects(self, object_ids: List[bytes]) -> int:
+        """GC fan-out target: drop local shm copies of freed objects."""
+        n = 0
+        for oid in object_ids:
+            if self.store.delete(oid):
+                n += 1
+        return n
+
     # ------------------------------------------------------------------
     # Worker channel (hijacked connection)
     # ------------------------------------------------------------------
@@ -299,11 +333,15 @@ class NodeManager:
             task_id = msg["task_id"]
             with self._lock:
                 if worker.actor_id is not None:
-                    worker.inflight_actor_tasks.pop(task_id, None)
+                    done_actor_spec = worker.inflight_actor_tasks.pop(
+                        task_id, None)
                     spec = None
                 else:
+                    done_actor_spec = None
                     spec = worker.current_task
                     worker.current_task = None
+            if done_actor_spec is not None:
+                self._unpin_dependencies(done_actor_spec)
             if spec is not None:
                 self._release_task_resources(spec, worker)
                 retrying = False
@@ -328,6 +366,8 @@ class NodeManager:
                     if worker.state == "busy":
                         worker.state = "idle"
                         self._idle.append(worker)
+                if not retrying:
+                    self._unpin_dependencies(spec)
             self.cp.add_task_event({
                 "task_id": task_id.hex(), "state": "FINISHED"
                 if not msg.get("error") else "FAILED",
@@ -634,6 +674,11 @@ class NodeManager:
             spec = worker.current_task
             worker.current_task = None
             actor_id = worker.actor_id
+        try:
+            # drop the dead process's refcount contributions wholesale
+            self.cp.purge_holder(worker.worker_id)
+        except Exception:  # noqa: BLE001
+            pass
         if prev_state == "starting":
             with self._lock:
                 self._starting = max(0, self._starting - 1)
@@ -718,6 +763,7 @@ class NodeManager:
     def _fail_task(self, spec: TaskSpec, error: BaseException):
         """Commit error objects for every return so getters unblock."""
         from ray_tpu.exceptions import TaskError
+        self._unpin_dependencies(spec)
         err = TaskError(error, format_remote_traceback(error),
                         spec.task_id.hex())
         data = serialization.dumps(err)

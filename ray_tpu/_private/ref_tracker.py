@@ -1,0 +1,110 @@
+"""Process-local reference tracking for ObjectRefs.
+
+TPU-native counterpart of the owner-side reference counter in the
+reference core worker (``src/ray/core_worker/reference_count.cc``,
+1.6k LoC).  Design difference, on purpose: ownership bookkeeping is
+centralized in the control plane (which already holds the object
+directory), so each process only aggregates +1/-1 deltas from
+``ObjectRef.__init__``/``__del__`` and flushes them in batches.  The
+control plane frees objects whose aggregate count sits at zero past a
+grace period (``control_plane.gc_sweep``); the grace covers the handoff
+window where a ref is serialized into a task spec before the node
+manager's dependency pin lands.
+
+Per-process deltas are keyed by this process's holder id so the control
+plane can drop a crashed process's contributions wholesale
+(``purge_holder``) instead of leaking positive counts forever.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from collections import defaultdict
+from typing import Dict, Optional
+
+
+class RefTracker:
+    def __init__(self, holder_id: bytes, control_plane,
+                 flush_interval: float = 0.2):
+        self.holder_id = holder_id
+        self.cp = control_plane
+        self._lock = threading.Lock()
+        self._deltas: Dict[bytes, int] = defaultdict(int)
+        self._dirty = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        name="ref-flush", daemon=True)
+        self._thread.start()
+        self._flush_interval = flush_interval
+        atexit.register(self.flush)
+
+    def add(self, object_id: bytes, delta: int) -> None:
+        with self._lock:
+            self._deltas[object_id] += delta
+        self._dirty.set()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._deltas:
+                return
+            # Zero-net entries are KEPT: a ref created and dropped within
+            # one flush window nets to 0, but the control plane must still
+            # learn the object was tracked and is now unreferenced
+            # (otherwise it never becomes eligible for GC).
+            batch = dict(self._deltas)
+            self._deltas.clear()
+        try:
+            self.cp.update_refs(self.holder_id, batch)
+        except Exception:  # noqa: BLE001 - cp may be shutting down
+            pass
+
+    def _flush_loop(self) -> None:
+        while not self._stopped.is_set():
+            self._dirty.wait(timeout=5.0)
+            self._dirty.clear()
+            if self._stopped.wait(self._flush_interval):
+                break
+            self.flush()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._dirty.set()
+        self.flush()
+
+
+_tracker: Optional[RefTracker] = None
+_tracker_lock = threading.Lock()
+
+
+def install_tracker(holder_id: bytes, control_plane) -> RefTracker:
+    global _tracker
+    with _tracker_lock:
+        if _tracker is not None:
+            _tracker.stop()
+        _tracker = RefTracker(holder_id, control_plane)
+        return _tracker
+
+
+def uninstall_tracker() -> None:
+    global _tracker
+    with _tracker_lock:
+        if _tracker is not None:
+            _tracker.stop()
+            _tracker = None
+
+
+def track_ref(object_id: bytes) -> bool:
+    """+1 for a newly constructed ObjectRef. Returns whether counted."""
+    t = _tracker
+    if t is None:
+        return False
+    t.add(object_id, +1)
+    return True
+
+
+def untrack_ref(object_id: bytes) -> None:
+    t = _tracker
+    if t is not None:
+        t.add(object_id, -1)

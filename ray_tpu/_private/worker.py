@@ -178,6 +178,37 @@ class CoreWorker:
             self._nm_peers[sock_path] = client
         return client
 
+    # ------------------------------------------------------------------
+    # Lineage reconstruction.  Reference:
+    # core_worker/object_recovery_manager.cc + TaskManager::ResubmitTask —
+    # a lost object (evicted shm copy, dead holder node) is recomputed by
+    # re-executing the deterministic task that created it; return ids are
+    # derived from the task id, so the re-execution commits the same ids.
+    # ------------------------------------------------------------------
+    def _recover_object(self, oid: bytes,
+                        attempts: int = 3) -> Dict[str, Any]:
+        from ray_tpu.exceptions import ObjectLostError
+        task_id = oid[: TaskID.SIZE]
+        for _ in range(attempts):
+            spec = self.cp.get_lineage(task_id)
+            if spec is None:
+                raise ObjectLostError(
+                    f"object {oid.hex()} lost and has no lineage to "
+                    f"reconstruct (ray.put objects and actor-task returns "
+                    f"are not reconstructible)")
+            # invalidate the stale location so waiters block on the
+            # re-execution's commit instead of re-reading the dead copy
+            self.cp.free_objects([oid])
+            if hasattr(self.nm, "call"):
+                self.nm.call("submit_task", spec)
+            else:
+                self.nm.submit_task(spec)
+            loc = self.cp.wait_object(oid, 300.0)
+            if loc is not None:
+                return loc
+        raise ObjectLostError(
+            f"object {oid.hex()} could not be reconstructed")
+
     def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
             timeout: Optional[float] = None) -> Any:
         single = isinstance(refs, ObjectRef)
@@ -207,7 +238,11 @@ class CoreWorker:
             loc = self.cp.get_location(o)
             if loc is None:
                 raise GetTimeoutError(f"object {o.hex()} not available")
-            value = self._fetch_committed(o, loc)
+            try:
+                value = self._fetch_committed(o, loc)
+            except KeyError:
+                loc = self._recover_object(o)
+                value = self._fetch_committed(o, loc)
             if loc.get("error"):
                 if isinstance(value, TaskError):
                     raise value.as_instanceof_cause()

@@ -48,6 +48,8 @@ class WorkerProcess:
             node_manager=self.nm_client, shm_store=self.store,
             session_dir=self.session_dir, nm_notify=self._send)
         set_global_worker(self.core)
+        from ray_tpu._private.ref_tracker import install_tracker
+        install_tracker(self.worker_id.binary(), self.cp)
         # actor execution machinery (populated on creation)
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None

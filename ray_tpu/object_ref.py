@@ -1,8 +1,15 @@
 """ObjectRef — a future for an object in the cluster.
 
 Parity target: ``python/ray/_raylet.pyx`` ``ObjectRef`` /
-``ObjectRefGenerator``.  Refs are cheap value types wrapping the 20-byte
+``ObjectRefGenerator``.  Refs are cheap value types wrapping the binary
 ObjectID; they pickle freely (into task args, other objects, etc.).
+
+Lifetime: every live ObjectRef counts toward its object's reference count
+(owner-side refcounting; reference ``core_worker/reference_count.cc``).
+Construction registers +1 with the process-local ref tracker, __del__
+registers -1; the control plane frees objects whose aggregate count stays
+zero past a grace period.  Pickling into a task arg transfers liveness to
+the task spec (the node manager pins dependencies until the task ends).
 """
 
 from __future__ import annotations
@@ -13,7 +20,7 @@ from ray_tpu._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id",)
+    __slots__ = ("_id", "_tracked")
 
     def __init__(self, object_id: bytes):
         if isinstance(object_id, ObjectID):
@@ -21,6 +28,17 @@ class ObjectRef:
         if not isinstance(object_id, bytes) or len(object_id) != ObjectID.SIZE:
             raise ValueError(f"bad object id: {object_id!r}")
         self._id = object_id
+        self._tracked = False
+        from ray_tpu._private.ref_tracker import track_ref
+        self._tracked = track_ref(object_id)
+
+    def __del__(self):
+        if getattr(self, "_tracked", False):
+            try:
+                from ray_tpu._private.ref_tracker import untrack_ref
+                untrack_ref(self._id)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
 
     def binary(self) -> bytes:
         return self._id
@@ -29,7 +47,8 @@ class ObjectRef:
         return self._id.hex()
 
     def task_id(self) -> bytes:
-        return self._id[:16]
+        from ray_tpu._private.ids import TaskID
+        return self._id[:TaskID.SIZE]
 
     def __hash__(self):
         return hash(self._id)

@@ -1,0 +1,90 @@
+"""Reference counting, object GC, and lineage reconstruction.
+
+Covers VERDICT round-1 item 4: objects are freed once unreferenced
+(reference: ``core_worker/reference_count.cc``), and lost shm copies are
+recomputed by re-executing the creating task
+(``object_recovery_manager.cc`` + ``TaskManager::ResubmitTask``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def fast_gc():
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_gc_grace_s": 0.4, "object_gc_period_s": 0.1})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _cp():
+    from ray_tpu._private.worker import global_node
+    return global_node().control_plane
+
+
+def test_unreferenced_objects_are_freed(fast_gc):
+    ray = fast_gc
+    base = _cp().objects_summary()["count"]
+    for i in range(2000):
+        ray.put(i)          # ref dropped immediately
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if _cp().objects_summary()["count"] <= base + 50:
+            break
+        time.sleep(0.2)
+    assert _cp().objects_summary()["count"] <= base + 50, \
+        _cp().objects_summary()
+
+
+def test_live_refs_survive_gc(fast_gc):
+    ray = fast_gc
+    ref = ray.put({"keep": 42})
+    time.sleep(1.5)          # several GC sweeps past the grace period
+    assert ray.get(ref)["keep"] == 42
+
+
+def test_task_arg_pinned_while_queued(fast_gc):
+    ray = fast_gc
+
+    @ray.remote
+    def slow_consume(x, delay):
+        time.sleep(delay)
+        return int(np.sum(x))
+
+    arg = ray.put(np.ones(10, dtype=np.int64))
+    ref = slow_consume.remote(arg, 1.0)
+    del arg                  # only the task-spec pin keeps it alive now
+    assert ray.get(ref, timeout=30) == 10
+
+
+def test_lineage_reconstruction_of_lost_shm_object(fast_gc):
+    ray = fast_gc
+    from ray_tpu._private.worker import global_node
+
+    @ray.remote
+    def produce():
+        return np.arange(3_000_000, dtype=np.int64)      # 24 MB -> shm
+
+    ref = produce.remote()
+    first = ray.get(ref, timeout=60)
+    assert int(first[-1]) == 2_999_999
+    # simulate loss of the only shm copy (eviction / node crash)
+    assert global_node().store.delete(ref.binary())
+    again = ray.get(ref, timeout=120)
+    assert again.shape == (3_000_000,)
+    assert int(again[7]) == 7
+
+
+def test_put_objects_are_not_reconstructible(fast_gc):
+    ray = fast_gc
+    from ray_tpu._private.worker import global_node
+    from ray_tpu.exceptions import ObjectLostError
+
+    ref = ray.put(np.zeros(2_000_000))                    # 16 MB -> shm
+    assert global_node().store.delete(ref.binary())
+    with pytest.raises(ObjectLostError):
+        ray.get(ref, timeout=30)
