@@ -456,6 +456,16 @@ class ControlPlane:
         with self._lock:
             return list(self._task_events[-limit:])
 
+    def tasks_last_state(self) -> List[Dict[str, Any]]:
+        """Latest event per task id (node-death recovery scans this)."""
+        with self._lock:
+            last: Dict[str, Dict[str, Any]] = {}
+            for ev in self._task_events:
+                tid = ev.get("task_id")
+                if tid:
+                    last[tid] = ev
+            return list(last.values())
+
     # -------------------------------------------------------- counters ----
     def incr(self, name: str, amount: int = 1) -> int:
         with self._lock:

@@ -193,6 +193,7 @@ class HeadNode:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                 self.control_plane.mark_node_dead(node_id, "removed")
+                self._on_node_dead(node_id)
                 return
         raise KeyError(node_id.hex())
 
@@ -213,6 +214,80 @@ class HeadNode:
                 if now - info.get("last_heartbeat", now) > timeout:
                     self.control_plane.mark_node_dead(
                         info["node_id"], "missed heartbeats")
+                    try:
+                        self._on_node_dead(info["node_id"])
+                    except Exception:  # noqa: BLE001
+                        import traceback
+                        traceback.print_exc()
+
+    def _on_node_dead(self, node_id: bytes):
+        """Recover cluster state owned by a dead node.
+
+        Reference behavior: ``gcs_actor_manager.cc`` (restart or kill the
+        node's actors), ``gcs_placement_group_manager`` (reschedule
+        bundles), and owner-side task retry.  Here the head drives all
+        three from control-plane state.
+        """
+        cp = self.control_plane
+        dead_hex = node_id.hex()
+        # 1. actors hosted on the dead node: restart elsewhere or kill
+        for info in cp.list_actors():
+            if info.get("node_id") != node_id:
+                continue
+            if info.get("state") not in ("ALIVE", "PENDING", "RESTARTING"):
+                continue
+            aid = info["actor_id"]
+            spec = info.get("creation_spec")
+            max_restarts = info.get("max_restarts", 0)
+            used = info.get("num_restarts", 0)
+            if spec is not None and (max_restarts == -1
+                                     or used < max_restarts):
+                cp.update_actor(aid, state="RESTARTING",
+                                num_restarts=used + 1, nm_sock=None,
+                                node_id=None)
+                self.node_manager.submit_actor_creation(spec)
+            else:
+                cp.update_actor(
+                    aid, state="DEAD",
+                    death_reason=f"node {dead_hex[:12]} died")
+        # 2. normal tasks that were queued/running there: re-execute from
+        # lineage (their callers still wait on the return objects)
+        for ev in cp.tasks_last_state():
+            if ev.get("node") != dead_hex:
+                continue
+            if ev.get("state") not in ("PENDING", "RUNNING", "RETRY"):
+                continue
+            spec = cp.get_lineage(bytes.fromhex(ev["task_id"]))
+            if spec is not None and not spec.actor_creation \
+                    and spec.actor_id is None:
+                self.node_manager.submit_task(spec)
+        # 3. placement groups with bundles on the dead node: release the
+        # surviving reservations and re-reserve the whole group
+        from ray_tpu.util import placement_group as pg_mod
+        nodes_by_hex = {n["node_id"].hex(): n for n in cp.list_nodes()}
+        for pg in cp.list_placement_groups():
+            bundle_nodes = pg.get("bundle_nodes") or []
+            if dead_hex not in bundle_nodes or pg.get("state") in (
+                    "REMOVED", "FAILED"):
+                continue
+            for index, (bundle, nid_hex) in enumerate(
+                    zip(pg.get("bundles", []), bundle_nodes)):
+                node = nodes_by_hex.get(nid_hex)
+                if node is None or node["state"] != "ALIVE":
+                    continue
+                try:
+                    pg_mod._call(
+                        pg_mod._nm_client_for(self.worker, node),
+                        "return_bundle", pg["pg_id"], index, bundle)
+                except (OSError, ConnectionError):
+                    pass
+            cp.update_placement_group(pg["pg_id"], state="RESCHEDULING",
+                                      bundle_nodes=[])
+            threading.Thread(
+                target=pg_mod._reserve_loop,
+                args=(pg["pg_id"], pg.get("bundles", []),
+                      pg.get("strategy", "PACK")),
+                daemon=True, name="pg-reschedule").start()
 
     def _gc_loop(self):
         """Periodic object GC: free unreferenced objects + fan out shm

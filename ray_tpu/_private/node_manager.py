@@ -478,7 +478,14 @@ class NodeManager:
                          name="nm-depwait").start()
 
     def _pick_node(self, spec: TaskSpec) -> Optional[Dict[str, Any]]:
-        """Choose a target node; None => run locally."""
+        """Choose a target node; None => run locally.
+
+        Raises :class:`InfeasibleTaskError` for tasks no node can ever
+        satisfy (the reference surfaces infeasible-task warnings instead
+        of silently requeueing forever) and for hard affinity to a dead
+        node.
+        """
+        from ray_tpu.exceptions import InfeasibleTaskError
         strategy = spec.scheduling_strategy
         nodes = [n for n in self.cp.list_nodes() if n["state"] == "ALIVE"]
         if strategy.kind == "node_affinity":
@@ -489,7 +496,9 @@ class NodeManager:
                     return n
             if strategy.soft:
                 return None
-            return None  # hard affinity to a dead node: run locally & fail?
+            raise InfeasibleTaskError(
+                f"task {spec.name!r} has hard affinity to node "
+                f"{strategy.node_id.hex()[:12]}, which is not alive")
         if strategy.kind == "spread":
             # Round-robin over nodes that can ever fit the shape; heartbeat
             # load is too stale (1s) to break ties between bursts.
@@ -521,10 +530,25 @@ class NodeManager:
                 continue
             if fits(n.get("resources_available", {}), spec.resources):
                 return n
-        return None if local_fits_ever else (nodes and None)
+        if local_fits_ever:
+            return None
+        if not any(fits(n.get("resources_total", {}), spec.resources)
+                   for n in nodes):
+            raise InfeasibleTaskError(
+                f"task {spec.name!r} requests {spec.resources}, which no "
+                f"node in the cluster can ever satisfy")
+        return None  # a node could fit it later; keep requeueing
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
-        target = self._pick_node(spec)
+        from ray_tpu.exceptions import InfeasibleTaskError
+        try:
+            target = self._pick_node(spec)
+        except InfeasibleTaskError as e:
+            if spec.actor_creation and spec.actor_id:
+                self.cp.update_actor(spec.actor_id, state="DEAD",
+                                     death_reason=str(e))
+            self._fail_task(spec, e)
+            return True  # terminally handled; do not requeue
         if target is not None:
             try:
                 peer = self._peer_client(target)

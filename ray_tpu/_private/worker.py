@@ -57,6 +57,13 @@ class CoreWorker:
         self._gen_len_cache: Dict[bytes, int] = {}
         self._nm_peers: Dict[str, Any] = {}
         self.num_remote_pulls = 0
+        # Caller-side in-flight actor calls (reference:
+        # direct_actor_task_submitter pending queue): watched so calls
+        # in flight when an actor's host dies are failed or resent
+        # instead of hanging forever.
+        self._inflight_actor: Dict[bytes, Dict[bytes, Tuple]] = {}
+        self._inflight_lock = threading.Lock()
+        self._watcher_started = False
         self.current_actor = None
         self.current_actor_id: Optional[bytes] = None
         # Per-execution-context task id (contextvar: safe under threaded
@@ -193,9 +200,9 @@ class CoreWorker:
             spec = self.cp.get_lineage(task_id)
             if spec is None:
                 raise ObjectLostError(
-                    f"object {oid.hex()} lost and has no lineage to "
-                    f"reconstruct (ray.put objects and actor-task returns "
-                    f"are not reconstructible)")
+                    oid.hex(), "no lineage to reconstruct (ray.put "
+                    "objects and actor-task returns are not "
+                    "reconstructible)")
             # invalidate the stale location so waiters block on the
             # re-execution's commit instead of re-reading the dead copy
             self.cp.free_objects([oid])
@@ -206,8 +213,7 @@ class CoreWorker:
             loc = self.cp.wait_object(oid, 300.0)
             if loc is not None:
                 return loc
-        raise ObjectLostError(
-            f"object {oid.hex()} could not be reconstructed")
+        raise ObjectLostError(oid.hex(), "reconstruction failed")
 
     def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
             timeout: Optional[float] = None) -> Any:
@@ -392,14 +398,6 @@ class CoreWorker:
         task_id = TaskID.for_actor_creation(actor_id)
         ser_args, ser_kwargs = self._serialize_args(args, kwargs)
         name = opts.get("name")
-        self.cp.register_actor(actor_id.binary(), {
-            "name": name, "namespace": opts.get("namespace", self.namespace),
-            "class_name": getattr(cls, "__name__", "Actor"),
-            "state": "PENDING",
-            "max_restarts": opts.get("max_restarts", 0),
-            "lifetime": opts.get("lifetime"),
-            "resources": opts["resources"],
-        })
         spec = TaskSpec(
             task_id=task_id.binary(), job_id=self.job_id.binary(),
             name=f"{getattr(cls, '__name__', 'Actor')}.__init__",
@@ -414,6 +412,17 @@ class CoreWorker:
             owner_id=self.worker_id.binary(),
             runtime_env=opts.get("runtime_env") or {},
         )
+        self.cp.register_actor(actor_id.binary(), {
+            "name": name, "namespace": opts.get("namespace", self.namespace),
+            "class_name": getattr(cls, "__name__", "Actor"),
+            "state": "PENDING",
+            "max_restarts": opts.get("max_restarts", 0),
+            "lifetime": opts.get("lifetime"),
+            "resources": opts["resources"],
+            # kept so the head can reschedule the actor on another node
+            # when its host dies (gcs_actor_manager restart path)
+            "creation_spec": spec,
+        })
         self.nm.submit_actor_creation(spec)
         return actor_id.binary()
 
@@ -478,7 +487,8 @@ class CoreWorker:
         refs = [ObjectRef(o) for o in spec.return_object_ids()]
         return refs[0] if num_returns == 1 else refs
 
-    def _route_now(self, spec: TaskSpec) -> None:
+    def _route_now(self, spec: TaskSpec, streaming: bool = False,
+                   restarts_seen: Optional[int] = None) -> None:
         nm = self._actor_nm(spec.actor_id, wait=False)
         if nm is self.nm and self.mode == "driver":
             nm.submit_actor_task(spec)
@@ -486,6 +496,107 @@ class CoreWorker:
             nm.call("submit_actor_task", spec)
         else:
             nm.submit_actor_task(spec)
+        self._record_inflight(spec, streaming, restarts_seen)
+
+    # ------------------------------------------------------------------
+    # In-flight actor call tracking.  If the hosting node dies, the node
+    # manager that knew about the call dies with it — the caller is the
+    # only party able to fail or resend.  A 1s watcher prunes committed
+    # calls and reacts to actor DEAD / restart transitions.
+    # ------------------------------------------------------------------
+    def _record_inflight(self, spec: TaskSpec, streaming: bool,
+                         restarts_seen: Optional[int] = None) -> None:
+        if not streaming and not spec.return_object_ids():
+            return  # num_returns=0: nothing to watch for
+        if restarts_seen is None:
+            info = self.cp.get_actor_info(spec.actor_id) or {}
+            restarts_seen = info.get("num_restarts", 0)
+        with self._inflight_lock:
+            self._inflight_actor.setdefault(spec.actor_id, {})[
+                spec.task_id] = (spec, streaming, restarts_seen)
+            if not self._watcher_started:
+                self._watcher_started = True
+                threading.Thread(target=self._inflight_watch_loop,
+                                 daemon=True,
+                                 name="actor-inflight-watch").start()
+
+    def _call_committed(self, spec: TaskSpec, streaming: bool) -> bool:
+        if streaming:
+            oid = self._gen_len_oid(spec.task_id)
+        else:
+            ids = spec.return_object_ids()
+            if not ids:
+                return True
+            oid = ids[0]
+        return self.cp.get_location(oid) is not None
+
+    def _inflight_watch_loop(self) -> None:
+        while True:
+            time.sleep(1.0)
+            try:
+                self._inflight_watch_once()
+            except Exception:  # noqa: BLE001 - transient cp error; keep
+                continue       # watching (a dead watcher would strand
+                               # every future in-flight call)
+
+    def _inflight_watch_once(self) -> None:
+        with self._inflight_lock:
+            snapshot = {aid: dict(tasks) for aid, tasks
+                        in self._inflight_actor.items()}
+        for actor_id, tasks in snapshot.items():
+            done = [tid for tid, (spec, streaming, _) in tasks.items()
+                    if self._call_committed(spec, streaming)]
+            for tid in done:
+                tasks.pop(tid)
+            with self._inflight_lock:
+                for tid in done:
+                    self._inflight_actor.get(actor_id, {}).pop(tid, None)
+                if not self._inflight_actor.get(actor_id):
+                    self._inflight_actor.pop(actor_id, None)
+            if not tasks:
+                continue
+            info = self.cp.get_actor_info(actor_id)
+            state = (info or {}).get("state")
+            if info is None or state == "DEAD":
+                for tid, (spec, streaming, _) in tasks.items():
+                    if not self._call_committed(spec, streaming):
+                        self._fail_actor_call(
+                            spec, streaming, ActorDiedError(
+                                actor_id.hex(),
+                                (info or {}).get("death_reason",
+                                                 "actor is dead")))
+                with self._inflight_lock:
+                    # pop only what we actually failed: a call recorded
+                    # after the snapshot must stay tracked
+                    actor_tasks = self._inflight_actor.get(actor_id, {})
+                    for tid in tasks:
+                        actor_tasks.pop(tid, None)
+                    if not actor_tasks:
+                        self._inflight_actor.pop(actor_id, None)
+                elif state == "ALIVE":
+                    restarts = info.get("num_restarts", 0)
+                    for tid, (spec, streaming, seen) in tasks.items():
+                        if restarts <= seen:
+                            continue  # same incarnation; still running
+                        if self._call_committed(spec, streaming):
+                            continue
+                        if spec.max_task_retries != 0:
+                            try:
+                                self._route_now(spec, streaming)
+                            except ActorDiedError as e:
+                                self._fail_actor_call(spec, streaming, e)
+                            except (OSError, ConnectionError):
+                                continue  # retry next tick
+                        else:
+                            self._fail_actor_call(
+                                spec, streaming, ActorDiedError(
+                                    actor_id.hex(),
+                                    "actor restarted; in-flight call "
+                                    "lost (set max_task_retries to "
+                                    "resend)"))
+                            with self._inflight_lock:
+                                self._inflight_actor.get(
+                                    actor_id, {}).pop(tid, None)
 
     def _fail_actor_call(self, spec: TaskSpec, streaming: bool,
                          error: BaseException) -> None:
@@ -526,11 +637,26 @@ class CoreWorker:
                 buffer.append((spec, streaming))
                 return
         try:
-            self._route_now(spec)
+            self._route_now(spec, streaming)
         except ActorDiedError as e:
             self._fail_actor_call(spec, streaming, e)
+        except (OSError, ConnectionError):
+            # The actor's node manager is unreachable (its node just
+            # died); buffer the call — the health loop will transition
+            # the actor to RESTARTING (new address) or DEAD shortly.
+            with self._actor_buffer_lock:
+                buffer = self._actor_buffers.get(actor_id)
+                if buffer is None:
+                    buffer = []
+                    self._actor_buffers[actor_id] = buffer
+                    threading.Thread(
+                        target=self._flush_actor_buffer,
+                        args=(actor_id,), daemon=True,
+                        name="actor-buffer-flush").start()
+                buffer.append((spec, streaming))
 
     def _flush_actor_buffer(self, actor_id: bytes) -> None:
+        deadline = time.monotonic() + 600.0
         info = self.cp.wait_actor_state(actor_id, ("ALIVE", "DEAD"),
                                         timeout=600.0)
         while True:
@@ -541,6 +667,7 @@ class CoreWorker:
                     return
                 batch = list(buffered)
                 buffered.clear()
+            retry = []
             for spec, streaming in batch:
                 if info is None or info.get("state") != "ALIVE":
                     self._fail_actor_call(
@@ -551,9 +678,28 @@ class CoreWorker:
                                           "actor is dead")))
                 else:
                     try:
-                        self._route_now(spec)
+                        self._route_now(spec, streaming)
                     except ActorDiedError as e:
                         self._fail_actor_call(spec, streaming, e)
+                    except (OSError, ConnectionError):
+                        retry.append((spec, streaming))
+            if retry:
+                if time.monotonic() > deadline:
+                    for spec, streaming in retry:
+                        self._fail_actor_call(
+                            spec, streaming, ActorDiedError(
+                                actor_id.hex(),
+                                "actor unreachable past deadline"))
+                    continue
+                # stale ALIVE info pointing at a dead node: wait for the
+                # health loop to move the actor, then try again
+                with self._actor_buffer_lock:
+                    self._actor_buffers.setdefault(actor_id,
+                                                   []).extend(retry)
+                time.sleep(0.5)
+                info = self.cp.wait_actor_state(
+                    actor_id, ("ALIVE", "DEAD"),
+                    timeout=max(0.0, deadline - time.monotonic()))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         try:

@@ -83,6 +83,10 @@ class ActorUnavailableError(ActorError):
     """Actor is restarting; the call may be retried."""
 
 
+class InfeasibleTaskError(RayError):
+    """No node in the cluster can ever satisfy the task's resources."""
+
+
 class ObjectLostError(RayError):
     def __init__(self, object_id: str = "", reason: str = ""):
         self.object_id = object_id

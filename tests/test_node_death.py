@@ -1,0 +1,133 @@
+"""Node failure detection + recovery (VERDICT round-1 item 5).
+
+Reference behavior being mirrored: GCS health checks declare the node
+dead (``gcs_health_check_manager.cc``), its restartable actors are
+rescheduled elsewhere (``gcs_actor_manager.cc``), its queued/running
+tasks re-execute from lineage, and callers of its dead actors get
+ActorDiedError instead of hanging.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster_fast_health():
+    import ray_tpu
+    ray_tpu.init(num_cpus=1, _system_config={
+        "health_check_period_s": 0.2, "health_check_timeout_s": 2.0})
+    from ray_tpu._private.worker import global_node
+    yield ray_tpu, global_node()
+    ray_tpu.shutdown()
+
+
+def _sigkill_node(node, node_id):
+    for nid, proc in node._extra_nodes:
+        if nid == node_id:
+            os.kill(proc.pid, signal.SIGKILL)
+            return proc
+    raise KeyError(node_id.hex())
+
+
+def test_restartable_actor_moves_off_dead_node(cluster_fast_health):
+    ray, node = cluster_fast_health
+    node_b = node.add_node(num_cpus=2)
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    # soft affinity: deterministic initial placement on node_b while it
+    # is alive, free to move on restart (hard affinity pins to the node
+    # and dies with it — reference NodeAffinity semantics)
+    @ray.remote(max_restarts=1, scheduling_strategy=
+                NodeAffinitySchedulingStrategy(node_id=node_b.hex(),
+                                               soft=True))
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def node(self):
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray.get(c.node.remote(), timeout=60) == node_b.hex()
+    _sigkill_node(node, node_b)
+    # health loop declares the node dead, head reschedules the actor
+    new_node = ray.get(c.node.remote(), timeout=60)
+    assert new_node != node_b.hex()
+    assert ray.get(c.bump.remote(), timeout=30) == 1   # fresh state
+
+
+def test_non_restartable_actor_dies_with_node(cluster_fast_health):
+    ray, node = cluster_fast_health
+    node_b = node.add_node(num_cpus=1)
+    from ray_tpu.exceptions import ActorDiedError
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.hex(), soft=False))
+    class A:
+        def ping(self):
+            return "pong"
+
+        def sleepy(self):
+            time.sleep(60)
+            return "late"
+
+    a = A.remote()
+    assert ray.get(a.ping.remote(), timeout=60) == "pong"
+    inflight = a.sleepy.remote()        # will be lost with the node
+    _sigkill_node(node, node_b)
+    with pytest.raises(ActorDiedError):
+        ray.get(inflight, timeout=60)
+    with pytest.raises(ActorDiedError):
+        ray.get(a.ping.remote(), timeout=60)
+
+
+def test_task_on_dead_node_reexecutes(cluster_fast_health):
+    ray, node = cluster_fast_health
+    node_b = node.add_node(num_cpus=1)
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.hex(), soft=True))
+    def slow_square(x):
+        time.sleep(3.0)
+        return x * x
+
+    ref = slow_square.remote(7)
+    time.sleep(0.8)                     # let it start on node_b
+    _sigkill_node(node, node_b)
+    # lineage resubmission runs it on the head node
+    assert ray.get(ref, timeout=90) == 49
+
+
+def test_infeasible_task_fails_fast(cluster_fast_health):
+    ray, node = cluster_fast_health
+    from ray_tpu.exceptions import InfeasibleTaskError
+
+    @ray.remote(resources={"accelerator_that_does_not_exist": 4})
+    def impossible():
+        return 1
+
+    with pytest.raises(InfeasibleTaskError):
+        ray.get(impossible.remote(), timeout=30)
+
+
+def test_hard_affinity_to_dead_node_fails(cluster_fast_health):
+    ray, node = cluster_fast_health
+    from ray_tpu.exceptions import InfeasibleTaskError
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id="ff" * 16, soft=False))
+    def stuck():
+        return 1
+
+    with pytest.raises(InfeasibleTaskError):
+        ray.get(stuck.remote(), timeout=30)
