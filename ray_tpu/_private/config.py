@@ -116,6 +116,10 @@ _d("lineage_max_bytes", 64 * 1024 * 1024,
    "Budget for retained lineage specs per worker.")
 
 # --- networking ------------------------------------------------------------
+_d("use_tcp", False,
+   "Bind control plane and node managers on TCP instead of unix sockets "
+   "so RPCs can cross hosts (reference: rpc/grpc_server.cc binds TCP).")
+_d("node_ip", "127.0.0.1", "Advertised IP for this node's TCP services.")
 _d("rpc_connect_timeout_s", 10.0, "Socket connect timeout.")
 _d("rpc_frame_max_bytes", 512 * 1024 * 1024, "Max RPC frame size.")
 _d("pubsub_poll_timeout_s", 60.0, "Long-poll timeout for subscribers.")

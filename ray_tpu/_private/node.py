@@ -105,10 +105,16 @@ class HeadNode:
                           or os.path.join(self.session_dir, "spill"))
 
         self.control_plane = ControlPlane()
-        self.cp_sock_path = os.path.join(self.session_dir, "sockets",
-                                         "cp.sock")
+        if GLOBAL_CONFIG.use_tcp:
+            self.cp_sock_path = f"tcp://{GLOBAL_CONFIG.node_ip}:0"
+        else:
+            self.cp_sock_path = os.path.join(self.session_dir, "sockets",
+                                             "cp.sock")
         self.cp_server = protocol.RpcServer(self.cp_sock_path,
                                             self.control_plane, name="cp")
+        self.cp_sock_path = self.cp_server.address
+        with open(os.path.join(self.session_dir, "cp_address"), "w") as f:
+            f.write(self.cp_sock_path)
         self.store = ShmStore(self.shm_root, spill_dir=self.spill_dir)
         self.node_id = NodeID.from_random().binary()
         self.resources = default_resources(num_cpus, num_tpus, resources)
@@ -155,6 +161,7 @@ class HeadNode:
         proc_env.update({
             "RAY_TPU_SESSION_DIR": self.session_dir,
             "RAY_TPU_CP_SOCK": self.cp_sock_path,
+            "RAY_TPU_USE_TCP": "1" if GLOBAL_CONFIG.use_tcp else "0",
             "RAY_TPU_NODE_ID": node_id.hex(),
             # Every node owns a DISTINCT shm root: objects move between
             # nodes only via the chunked pull protocol (node_manager

@@ -88,10 +88,14 @@ class NodeManager:
         self.labels = labels or {}
         self._res_lock = threading.RLock()
 
-        self.sock_path = os.path.join(
-            session_dir, "sockets", f"nm_{node_id.hex()[:12]}.sock")
+        if GLOBAL_CONFIG.use_tcp:
+            self.sock_path = f"tcp://{node_ip}:0"
+        else:
+            self.sock_path = os.path.join(
+                session_dir, "sockets", f"nm_{node_id.hex()[:12]}.sock")
         self._server = protocol.RpcServer(self.sock_path, self,
                                           name=f"nm-{node_id.hex()[:6]}")
+        self.sock_path = self._server.address  # resolve ephemeral TCP port
 
         self._workers: Dict[bytes, _Worker] = {}
         self._idle: deque = deque()
@@ -500,8 +504,9 @@ class NodeManager:
                 f"task {spec.name!r} has hard affinity to node "
                 f"{strategy.node_id.hex()[:12]}, which is not alive")
         if strategy.kind == "spread":
-            # Round-robin over nodes that can ever fit the shape; heartbeat
-            # load is too stale (1s) to break ties between bursts.
+            # Least-loaded first (queue depth from heartbeats, locally from
+            # live state), round-robin only to break ties between bursts
+            # (reference: spread_scheduling_policy.cc sorts by load).
             candidates = sorted(
                 (n for n in nodes
                  if fits(n.get("resources_total", {}), spec.resources)
@@ -509,8 +514,18 @@ class NodeManager:
                 key=lambda n: n["node_id"])
             if not candidates:
                 return None
+
+            def _queue_depth(n):
+                if n["node_id"] == self.node_id:
+                    with self._lock:
+                        return len(self._pending) + len(self._waiting)
+                return n.get("load", {}).get("num_pending", 0)
+
+            depths = [_queue_depth(n) for n in candidates]
+            least = min(depths)
+            tied = [n for n, d in zip(candidates, depths) if d == least]
             self._spread_rr = getattr(self, "_spread_rr", -1) + 1
-            best = candidates[self._spread_rr % len(candidates)]
+            best = tied[self._spread_rr % len(tied)]
             return None if best["node_id"] == self.node_id else best
         # default hybrid: local first if it can ever fit and is under
         # the spread threshold; else best remote fit.
@@ -570,7 +585,16 @@ class NodeManager:
                 release(self.resources_available, spec.resources)
             self._maybe_spawn_worker(need_tpu)
             return False
-        chips = self._assign_chips(spec, worker)
+        try:
+            chips = self._assign_chips(spec, worker)
+        except RuntimeError as e:
+            print(f"[node_manager] {e}; requeueing task", file=sys.stderr)
+            with self._lock:
+                worker.state = "idle"
+                self._idle.append(worker)
+            with self._res_lock:
+                release(self.resources_available, spec.resources)
+            return False
         with self._lock:
             worker.current_task = spec
             worker.state = "busy" if not spec.actor_creation else "actor"
@@ -669,6 +693,13 @@ class NodeManager:
         if n <= 0:
             return None
         with self._res_lock:
+            if len(self._free_chips) < n:
+                # TPU resource accounting said the task fits, so the chip
+                # list must agree; a skew here would silently hand the task
+                # fewer chips than it asked for.
+                raise RuntimeError(
+                    f"chip accounting skew: task {spec.name!r} needs {n} "
+                    f"chips but only {len(self._free_chips)} are free")
             chips = self._free_chips[:n]
             del self._free_chips[:n]
         self._worker_chips[worker.worker_id] = chips

@@ -125,7 +125,7 @@ class ShmStore:
     def put_bytes(self, object_id: bytes, data: bytes) -> int:
         self._ensure_capacity(len(data))
         path = self._path(object_id)
-        tmp = path + f".tmp.{os.getpid()}"
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(data)
         os.rename(tmp, path)
@@ -142,7 +142,9 @@ class ShmStore:
         """
         self._ensure_capacity(size)
         path = self._path(object_id)
-        tmp = path + f".tmp.{os.getpid()}"
+        # Per-writer tmp name: two threads pulling the same object
+        # concurrently must not interleave into one file.
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
         written = 0
         with open(tmp, "wb") as f:
             for chunk in chunks:
@@ -152,8 +154,12 @@ class ShmStore:
             os.unlink(tmp)
             raise IOError(f"object {object_id.hex()}: streamed {written} "
                           f"bytes, expected {size}")
-        os.rename(tmp, path)
         with self._lock:
+            if object_id in self._index:
+                # a concurrent pull of this (immutable) object won the race
+                os.unlink(tmp)
+                return size
+            os.rename(tmp, path)
             self._index[object_id] = (size, time.monotonic())
             self._used += size
         return size

@@ -1,4 +1,4 @@
-"""Framed RPC over unix-domain sockets.
+"""Framed RPC over unix-domain or TCP sockets.
 
 TPU-native counterpart of the reference's gRPC layer (``src/ray/rpc/``).
 The control plane and node managers are in-cluster trusted peers on the
@@ -6,6 +6,13 @@ same host or VPC, so the wire format is length-prefixed pickle frames —
 simple, fast, and sufficient for the control plane.  The *tensor* plane
 never touches this layer: device arrays move over ICI/DCN inside XLA
 programs, host objects through the shm object store.
+
+Addresses are strings of two forms (reference: ``src/ray/rpc/grpc_server.cc``
+binds TCP; plasma's UDS stays for the local fast path):
+
+- a filesystem path → AF_UNIX (same-host fast path)
+- ``tcp://host:port`` → AF_INET (cross-host; port 0 = ephemeral, the
+  canonical bound address is ``RpcServer.address``)
 
 Frame: [u64 little-endian length][pickle payload]
 
@@ -23,9 +30,34 @@ import socketserver
 import struct
 import threading
 import traceback
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
+
+
+def is_tcp_address(addr: str) -> bool:
+    return addr.startswith("tcp://")
+
+
+def parse_tcp_address(addr: str) -> Tuple[str, int]:
+    hostport = addr[len("tcp://"):]
+    host, _, port = hostport.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _client_socket(addr: str, timeout: Optional[float]) -> socket.socket:
+    if is_tcp_address(addr):
+        host, port = parse_tcp_address(addr)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(timeout)
+        sock.connect((host, port))
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(addr)
+    sock.settimeout(None)
+    return sock
 
 
 class ConnectionClosed(ConnectionError):
@@ -74,11 +106,20 @@ class RpcServer:
         self.sock_path = sock_path
         self.handler = handler
         self.name = name
-        os.makedirs(os.path.dirname(sock_path), exist_ok=True)
-        if os.path.exists(sock_path):
-            os.unlink(sock_path)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(sock_path)
+        if is_tcp_address(sock_path):
+            host, port = parse_tcp_address(sock_path)
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            # canonical address after an ephemeral (port 0) bind
+            self.address = f"tcp://{host}:{self._sock.getsockname()[1]}"
+        else:
+            os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+            if os.path.exists(sock_path):
+                os.unlink(sock_path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(sock_path)
+            self.address = sock_path
         self._sock.listen(512)
         self._stopped = threading.Event()
         self._accept_thread = threading.Thread(
@@ -138,7 +179,8 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
-        if os.path.exists(self.sock_path):
+        if not is_tcp_address(self.sock_path) \
+                and os.path.exists(self.sock_path):
             try:
                 os.unlink(self.sock_path)
             except OSError:
@@ -154,11 +196,7 @@ class RpcClient:
         self._local = threading.local()
 
     def _connect(self) -> socket.socket:
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self.connect_timeout)
-        sock.connect(self.sock_path)
-        sock.settimeout(None)
-        return sock
+        return _client_socket(self.sock_path, self.connect_timeout)
 
     def _conn(self) -> socket.socket:
         sock = getattr(self._local, "sock", None)

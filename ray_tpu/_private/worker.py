@@ -573,30 +573,30 @@ class CoreWorker:
                         actor_tasks.pop(tid, None)
                     if not actor_tasks:
                         self._inflight_actor.pop(actor_id, None)
-                elif state == "ALIVE":
-                    restarts = info.get("num_restarts", 0)
-                    for tid, (spec, streaming, seen) in tasks.items():
-                        if restarts <= seen:
-                            continue  # same incarnation; still running
-                        if self._call_committed(spec, streaming):
-                            continue
-                        if spec.max_task_retries != 0:
-                            try:
-                                self._route_now(spec, streaming)
-                            except ActorDiedError as e:
-                                self._fail_actor_call(spec, streaming, e)
-                            except (OSError, ConnectionError):
-                                continue  # retry next tick
-                        else:
-                            self._fail_actor_call(
-                                spec, streaming, ActorDiedError(
-                                    actor_id.hex(),
-                                    "actor restarted; in-flight call "
-                                    "lost (set max_task_retries to "
-                                    "resend)"))
-                            with self._inflight_lock:
-                                self._inflight_actor.get(
-                                    actor_id, {}).pop(tid, None)
+            elif state == "ALIVE":
+                restarts = info.get("num_restarts", 0)
+                for tid, (spec, streaming, seen) in tasks.items():
+                    if restarts <= seen:
+                        continue  # same incarnation; still running
+                    if self._call_committed(spec, streaming):
+                        continue
+                    if spec.max_task_retries != 0:
+                        try:
+                            self._route_now(spec, streaming)
+                        except ActorDiedError as e:
+                            self._fail_actor_call(spec, streaming, e)
+                        except (OSError, ConnectionError):
+                            continue  # retry next tick
+                    else:
+                        self._fail_actor_call(
+                            spec, streaming, ActorDiedError(
+                                actor_id.hex(),
+                                "actor restarted; in-flight call "
+                                "lost (set max_task_retries to "
+                                "resend)"))
+                        with self._inflight_lock:
+                            self._inflight_actor.get(
+                                actor_id, {}).pop(tid, None)
 
     def _fail_actor_call(self, spec: TaskSpec, streaming: bool,
                          error: BaseException) -> None:

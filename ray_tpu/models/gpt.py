@@ -221,7 +221,14 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
         attn_fn = functools.partial(local_attention, causal=True)
     constrain = functools.partial(shd.constrain, mesh=mesh)
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # The table is (vocab:tp, d:fsdp)-sharded for the tied head matmul; a
+    # gather across sharded dims makes SPMD replicate it *involuntarily*
+    # ("full rematerialization" warning), and any surviving shard on d
+    # clashes with the batch/seq sharding of the output.  ZeRO-3 semantics:
+    # all-gather the table once, gather, let the output land directly on
+    # its (batch, seq) sharding; the table grad reduce-scatters back.
+    table = constrain(params["embed"].astype(cfg.dtype), (None, None))
+    x = constrain(table[tokens], ("batch", "seq", None))
     if cfg.pos == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
     x = constrain(x, ("batch", "seq", None))

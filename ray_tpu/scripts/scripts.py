@@ -24,6 +24,12 @@ def _find_session_cp_sock() -> Optional[str]:
     sessions = sorted(glob.glob(os.path.join(root, "session_*")),
                       key=os.path.getmtime, reverse=True)
     for session in sessions:
+        # TCP sessions advertise their address in a file; UDS sessions
+        # are found by the socket path itself.
+        addr_file = os.path.join(session, "cp_address")
+        if os.path.exists(addr_file):
+            with open(addr_file) as f:
+                return f.read().strip()
         sock = os.path.join(session, "sockets", "cp.sock")
         if os.path.exists(sock):
             return sock
