@@ -209,6 +209,64 @@ def _moe_ffn(lp, x, cfg: GPTConfig):
     return out.reshape(B, S, d), aux
 
 
+def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
+    """One transformer block: ``(layer params, hidden [B,S,d]) -> (hidden,
+    moe aux)``.  Shared by the stacked ``lax.scan`` in ``forward_hidden``
+    and the per-stage scan in the pipeline-parallel trainer
+    (``models/training.py`` build_gpt_train_pp)."""
+    constrain = functools.partial(shd.constrain, mesh=mesh)
+    h = _norm(x, lp["ln1"], cfg.norm)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    if cfg.pos == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    q = constrain(q, ("batch", "seq", "heads", None))
+    k = constrain(k, ("batch", "seq", "heads", None))
+    v = constrain(v, ("batch", "seq", "heads", None))
+    attn = attn_fn(q, k, v)
+    attn = constrain(attn, ("batch", "seq", "heads", None))
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    h2 = _norm(x, lp["ln2"], cfg.norm)
+    if cfg.n_experts > 0:
+        ffn_out, aux = _moe_ffn(lp, h2, cfg)
+    else:
+        ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
+    x = x + ffn_out
+    x = constrain(x, ("batch", "seq", None))
+    return x, aux
+
+
+def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
+                 mesh=None):
+    """tokens [B, S] -> hidden [B, S, d], sharded (batch, seq).
+
+    The table is (vocab:tp, d:fsdp)-sharded for the tied head matmul; a
+    gather across sharded dims makes SPMD replicate it *involuntarily*
+    ("full rematerialization" warning), and any surviving shard on d
+    clashes with the batch/seq sharding of the output.  ZeRO-3 semantics:
+    all-gather the table once, gather, let the output land directly on
+    its (batch, seq) sharding; the table grad reduce-scatters back.
+    """
+    constrain = functools.partial(shd.constrain, mesh=mesh)
+    S = tokens.shape[1]
+    table = constrain(params["embed"].astype(cfg.dtype), (None, None))
+    x = constrain(table[tokens], ("batch", "seq", None))
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
+    return constrain(x, ("batch", "seq", None))
+
+
+def loss_from_hidden(params, x, targets, cfg: GPTConfig):
+    """(final *normed* hidden [B,S,d], targets [B,S]) -> mean NLL
+    (chunked-CE glue shared by the dense and pipeline-parallel trainers)."""
+    B, S, d = x.shape
+    s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
+                       targets.reshape(B * S))
+    return s / jnp.maximum(n, 1.0)
+
+
 def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
                    attn_fn: Optional[Callable] = None, mesh=None):
     """tokens [B, S] int32 -> (final hidden [B, S, d], moe aux loss).
@@ -220,42 +278,12 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     if attn_fn is None:
         attn_fn = functools.partial(local_attention, causal=True)
     constrain = functools.partial(shd.constrain, mesh=mesh)
-
-    # The table is (vocab:tp, d:fsdp)-sharded for the tied head matmul; a
-    # gather across sharded dims makes SPMD replicate it *involuntarily*
-    # ("full rematerialization" warning), and any surviving shard on d
-    # clashes with the batch/seq sharding of the output.  ZeRO-3 semantics:
-    # all-gather the table once, gather, let the output land directly on
-    # its (batch, seq) sharding; the table grad reduce-scatters back.
-    table = constrain(params["embed"].astype(cfg.dtype), (None, None))
-    x = constrain(table[tokens], ("batch", "seq", None))
-    if cfg.pos == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
-    x = constrain(x, ("batch", "seq", None))
+    x = embed_tokens(params, tokens, cfg, mesh=mesh)
     positions = jnp.arange(S)
 
     def layer_body(x, lp):
-        h = _norm(x, lp["ln1"], cfg.norm)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-        if cfg.pos == "rope":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
-        q = constrain(q, ("batch", "seq", "heads", None))
-        k = constrain(k, ("batch", "seq", "heads", None))
-        v = constrain(v, ("batch", "seq", "heads", None))
-        attn = attn_fn(q, k, v)
-        attn = constrain(attn, ("batch", "seq", "heads", None))
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-        h2 = _norm(x, lp["ln2"], cfg.norm)
-        if cfg.n_experts > 0:
-            ffn_out, aux = _moe_ffn(lp, h2, cfg)
-        else:
-            ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
-        x = x + ffn_out
-        x = constrain(x, ("batch", "seq", None))
-        return x, aux
+        return layer_apply(lp, x, cfg, positions=positions,
+                           attn_fn=attn_fn, mesh=mesh)
 
     if cfg.remat:
         layer_body = jax.checkpoint(layer_body)
@@ -325,10 +353,7 @@ def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
     """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss."""
     x, aux = forward_hidden(params, batch["tokens"], cfg, attn_fn=attn_fn,
                             mesh=mesh)
-    B, S, d = x.shape
-    s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
-                       batch["targets"].reshape(B * S))
-    loss = s / jnp.maximum(n, 1.0)
+    loss = loss_from_hidden(params, x, batch["targets"], cfg)
     return loss + aux_weight * aux
 
 

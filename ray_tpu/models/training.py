@@ -40,6 +40,22 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
     )
 
 
+def _state_shardings(init, param_sh, mesh) -> TrainState:
+    """Shard the full state by structure: params by rules; opt_state leaves
+    that match a param shape inherit that param's sharding; scalars
+    replicate."""
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    shape_to_sh = {}
+    for (path, leaf), sh in zip(jax.tree.leaves_with_path(example.params),
+                                jax.tree.leaves(param_sh)):
+        shape_to_sh[leaf.shape] = sh
+    replicated = NamedSharding(mesh, P())
+    opt_sh = jax.tree.map(lambda leaf: shape_to_sh.get(leaf.shape,
+                                                       replicated),
+                          example.opt_state)
+    return TrainState(param_sh, opt_sh, replicated)
+
+
 def _batch_sharding(mesh):
     seq_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
     return NamedSharding(mesh, P(shd.data_axes(mesh), seq_axis))
@@ -70,25 +86,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         params = gpt_mod.init_params(cfg, key)
         return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
 
-    # Shard the full state by structure: params by rules; opt_state leaves
-    # that match a param shape inherit that param's sharding; scalars
-    # replicate.
-    def state_shardings() -> TrainState:
-        example = jax.eval_shape(init, jax.random.PRNGKey(0))
-        param_leaves = jax.tree.leaves_with_path(example.params)
-        shape_to_sh = {}
-        sh_leaves = jax.tree.leaves(param_sh)
-        for (path, leaf), sh in zip(param_leaves, sh_leaves):
-            shape_to_sh[leaf.shape] = sh
-        replicated = NamedSharding(mesh, P())
-
-        def pick(leaf):
-            return shape_to_sh.get(leaf.shape, replicated)
-
-        opt_sh = jax.tree.map(pick, example.opt_state)
-        return TrainState(param_sh, opt_sh, replicated)
-
-    st_sh = state_shardings()
+    st_sh = _state_shardings(init, param_sh, mesh)
     init_jit = jax.jit(init, out_shardings=st_sh)
 
     @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
@@ -122,6 +120,106 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "state_shardings": st_sh,
         "batch_sharding": batch_sh,
         "attn_fn": attn_fn,
+    }
+
+
+def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
+                       num_microbatches: Optional[int] = None,
+                       optimizer=None) -> Dict[str, Callable]:
+    """Pipeline-parallel GPT training over a mesh with a ``pp`` axis.
+
+    The layer stack ``[L, ...]`` is reshaped to ``[pp, L/pp, ...]`` and
+    sharded stage-wise; the forward runs a GPipe schedule
+    (``parallel/pipeline.py``) with each stage scanning its local layers.
+    Embedding/loss run outside the pipeline (replicated over pp, sharded
+    over dp/tp as usual); dp/fsdp/tp compose inside each stage via the
+    partial-manual shard_map.  TPU-native counterpart of the reference's
+    DeepSpeed-delegated pipeline parallelism (SURVEY §2.4).
+    """
+    from ray_tpu.parallel import pipeline as pipe
+    from ray_tpu.parallel.ring_attention import local_attention
+
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if cfg.n_experts > 0:
+        raise ValueError("MoE + pipeline parallelism not supported yet")
+    Ls = cfg.n_layers // pp
+    M = num_microbatches or 2 * pp
+    tx = optimizer or default_optimizer()
+
+    logical = gpt_mod.param_logical_axes(cfg)
+    is_axes = lambda x: (isinstance(x, tuple) and all(  # noqa: E731
+        isinstance(a, (str, type(None))) for a in x))
+    logical["layers"] = jax.tree.map(lambda axes: ("stage",) + axes,
+                                     logical["layers"], is_leaf=is_axes)
+    param_sh = shd.tree_shardings(mesh, logical)
+    batch_sh = _batch_sharding(mesh)
+    attn = functools.partial(local_attention, causal=True)
+    # stage params enter the shard_map split on dim 0 (pp) only; their
+    # within-stage tp/fsdp sharding flows through the auto axes.
+    stage_spec = jax.tree.map(lambda leaf: P("pp"), logical["layers"],
+                              is_leaf=is_axes)
+
+    def init(key) -> TrainState:
+        params = gpt_mod.init_params(cfg, key)
+        params["layers"] = jax.tree.map(
+            lambda leaf: leaf.reshape((pp, Ls) + leaf.shape[1:]),
+            params["layers"])
+        return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+    def loss(params, batch):
+        tokens, targets = batch["tokens"], batch["targets"]
+        B, S = tokens.shape
+        if B % M:
+            raise ValueError(f"batch={B} not divisible by microbatches={M}")
+        positions = jnp.arange(S)
+        x = gpt_mod.embed_tokens(params, tokens, cfg, mesh=mesh)
+        d = x.shape[-1]
+        xs = x.reshape(M, B // M, S, d)
+
+        def stage_fn(sp, a):
+            def body(c, lp):
+                y, _aux = gpt_mod.layer_apply(lp, c, cfg,
+                                              positions=positions,
+                                              attn_fn=attn)
+                return y, None
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            a, _ = jax.lax.scan(body, a, sp)
+            return a
+
+        out = pipe.pipeline_apply(stage_fn, params["layers"], xs,
+                                  mesh=mesh, num_microbatches=M,
+                                  params_spec=stage_spec)
+        h = out.reshape(B, S, d)
+        h = gpt_mod._norm(h, params["ln_f"], cfg.norm)
+        return gpt_mod.loss_from_hidden(params, h, targets, cfg)
+
+    st_sh = _state_shardings(init, param_sh, mesh)
+    init_jit = jax.jit(init, out_shardings=st_sh)
+
+    @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
+                       out_shardings=(st_sh, None), donate_argnums=(0,))
+    def step(state: TrainState, batch):
+        loss_val, grads = jax.value_and_grad(loss)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1),
+                {"loss": loss_val, "grad_norm": optax.global_norm(grads),
+                 "step": state.step + 1})
+
+    @functools.partial(jax.jit, in_shardings=(st_sh.params, batch_sh))
+    def loss_eval(params, batch):
+        return loss(params, batch)
+
+    return {
+        "init_fn": init_jit,
+        "step_fn": step,
+        "loss_fn": loss_eval,
+        "state_shardings": st_sh,
+        "batch_sharding": batch_sh,
+        "num_microbatches": M,
     }
 
 

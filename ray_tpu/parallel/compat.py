@@ -7,19 +7,31 @@ from typing import Any
 
 
 def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
-              check: bool = False):
+              check: bool = False, axis_names=None):
     """Uniform shard_map wrapper with replication checking disabled.
 
     The manual collectives here (ppermute rings, all_to_all) confuse the
     replication checker on some jax versions; numerical tests cover
     correctness instead.
+
+    ``axis_names`` (jax >= 0.8): partial-manual mode — only the named mesh
+    axes are manual inside the body; the rest stay automatic, so sharding
+    constraints on them still propagate (used by the pipeline layer to be
+    manual over ``pp`` while dp/fsdp/tp compose automatically).
     """
     import jax
 
     def wrap(fn):
         if hasattr(jax, "shard_map"):
+            kw = {}
+            if axis_names is not None:
+                kw["axis_names"] = frozenset(axis_names)
             return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=check)
+                                 out_specs=out_specs, check_vma=check,
+                                 **kw)
+        if axis_names is not None:
+            raise NotImplementedError(
+                "partial-manual shard_map needs jax.shard_map (jax>=0.8)")
         from jax.experimental.shard_map import shard_map as _sm
         return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=check)
@@ -27,3 +39,12 @@ def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
     if f is None:
         return wrap
     return wrap(f)
+
+
+def supports_partial_manual() -> bool:
+    import inspect
+
+    import jax
+    if not hasattr(jax, "shard_map"):
+        return False
+    return "axis_names" in inspect.signature(jax.shard_map).parameters

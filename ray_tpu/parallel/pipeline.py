@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ray_tpu.parallel.compat import shard_map
+from ray_tpu.parallel.compat import shard_map, supports_partial_manual
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh,
@@ -38,8 +38,17 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh,
         (defaults to sharding dim 0 over pp, rest replicated).
 
     Returns the last stage's outputs, ``[M, mb, ...]``.
+
+    On jax>=0.8 the shard_map is *partial-manual*: only ``pp`` is manual,
+    so dp/fsdp/tp shardings inside ``stage_fn`` compose automatically
+    (XLA partitions the within-stage math as usual).
     """
     pp = mesh.shape["pp"]
+    xs_m = jax.tree.leaves(x)[0].shape[0]
+    if xs_m != num_microbatches:
+        raise ValueError(f"x leading dim {xs_m} != "
+                         f"num_microbatches {num_microbatches}")
+    partial_manual = supports_partial_manual()
     if params_spec is None:
         params_spec = jax.tree.map(
             lambda leaf: P("pp", *([None] * (leaf.ndim - 1))),
@@ -47,7 +56,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, *, mesh,
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(params_spec, P()), out_specs=P())
+        in_specs=(params_spec, P()), out_specs=P(),
+        axis_names={"pp"} if partial_manual else None)
     def run(params, xs):
         # params leaves: [1, ...] local stage slice -> squeeze
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)

@@ -101,10 +101,14 @@ def constrain(x, logical_axes: Sequence[Optional[str]],
                 return x
         except Exception:  # noqa: BLE001
             return x
-    spec = logical_to_spec(logical_axes, rules,
-                           mesh if hasattr(mesh, "shape") else None)
-    return with_sharding_constraint(x, NamedSharding(mesh, spec) if
-                                    hasattr(mesh, "devices") else spec)
+    try:
+        shaped = mesh if mesh.shape else None
+    except Exception:  # noqa: BLE001 — AbstractMesh may refuse attributes
+        shaped = None
+    spec = logical_to_spec(logical_axes, rules, shaped)
+    concrete = isinstance(mesh, jax.sharding.Mesh)
+    return with_sharding_constraint(
+        x, NamedSharding(mesh, spec) if concrete else spec)
 
 
 def shard_params(params, mesh, logical_tree, rules: Optional[Rules] = None):
