@@ -4,13 +4,20 @@ API parity: reference ``python/ray/util/collective/collective.py``
 (init_collective_group, allreduce, allgather, reducescatter, broadcast,
 barrier, send, recv).  Backends:
 
-- ``"host"`` (gloo-equivalent): host-memory arrays, rendezvous through a
-  named async actor (the reference's ``NCCLUniqueIDStore`` pattern —
-  ``collective_group/nccl_collective_group.py`` Rendezvous) which also
-  performs the reduction.  Correctness-first; data rides the object store.
-- ``"xla"`` (NCCL-replacement): arrays are sharded over this process's
-  device mesh and reduced by XLA collectives over ICI — used inside SPMD
-  worker groups where each actor owns a slice of chips.
+- ``"host"`` (gloo-equivalent): ring collectives over p2p sends, like the
+  reference's ring NCCL (``collective_group/nccl_collective_group.py:402``).
+  Payloads ride the shm object store worker-to-worker; the rendezvous
+  actor only shuttles ObjectRefs (control plane), so per-rank traffic is
+  O(2·N·(W-1)/W) and no single process sees more than its ring share.
+- ``"xla"``: arrays are sharded over this process's device mesh and
+  reduced by XLA collectives over ICI — used inside SPMD worker groups
+  where each actor owns a slice of chips.
+- ``"ici"``: multi-process device world — rank 0 publishes a coordinator
+  address in the control-plane KV, every rank calls
+  ``jax.distributed.initialize``, and verbs execute as XLA collectives
+  over ICI/DCN on the *global* device set.  ``global_mesh()`` exposes the
+  multi-process mesh for pjit programs (gradients should move inside
+  pjit, not through verbs).
 
 Group state is per-process, keyed by group name (reference
 ``GroupManager``).
@@ -30,12 +37,11 @@ from ray_tpu.actor import get_actor
 _groups: Dict[str, "BaseGroup"] = {}
 _lock = threading.Lock()
 
-REDUCE_OPS = {
-    "sum": lambda arrs: _tree_reduce(arrs, np.add),
-    "product": lambda arrs: _tree_reduce(arrs, np.multiply),
-    "min": lambda arrs: _tree_reduce(arrs, np.minimum),
-    "max": lambda arrs: _tree_reduce(arrs, np.maximum),
-}
+_BINOPS = {"sum": np.add, "product": np.multiply, "min": np.minimum,
+           "max": np.maximum}
+
+REDUCE_OPS = {name: (lambda arrs, f=f: _tree_reduce(arrs, f))
+              for name, f in _BINOPS.items()}
 
 
 def _tree_reduce(arrs, op):
@@ -91,12 +97,17 @@ class CollectiveStore:
         self._p2p_events[key].set()
 
     async def get_p2p(self, key: str):
+        """Return the mailbox entry WITHOUT popping: the mailbox must keep
+        the contained ObjectRef alive until the receiver has fetched the
+        payload (``ack_p2p``), else GC can free the object in flight."""
         if key not in self._p2p_events:
             self._p2p_events[key] = asyncio.Event()
         await self._p2p_events[key].wait()
-        value = self._p2p.pop(key)
+        return self._p2p[key]
+
+    async def ack_p2p(self, key: str):
+        self._p2p.pop(key, None)
         self._p2p_events.pop(key, None)
-        return value
 
 
 class BaseGroup:
@@ -112,7 +123,12 @@ class BaseGroup:
 
 
 class HostGroup(BaseGroup):
-    """Host-memory collectives through the rendezvous actor."""
+    """Ring collectives; payloads via the object store, refs via mailbox.
+
+    Every rank calls each verb in the same order (standard collective
+    contract), so the per-group op sequence numbers agree across ranks
+    and key the per-step mailboxes.
+    """
 
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
@@ -137,44 +153,144 @@ class HostGroup(BaseGroup):
                     time.sleep(0.05)
 
     def _exchange(self, verb: str, value: Any) -> List[Any]:
+        """Full gather through the actor — only for tiny payloads
+        (barrier tokens, broadcast refs)."""
         op = self._next_op(verb)
         return ray_tpu.get(self.store.gather.remote(op, self.rank, value))
 
+    # -- ring plumbing ------------------------------------------------
+    def _ring_send(self, op: str, step: int, dst: int, arr) -> None:
+        key = f"{op}:s{step}:{self.rank}->{dst}"
+        ref = ray_tpu.put(np.ascontiguousarray(arr))
+        # wait for the ack so the mailbox holds (and refcounts) the ref
+        # before our local handle can be dropped
+        ray_tpu.get(self.store.put_p2p.remote(key, [ref]))
+
+    def _ring_recv(self, op: str, step: int, src: int):
+        key = f"{op}:s{step}:{src}->{self.rank}"
+        (ref,) = ray_tpu.get(self.store.get_p2p.remote(key))
+        value = ray_tpu.get(ref)
+        self.store.ack_p2p.remote(key)  # safe to drop now that we hold it
+        return value
+
+    def _ring_reduce_scatter(self, op_id: str, chunks, binop):
+        """In-place ring reduce-scatter; afterwards chunk[(rank+1) % W]
+        holds the full reduction on this rank."""
+        W, r = self.world_size, self.rank
+        nxt, prv = (r + 1) % W, (r - 1) % W
+        for step in range(W - 1):
+            send_idx = (r - step) % W
+            recv_idx = (r - step - 1) % W
+            self._ring_send(op_id, step, nxt, chunks[send_idx])
+            chunks[recv_idx] = binop(chunks[recv_idx],
+                                     self._ring_recv(op_id, step, prv))
+        return chunks
+
+    def _ring_allgather(self, op_id: str, chunks, owned_idx: int):
+        """Circulate chunks so every rank ends with all of them;
+        ``owned_idx`` is the chunk this rank holds authoritative data
+        for at the start."""
+        W, r = self.world_size, self.rank
+        nxt, prv = (r + 1) % W, (r - 1) % W
+        for step in range(W - 1):
+            send_idx = (owned_idx - step) % W
+            recv_idx = (owned_idx - step - 1) % W
+            self._ring_send(op_id, step, nxt, chunks[send_idx])
+            chunks[recv_idx] = self._ring_recv(op_id, step, prv)
+        return chunks
+
+    # -- verbs --------------------------------------------------------
     def allreduce(self, tensor, op: str = "sum"):
-        arrs = self._exchange("allreduce", np.asarray(tensor))
-        return REDUCE_OPS[op](arrs)
+        arr = np.asarray(tensor)
+        W = self.world_size
+        if W == 1:
+            return arr
+        binop = _BINOPS[op]
+        flat = arr.reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, W)]
+        op_rs = self._next_op("ar_rs")
+        op_ag = self._next_op("ar_ag")
+        chunks = self._ring_reduce_scatter(op_rs, chunks, binop)
+        chunks = self._ring_allgather(op_ag, chunks,
+                                      (self.rank + 1) % W)
+        return np.concatenate(chunks).reshape(arr.shape)
 
     def allgather(self, tensor) -> List[np.ndarray]:
-        return [np.asarray(a) for a in
-                self._exchange("allgather", np.asarray(tensor))]
+        arr = np.asarray(tensor)
+        W = self.world_size
+        if W == 1:
+            return [arr]
+        chunks: List[Any] = [None] * W
+        chunks[self.rank] = arr
+        op_ag = self._next_op("ag")
+        chunks = self._ring_allgather(op_ag, chunks, self.rank)
+        return [np.asarray(c) for c in chunks]
 
     def reducescatter(self, tensor, op: str = "sum"):
-        arrs = self._exchange("reducescatter", np.asarray(tensor))
-        red = REDUCE_OPS[op](arrs)
-        return np.array_split(red, self.world_size)[self.rank]
+        arr = np.asarray(tensor)
+        W = self.world_size
+        if W == 1:
+            return arr
+        binop = _BINOPS[op]
+        chunks = [c.copy() for c in np.array_split(arr, W)]
+        op_rs = self._next_op("rs")
+        chunks = self._ring_reduce_scatter(op_rs, chunks, binop)
+        # rank holds chunk (rank+1)%W reduced; route it to its owner
+        op_mv = self._next_op("rs_mv")
+        owner = (self.rank + 1) % W
+        if owner != self.rank:
+            self._ring_send(op_mv, 0, owner, chunks[owner])
+            mine = self._ring_recv(op_mv, 0, (self.rank - 1) % W)
+        else:
+            mine = chunks[owner]
+        return np.asarray(mine)
 
     def broadcast(self, tensor, src_rank: int = 0):
-        arrs = self._exchange("broadcast",
-                              np.asarray(tensor) if self.rank == src_rank
-                              else None)
-        return np.asarray(arrs[src_rank])
+        # one put by src; everyone else pulls the ref from the store
+        if self.rank == src_rank:
+            ref = ray_tpu.put(np.asarray(tensor))
+            arrs = self._exchange("broadcast", [ref])
+        else:
+            arrs = self._exchange("broadcast", None)
+        (ref,) = arrs[src_rank]
+        return np.asarray(ray_tpu.get(ref))
 
     def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
-        arrs = self._exchange("reduce", np.asarray(tensor))
-        if self.rank == dst_rank:
-            return REDUCE_OPS[op](arrs)
-        return np.asarray(tensor)
+        arr = np.asarray(tensor)
+        W = self.world_size
+        if W == 1:
+            return arr
+        binop = _BINOPS[op]
+        chunks = [c.copy() for c in np.array_split(arr.reshape(-1), W)]
+        op_rs = self._next_op("red_rs")
+        chunks = self._ring_reduce_scatter(op_rs, chunks, binop)
+        # every rank sends its reduced chunk to dst
+        op_gv = self._next_op("red_gather")
+        mine_idx = (self.rank + 1) % W
+        if self.rank != dst_rank:
+            self._ring_send(op_gv, mine_idx, dst_rank, chunks[mine_idx])
+            return arr
+        for i in range(W):
+            src = (i - 1) % W
+            if src == dst_rank:
+                continue
+            chunks[i] = self._ring_recv(op_gv, i, src)
+        return np.concatenate(chunks).reshape(arr.shape)
 
     def barrier(self):
         self._exchange("barrier", None)
 
     def send(self, tensor, dst_rank: int, tag: int = 0):
         key = f"{self.group_name}:p2p:{self.rank}->{dst_rank}:{tag}"
-        ray_tpu.get(self.store.put_p2p.remote(key, np.asarray(tensor)))
+        ref = ray_tpu.put(np.asarray(tensor))
+        ray_tpu.get(self.store.put_p2p.remote(key, [ref]))
 
     def recv(self, src_rank: int, tag: int = 0):
         key = f"{self.group_name}:p2p:{src_rank}->{self.rank}:{tag}"
-        return np.asarray(ray_tpu.get(self.store.get_p2p.remote(key)))
+        (ref,) = ray_tpu.get(self.store.get_p2p.remote(key))
+        value = np.asarray(ray_tpu.get(ref))
+        self.store.ack_p2p.remote(key)
+        return value
 
     def destroy(self):
         pass
@@ -198,44 +314,208 @@ class XlaGroup(BaseGroup):
         from ray_tpu.parallel.mesh import make_mesh
         self.mesh = make_mesh(dp=len(self.devices), devices=self.devices)
 
-    def _psum(self, x):
+    def _run_manual(self, x, body, out_spec=None):
+        """device_put x split on dim 0, run ``body(shard)`` under
+        shard_map over dp, return the result."""
         import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
-        sharded = jax.device_put(
-            x, NamedSharding(self.mesh, P("dp")))
 
-        @jax.jit
-        def reduce_fn(a):
-            from ray_tpu.parallel.compat import shard_map
-            import functools
-            return shard_map(
-                lambda s: jax.lax.psum(s, "dp"), mesh=self.mesh,
-                in_specs=P("dp"), out_specs=P())(a)
-        return reduce_fn(sharded)
+        from ray_tpu.parallel.compat import shard_map
+        sharded = jax.device_put(x, NamedSharding(self.mesh, P("dp")))
+        fn = shard_map(body, mesh=self.mesh, in_specs=P("dp"),
+                       out_specs=P() if out_spec is None else out_spec)
+        return jax.jit(fn)(sharded)
 
     def allreduce(self, tensor, op: str = "sum"):
         """Leading axis of ``tensor`` = per-device contributions."""
-        assert op == "sum", "xla backend supports sum"
+        import jax
+        import jax.numpy as jnp
         x = np.asarray(tensor)
-        return np.asarray(self._psum(x))
+        if op == "sum":
+            body = lambda s: jax.lax.psum(s, "dp")           # noqa: E731
+        elif op == "max":
+            body = lambda s: jax.lax.pmax(s, "dp")           # noqa: E731
+        elif op == "min":
+            body = lambda s: jax.lax.pmin(s, "dp")           # noqa: E731
+        elif op == "product":
+            body = lambda s: jnp.prod(                        # noqa: E731
+                jax.lax.all_gather(s, "dp"), axis=0)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+        return np.asarray(self._run_manual(x, body))
+
+    def allgather(self, tensor) -> List[np.ndarray]:
+        import jax
+        x = np.asarray(tensor)
+        out = self._run_manual(
+            x, lambda s: jax.lax.all_gather(s, "dp"))
+        return [np.asarray(o) for o in out]
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        """Per-device rows reduced then scattered; returns the host copy
+        of every device's shard stacked on dim 0 (single process owns
+        all shards)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        assert op == "sum", "xla reducescatter supports sum"
+        x = np.asarray(tensor)
+        if x.shape[0] != len(self.devices):
+            raise ValueError(
+                f"xla reducescatter needs one leading row per device "
+                f"({len(self.devices)}), got shape {x.shape}")
+        out = self._run_manual(
+            x, lambda s: jax.lax.psum_scatter(
+                s[0], "dp", scatter_dimension=0, tiled=True)[None],
+            out_spec=P("dp"))
+        return np.asarray(out)
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        return np.asarray(tensor)  # single process: already everywhere
 
     def barrier(self):
-        import numpy as np
-        self._psum(np.zeros((len(self.devices),), np.float32))
+        import jax
+        self._run_manual(np.zeros((len(self.devices),), np.float32),
+                         lambda s: jax.lax.psum(s, "dp"))
+
+
+class IciGroup(BaseGroup):
+    """Multi-process device world over ``jax.distributed``.
+
+    The TPU-native replacement for NCCL process groups (SURVEY §2.3):
+    rank 0 publishes ``ip:port`` under a control-plane KV key; every rank
+    calls ``jax.distributed.initialize(coordinator, world, rank)``; after
+    that ``jax.devices()`` is the global device set and verbs execute as
+    XLA collectives over ICI/DCN.  Big tensors should be moved inside
+    pjit programs over ``global_mesh()`` — the verbs here are for
+    control-plane reductions (metrics, losses, small grads).
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 coordinator: Optional[str] = None, timeout: float = 60.0):
+        super().__init__(world_size, rank, group_name)
+        import jax
+
+        # NB: probe distributed state without jax.process_count() — that
+        # would initialize the XLA backend and forbid initialize().
+        from jax._src import distributed as _jd
+        already = getattr(_jd.global_state, "client", None) is not None
+        if already:
+            # reuse the live world; rank 0 republishes its coordinator so
+            # fresh ranks don't rendezvous on an address nobody serves
+            coordinator = coordinator or getattr(
+                _jd.global_state, "coordinator_address", None)
+            if rank == 0 and coordinator:
+                self._publish(coordinator)
+        else:
+            if coordinator is None:
+                coordinator = self._rendezvous(timeout)
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=world_size,
+                                       process_id=rank)
+        self.coordinator = coordinator
+
+    @property
+    def _kv_key(self) -> bytes:
+        return f"__ici_coordinator_{self.group_name}".encode()
+
+    def _publish(self, coordinator: str) -> None:
+        from ray_tpu._private.worker import global_worker
+        global_worker().cp.kv_put(self._kv_key, coordinator.encode(),
+                                  namespace="_collective")
+
+    def _rendezvous(self, timeout: float) -> str:
+        import time
+
+        from ray_tpu._private.worker import global_worker
+        worker = global_worker()
+        if self.rank == 0:
+            import socket
+            s = socket.socket()
+            s.bind(("0.0.0.0", 0))
+            port = s.getsockname()[1]
+            s.close()
+            node = worker.cp.get_node(worker.node_id) or {}
+            ip = node.get("ip") or "127.0.0.1"
+            coordinator = f"{ip}:{port}"
+            self._publish(coordinator)
+            return coordinator
+        t0 = time.time()
+        while True:
+            raw = worker.cp.kv_get(self._kv_key, namespace="_collective")
+            if raw:
+                return raw.decode()
+            if time.time() - t0 > timeout:
+                raise TimeoutError(
+                    f"no ici coordinator published for group "
+                    f"{self.group_name!r} within {timeout}s")
+            time.sleep(0.05)
+
+    def global_mesh(self, **axes):
+        """A mesh over the global (all-process) device set."""
+        import jax
+
+        from ray_tpu.parallel.mesh import make_mesh
+        if not axes:
+            axes = {"dp": -1}
+        return make_mesh(devices=jax.devices(), **axes)
+
+    def allreduce(self, tensor, op: str = "sum"):
+        import jax.numpy as jnp
+
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(np.asarray(tensor)))
+        return np.asarray(REDUCE_OPS[op](list(np.asarray(gathered))))
+
+    def allgather(self, tensor) -> List[np.ndarray]:
+        import jax.numpy as jnp
+
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(np.asarray(tensor)))
+        return [np.asarray(g) for g in np.asarray(gathered)]
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        red = self.allreduce(tensor, op=op)
+        return np.array_split(red, self.world_size)[self.rank]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        arrs = self.allgather(np.asarray(tensor))
+        return arrs[src_rank]
+
+    def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
+        red = self.allreduce(tensor, op=op)
+        return red if self.rank == dst_rank else np.asarray(tensor)
+
+    def barrier(self):
+        from jax.experimental import multihost_utils
+        self._seq += 1
+        multihost_utils.sync_global_devices(
+            f"{self.group_name}:barrier:{self._seq}")
+
+    def destroy(self):
+        if self.rank == 0:
+            try:
+                from ray_tpu._private.worker import global_worker
+                global_worker().cp.kv_del(self._kv_key,
+                                          namespace="_collective")
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
 
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
-                          group_name: str = "default") -> None:
+                          group_name: str = "default", **kwargs) -> None:
     """Register this process/actor as ``rank`` of a collective group."""
     with _lock:
         if group_name in _groups:
             raise ValueError(f"group {group_name!r} already initialized")
         if backend in ("host", "cpu", "gloo"):
             group = HostGroup(world_size, rank, group_name)
-        elif backend in ("xla", "ici", "tpu", "nccl"):
-            group = XlaGroup(world_size, rank, group_name)
+        elif backend in ("xla", "tpu", "nccl"):
+            group = XlaGroup(world_size, rank, group_name, **kwargs)
+        elif backend == "ici":
+            group = IciGroup(world_size, rank, group_name, **kwargs)
         else:
             raise ValueError(f"unknown backend {backend!r}")
         _groups[group_name] = group

@@ -121,3 +121,85 @@ def test_actor_pool(ray_start_regular):
     pool = ActorPool([Doubler.remote(), Doubler.remote()])
     out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
     assert out == [2, 4, 6, 8]
+
+
+def test_host_ring_ops_world4(ray_start_regular):
+    """Ring reduce-scatter/allgather with every reduce op (parity:
+    reference nccl_collective_group ring allreduce)."""
+    ray = ray_start_regular
+
+    @ray.remote
+    class W:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+            collective.init_collective_group(world, rank, backend="host",
+                                             group_name="ring4")
+            self.rank = rank
+
+        def run(self):
+            from ray_tpu.util import collective
+            r = self.rank
+            out = {}
+            out["sum"] = collective.allreduce(
+                np.arange(10.0) + r, group_name="ring4")
+            out["max"] = collective.allreduce(
+                np.full(5, float(r)), group_name="ring4", op="max")
+            out["min"] = collective.allreduce(
+                np.full(5, float(r)), group_name="ring4", op="min")
+            out["product"] = collective.allreduce(
+                np.full(3, 2.0), group_name="ring4", op="product")
+            out["rs"] = collective.reducescatter(
+                np.ones((8, 2)) * (r + 1), group_name="ring4")
+            out["reduce"] = collective.reduce(
+                np.full(6, float(r + 1)), dst_rank=1, group_name="ring4")
+            return out
+
+    world = 4
+    ws = [W.remote(r, world) for r in range(world)]
+    outs = ray.get([w.run.remote() for w in ws], timeout=120)
+    base = np.arange(10.0)
+    for r, o in enumerate(outs):
+        np.testing.assert_allclose(o["sum"], base * 4 + 6)
+        np.testing.assert_allclose(o["max"], np.full(5, 3.0))
+        np.testing.assert_allclose(o["min"], np.zeros(5))
+        np.testing.assert_allclose(o["product"], np.full(3, 16.0))
+        # reducescatter: rows summed across ranks -> 1+2+3+4 = 10
+        np.testing.assert_allclose(o["rs"], np.ones((2, 2)) * 10)
+    np.testing.assert_allclose(outs[1]["reduce"], np.full(6, 10.0))
+    # non-dst ranks return their input unchanged
+    np.testing.assert_allclose(outs[0]["reduce"], np.full(6, 1.0))
+
+
+def test_ici_backend_two_process_world(ray_start_regular):
+    """Two actor processes form one jax.distributed world (gloo on CPU;
+    ICI/DCN on TPU pods) and run XLA collectives across it."""
+    ray = ray_start_regular
+
+    @ray.remote
+    class W:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+            collective.init_collective_group(world, rank, backend="ici",
+                                             group_name="ici1")
+            self.rank = rank
+
+        def world_info(self):
+            import jax
+            return (jax.process_count(), jax.device_count())
+
+        def run(self):
+            from ray_tpu.util import collective
+            s = collective.allreduce(np.full(4, self.rank + 1.0),
+                                     group_name="ici1")
+            g = collective.allgather(np.array([float(self.rank)]),
+                                     group_name="ici1")
+            collective.barrier(group_name="ici1")
+            return s, g
+
+    ws = [W.remote(r, 2) for r in range(2)]
+    infos = ray.get([w.world_info.remote() for w in ws], timeout=120)
+    assert all(pc == 2 for pc, _ in infos)
+    outs = ray.get([w.run.remote() for w in ws], timeout=120)
+    for s, g in outs:
+        np.testing.assert_allclose(s, np.full(4, 3.0))
+        assert [float(a[0]) for a in g] == [0.0, 1.0]
