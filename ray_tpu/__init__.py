@@ -46,7 +46,8 @@ def init(num_cpus: Optional[float] = None,
         from ray_tpu._private.node import HeadNode
         node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
                         resources=resources, namespace=namespace,
-                        system_config=_system_config)
+                        system_config=_system_config,
+                        session_name=kwargs.pop("session_name", None))
         _worker_mod.set_global_worker(node.worker, node)
         return get_runtime_context()
 

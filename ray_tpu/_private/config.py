@@ -114,6 +114,16 @@ _d("health_check_period_s", 1.0, "Control-plane liveness probe period.")
 _d("health_check_timeout_s", 10.0, "Misses before a node is declared dead.")
 _d("lineage_max_bytes", 64 * 1024 * 1024,
    "Budget for retained lineage specs per worker.")
+_d("cp_persistence", False,
+   "Journal durable control-plane tables to <session>/cp_journal.bin so "
+   "a restarted head (init(session_name=<old>)) restores cluster "
+   "metadata and surviving nodes reconnect (reference: GCS Redis "
+   "persistence, redis_store_client.cc).")
+_d("cp_journal_sync", False,
+   "fsync the control-plane journal on every record (durable against "
+   "host crash, slower).")
+_d("cp_journal_compact_records", 100_000,
+   "Snapshot-compact the journal once this many records accumulate.")
 
 # --- networking ------------------------------------------------------------
 _d("use_tcp", False,

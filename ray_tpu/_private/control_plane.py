@@ -52,8 +52,13 @@ class _Waiters:
 
 
 class ControlPlane:
-    def __init__(self):
+    def __init__(self, journal=None):
         self._lock = threading.RLock()
+        # persistence: append-only journal of durable mutations
+        # (``persistence.py``; reference: redis_store_client.cc).  Set
+        # after construction via attach_journal() when restoring.
+        self._journal = journal
+        self._replaying = False
         # internal KV (function table, runtime metadata, user internal_kv)
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         # object directory: id -> location dict
@@ -93,6 +98,73 @@ class ControlPlane:
         self._counters: Dict[str, int] = defaultdict(int)
         self.start_time = time.time()
 
+    # ----------------------------------------------------- persistence ----
+    def _j(self, op: str, *args) -> None:
+        if self._journal is not None and not self._replaying:
+            self._journal.append(op, args)
+
+    def attach_journal(self, journal) -> None:
+        self._journal = journal
+
+    def dump_state(self) -> Dict[str, Any]:
+        """Durable tables only (snapshot compaction payload)."""
+        with self._lock:
+            return {
+                "kv": dict(self._kv),
+                "objects": {k: dict(v) for k, v in self._objects.items()},
+                "inline_data": dict(self._inline_data),
+                "actors": {k: dict(v) for k, v in self._actors.items()},
+                "named_actors": dict(self._named_actors),
+                "nodes": {k: dict(v) for k, v in self._nodes.items()},
+                "placement_groups": {
+                    k: dict(v) for k, v in self._placement_groups.items()},
+            }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self._kv = dict(state.get("kv", {}))
+            self._objects = {k: dict(v) for k, v in
+                             state.get("objects", {}).items()}
+            self._inline_data = dict(state.get("inline_data", {}))
+            self._actors = {k: dict(v) for k, v in
+                            state.get("actors", {}).items()}
+            self._named_actors = dict(state.get("named_actors", {}))
+            self._nodes = {k: dict(v) for k, v in
+                           state.get("nodes", {}).items()}
+            self._placement_groups = {
+                k: dict(v) for k, v in
+                state.get("placement_groups", {}).items()}
+
+    def post_restore(self) -> None:
+        """Fixups after replay: give restored nodes one fresh heartbeat
+        window to reconnect (survivors re-heartbeat within 1s over the
+        rebound socket; the death watcher reaps the rest)."""
+        now = time.time()
+        with self._lock:
+            for info in self._nodes.values():
+                if info.get("state") == "ALIVE":
+                    info["last_heartbeat"] = now
+        self._object_waiters.notify()
+        self._actor_waiters.notify()
+        self._pg_waiters.notify()
+
+    def compact_journal(self) -> bool:
+        """Snapshot-compact now. Holds the CP lock across dump+swap so a
+        mutation can't append to the old file after the snapshot was
+        taken (that record would vanish in the swap)."""
+        j = self._journal
+        if j is None:
+            return False
+        with self._lock:
+            j.compact(self.dump_state())
+        return True
+
+    def maybe_compact(self, threshold: int = 100_000) -> bool:
+        j = self._journal
+        if j is None or j._records_since_snapshot < threshold:
+            return False
+        return self.compact_journal()
+
     # ------------------------------------------------------------- KV ----
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
                namespace: str = "default") -> bool:
@@ -101,6 +173,7 @@ class ControlPlane:
             if not overwrite and k in self._kv:
                 return False
             self._kv[k] = bytes(value)
+            self._j("kv_put", bytes(key), bytes(value), overwrite, namespace)
             return True
 
     def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
@@ -109,7 +182,10 @@ class ControlPlane:
 
     def kv_del(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
-            return self._kv.pop((namespace, bytes(key)), None) is not None
+            hit = self._kv.pop((namespace, bytes(key)), None) is not None
+            if hit:
+                self._j("kv_del", bytes(key), namespace)
+            return hit
 
     def kv_exists(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
@@ -130,6 +206,7 @@ class ControlPlane:
                 "where": "inline", "size": len(data), "error": is_error,
                 "owner": owner, "commit_time": time.time(),
             }
+            self._j("put_inline", object_id, data, is_error, owner)
         self._object_waiters.notify()
 
     def commit_shm(self, object_id: bytes, size: int,
@@ -141,6 +218,7 @@ class ControlPlane:
                 "error": is_error, "owner": owner,
                 "commit_time": time.time(),
             }
+            self._j("commit_shm", object_id, size, node_id, is_error, owner)
         self._object_waiters.notify()
 
     def get_location(self, object_id: bytes) -> Optional[Dict[str, Any]]:
@@ -185,6 +263,8 @@ class ControlPlane:
                     self._objects.pop(o, None)
                     self._inline_data.pop(o, None)
                     freed += 1
+            if freed:
+                self._j("free_objects", [bytes(o) for o in object_ids])
         return freed
 
     # ------------------------------------------------ refcounting / GC ----
@@ -198,13 +278,15 @@ class ControlPlane:
                 if held[oid] == 0:
                     held.pop(oid)
                 total = self._ref_totals[oid] + d
-                if total:
+                if total > 0:
                     self._ref_totals[oid] = total
                     self._zero_since.pop(oid, None)
                 else:
-                    # d == 0 (ref born and dropped within one flush
-                    # window) still marks the object as once-tracked and
-                    # now unreferenced
+                    # total <= 0: d == 0 (ref born and dropped within one
+                    # flush window) or a negative delta against untracked
+                    # state (e.g. a survivor dropping a ref the restored
+                    # head never saw) — either way the object is now
+                    # unreferenced
                     self._ref_totals.pop(oid, None)
                     self._zero_since.setdefault(oid, now)
             if not held:
@@ -237,6 +319,8 @@ class ControlPlane:
                 self._objects.pop(oid, None)
                 self._inline_data.pop(oid, None)
                 self._zero_since.pop(oid, None)
+            if victims:
+                self._j("free_objects", victims)
             # forget zero-marks for ids that were never committed
             stale = [oid for oid, t0 in self._zero_since.items()
                      if t0 < cutoff - 60.0]
@@ -292,6 +376,7 @@ class ControlPlane:
             info.setdefault("num_restarts", 0)
             info["actor_id"] = actor_id
             self._actors[actor_id] = info
+            self._j("register_actor", actor_id, info)
         self._actor_waiters.notify()
 
     def update_actor(self, actor_id: bytes, **updates) -> None:
@@ -303,6 +388,7 @@ class ControlPlane:
             if updates.get("state") == "DEAD" and info.get("name"):
                 self._named_actors.pop(
                     (info.get("namespace", "default"), info["name"]), None)
+            self._j("update_actor", actor_id, updates)
         self._actor_waiters.notify()
         self.publish(f"actor:{actor_id.hex()}", updates)
 
@@ -345,6 +431,7 @@ class ControlPlane:
             info.setdefault("state", "ALIVE")
             info["last_heartbeat"] = time.time()
             self._nodes[node_id] = info
+            self._j("register_node", node_id, info)
         self.publish("nodes", {"event": "register", "node_id": node_id.hex()})
 
     def heartbeat_node(self, node_id: bytes,
@@ -367,6 +454,7 @@ class ControlPlane:
                 return
             info["state"] = "DEAD"
             info["death_reason"] = reason
+            self._j("mark_node_dead", node_id, reason)
         self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
 
     def list_nodes(self) -> List[Dict[str, Any]]:
@@ -386,6 +474,7 @@ class ControlPlane:
             info["pg_id"] = pg_id
             info.setdefault("state", "PENDING")
             self._placement_groups[pg_id] = info
+            self._j("register_placement_group", pg_id, info)
         self._pg_waiters.notify()
 
     def update_placement_group(self, pg_id: bytes, **updates) -> None:
@@ -394,6 +483,7 @@ class ControlPlane:
             if info is None:
                 return
             info.update(updates)
+            self._j("update_placement_group", pg_id, updates)
         self._pg_waiters.notify()
 
     def get_placement_group(self, pg_id: bytes) -> Optional[Dict[str, Any]]:

@@ -39,17 +39,21 @@ def _shm_root(session_name: str) -> str:
     return os.path.join(base, f"ray_tpu_{session_name}")
 
 
-def _gc_stale_sessions() -> None:
+def _gc_stale_sessions(keep: Optional[str] = None) -> None:
     """Remove session/shm dirs whose head process is gone.
 
     Session names embed the head pid (``session_<ts>_<pid>``); a dead pid
     means a crashed driver left state behind (reference equivalent: session
-    dir cleanup in ``ray start``).
+    dir cleanup in ``ray start``).  ``keep`` preserves a named session —
+    the head-restart path re-enters a dead head's session dir to replay
+    its control-plane journal.
     """
     import glob
     import re
     for path in (glob.glob(os.path.join(_default_tmp_root(), "session_*"))
                  + glob.glob(_shm_root("session_*"))):
+        if keep and path.endswith(keep):
+            continue
         m = re.search(r"session_\d+_\d+_(\d+)$", path)
         if not m:
             continue
@@ -93,7 +97,7 @@ class HeadNode:
                  system_config: Optional[Dict[str, Any]] = None,
                  session_name: Optional[str] = None):
         GLOBAL_CONFIG.apply_system_config(system_config or {})
-        _gc_stale_sessions()
+        _gc_stale_sessions(keep=session_name)
         self.session_name = session_name or (
             f"session_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}")
         self.session_dir = os.path.join(_default_tmp_root(),
@@ -105,6 +109,22 @@ class HeadNode:
                           or os.path.join(self.session_dir, "spill"))
 
         self.control_plane = ControlPlane()
+        self.cp_journal = None
+        if GLOBAL_CONFIG.cp_persistence:
+            from ray_tpu._private.persistence import (Journal,
+                                                      restore_control_plane)
+            journal_path = os.path.join(self.session_dir, "cp_journal.bin")
+            restored = 0
+            if os.path.exists(journal_path):
+                restored = restore_control_plane(self.control_plane,
+                                                 journal_path)
+            self.cp_journal = Journal(journal_path,
+                                      sync=GLOBAL_CONFIG.cp_journal_sync)
+            self.control_plane.attach_journal(self.cp_journal)
+            if restored:
+                # compact on every restart so a crash loop can't grow the
+                # journal (replays re-append on top of the old log)
+                self.control_plane.compact_journal()
         if GLOBAL_CONFIG.use_tcp:
             self.cp_sock_path = f"tcp://{GLOBAL_CONFIG.node_ip}:0"
         else:
@@ -308,6 +328,8 @@ class HeadNode:
                 return
             try:
                 freed = self.control_plane.gc_sweep(grace)
+                self.control_plane.maybe_compact(
+                    GLOBAL_CONFIG.cp_journal_compact_records)
             except Exception:  # noqa: BLE001
                 continue
             if not freed:
@@ -338,6 +360,8 @@ class HeadNode:
                 proc.kill()
         self.node_manager.stop()
         self.cp_server.shutdown()
+        if self.cp_journal is not None:
+            self.cp_journal.close()
         self.store.destroy()
         shutil.rmtree(self.spill_dir, ignore_errors=True)
         # extra-node stores (SIGKILLed nodes never ran their own cleanup)

@@ -16,6 +16,7 @@ placement-group) override.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import subprocess
@@ -33,7 +34,45 @@ from ray_tpu._private.task_spec import (TaskSpec, acquire, fits, release)
 from ray_tpu.exceptions import (ActorDiedError, WorkerCrashedError,
                                 format_remote_traceback)
 
+logger = logging.getLogger(__name__)
+
 _EXIT_SENTINEL = {"type": "exit"}
+
+_CONN_ERRORS = (protocol.ConnectionClosed, ConnectionResetError,
+                ConnectionRefusedError, BrokenPipeError, OSError,
+                EOFError)
+
+
+class _ResilientCP:
+    """Control-plane client that rides out a head restart.
+
+    Wraps the remote RpcClient: a connection failure blocks and retries
+    (bounded) instead of raising, so in-flight bookkeeping — task result
+    commits, actor state updates — lands once the restarted head rebinds
+    its socket (reference flow: raylet reconnect on NotifyGCSRestart,
+    ``node_manager.proto:352``).  Only used for the out-of-process client;
+    the head's in-process ControlPlane needs none of this.
+    """
+
+    def __init__(self, client, retry_window_s: float = 30.0):
+        self._client = client
+        self._window = retry_window_s
+
+    def __getattr__(self, name: str):
+        target = getattr(self._client, name)
+
+        def call(*args, **kwargs):
+            deadline = time.time() + self._window
+            while True:
+                try:
+                    return target(*args, **kwargs)
+                except _CONN_ERRORS:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+
+        call.__name__ = name
+        return call
 
 
 class _Worker:
@@ -79,7 +118,9 @@ class NodeManager:
                  node_ip: str = "127.0.0.1", labels: Optional[Dict] = None):
         self.node_id = node_id
         self.session_dir = session_dir
-        self.cp = control_plane  # ControlPlane or RpcClient
+        if isinstance(control_plane, protocol.RpcClient):
+            control_plane = _ResilientCP(control_plane)
+        self.cp = control_plane  # ControlPlane, or _ResilientCP(RpcClient)
         self.cp_sock_path = cp_sock_path
         self.store = shm_store
         self.resources_total = dict(resources)
@@ -322,14 +363,31 @@ class NodeManager:
         self._wake.set()
         self._worker_reader(worker)
 
+    _CONN_ERRORS = _CONN_ERRORS
+
     def _worker_reader(self, worker: _Worker) -> None:
-        try:
-            while True:
+        """Two distinct failure domains: the worker socket (worker died —
+        run death handling) and control-plane calls made while handling a
+        message (head outage — _ResilientCP block-retries through the
+        restart; this branch is the backstop for an outage longer than
+        its window)."""
+        while True:
+            try:
                 msg = protocol.recv_msg(worker.sock)
+            except self._CONN_ERRORS:
+                break
+            try:
                 self._handle_worker_msg(worker, msg)
-        except (protocol.ConnectionClosed, ConnectionResetError, OSError,
-                EOFError):
+            except self._CONN_ERRORS:
+                logger.error(
+                    "control plane unreachable handling %s from worker "
+                    "%s; message dropped", msg.get("type"),
+                    worker.worker_id.hex()[:12])
+        try:
             self._on_worker_death(worker)
+        except self._CONN_ERRORS:
+            logger.error("control plane unreachable reporting death of "
+                         "worker %s", worker.worker_id.hex()[:12])
 
     def _handle_worker_msg(self, worker: _Worker, msg: Dict[str, Any]):
         kind = msg.get("type")

@@ -1,0 +1,120 @@
+"""Control-plane persistence: append-only journal + snapshot compaction.
+
+TPU-native equivalent of the reference's GCS Redis persistence
+(``src/ray/gcs/store_client/redis_store_client.cc``,
+``gcs_init_data.cc`` rehydration): every durable control-plane mutation
+is appended to a length-prefixed pickle log in the session directory; a
+restarted head replays the log, rebinds the same sockets, and surviving
+node managers / workers reconnect on their next call (the RPC client
+reconnects per call — the ``NotifyGCSRestart`` flow of
+``node_manager.proto:352`` falls out of the transport).
+
+High-frequency ephemeral state (heartbeats, pubsub rings, task events,
+refcounts) is deliberately NOT journaled — it regenerates within one
+heartbeat period.
+
+Format: ``[u32 length][pickle((op, args))]`` records.  A record whose op
+is ``__snapshot__`` carries a full state dict and resets replay state
+(compaction rewrites the log as one snapshot).  A truncated tail (crash
+mid-write) is ignored.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Any, Iterator, List, Tuple
+
+_LEN = struct.Struct("<I")
+
+SNAPSHOT_OP = "__snapshot__"
+
+
+class Journal:
+    """Append-only op log with atomic snapshot compaction."""
+
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, "ab")
+        self._records_since_snapshot = 0
+
+    def append(self, op: str, args: Tuple[Any, ...]) -> None:
+        payload = pickle.dumps((op, args), protocol=5)
+        with self._lock:
+            self._f.write(_LEN.pack(len(payload)) + payload)
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+            self._records_since_snapshot += 1
+
+    @staticmethod
+    def replay(path: str) -> Iterator[Tuple[str, Tuple[Any, ...]]]:
+        """Yield records; stop silently at a truncated tail."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    return
+                (length,) = _LEN.unpack(head)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return
+                try:
+                    yield pickle.loads(payload)
+                except Exception:  # noqa: BLE001 — corrupt record ends log
+                    return
+
+    def compact(self, state: Any) -> None:
+        """Atomically replace the log with one snapshot record."""
+        payload = pickle.dumps((SNAPSHOT_OP, (state,)), protocol=5)
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(_LEN.pack(len(payload)) + payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._f.close()
+            self._f = open(self.path, "ab")
+            self._records_since_snapshot = 0
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+def restore_control_plane(cp, path: str) -> int:
+    """Replay a journal into a fresh ControlPlane. Returns record count."""
+    n = 0
+    cp._replaying = True
+    try:
+        for op, args in Journal.replay(path):
+            n += 1
+            if op == SNAPSHOT_OP:
+                cp.load_state(args[0])
+                continue
+            method = getattr(cp, op, None)
+            if method is None:
+                continue
+            if op == "update_actor":
+                actor_id, updates = args
+                method(actor_id, **updates)
+            elif op == "update_placement_group":
+                pg_id, updates = args
+                method(pg_id, **updates)
+            else:
+                method(*args)
+    finally:
+        cp._replaying = False
+    cp.post_restore()
+    return n

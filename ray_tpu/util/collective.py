@@ -132,6 +132,7 @@ class HostGroup(BaseGroup):
 
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
+        self._p2p_seq: Dict[Any, int] = {}
         store_name = f"__collective_{group_name}"
         if rank == 0:
             try:
@@ -246,14 +247,18 @@ class HostGroup(BaseGroup):
         return np.asarray(mine)
 
     def broadcast(self, tensor, src_rank: int = 0):
-        # one put by src; everyone else pulls the ref from the store
+        # one put by src; everyone else pulls the ref from the store.
+        # The trailing exchange is an ack barrier: src's local ref (the
+        # object's GC pin) stays alive until every rank has fetched.
         if self.rank == src_rank:
             ref = ray_tpu.put(np.asarray(tensor))
             arrs = self._exchange("broadcast", [ref])
         else:
             arrs = self._exchange("broadcast", None)
         (ref,) = arrs[src_rank]
-        return np.asarray(ray_tpu.get(ref))
+        value = np.asarray(ray_tpu.get(ref))
+        self._exchange("broadcast_ack", None)
+        return value
 
     def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
         arr = np.asarray(tensor)
@@ -281,12 +286,18 @@ class HostGroup(BaseGroup):
         self._exchange("barrier", None)
 
     def send(self, tensor, dst_rank: int, tag: int = 0):
-        key = f"{self.group_name}:p2p:{self.rank}->{dst_rank}:{tag}"
+        # per-(peer, tag) sequence keeps every key unique, so a delayed
+        # fire-and-forget ack can never delete a later message
+        n = self._p2p_seq.setdefault(("s", dst_rank, tag), 0)
+        self._p2p_seq[("s", dst_rank, tag)] = n + 1
+        key = f"{self.group_name}:p2p:{self.rank}->{dst_rank}:{tag}:{n}"
         ref = ray_tpu.put(np.asarray(tensor))
         ray_tpu.get(self.store.put_p2p.remote(key, [ref]))
 
     def recv(self, src_rank: int, tag: int = 0):
-        key = f"{self.group_name}:p2p:{src_rank}->{self.rank}:{tag}"
+        n = self._p2p_seq.setdefault(("r", src_rank, tag), 0)
+        self._p2p_seq[("r", src_rank, tag)] = n + 1
+        key = f"{self.group_name}:p2p:{src_rank}->{self.rank}:{tag}:{n}"
         (ref,) = ray_tpu.get(self.store.get_p2p.remote(key))
         value = np.asarray(ray_tpu.get(ref))
         self.store.ack_p2p.remote(key)
@@ -418,9 +429,18 @@ class IciGroup(BaseGroup):
     def _kv_key(self) -> bytes:
         return f"__ici_coordinator_{self.group_name}".encode()
 
+    # joiners ignore coordinator records older than this — a crashed
+    # run's stale key (which cp_persistence may even have journaled)
+    # must not capture a fresh group's rendezvous
+    _COORD_FRESH_S = 120.0
+
     def _publish(self, coordinator: str) -> None:
+        import json
+        import time
+
         from ray_tpu._private.worker import global_worker
-        global_worker().cp.kv_put(self._kv_key, coordinator.encode(),
+        payload = json.dumps({"addr": coordinator, "ts": time.time()})
+        global_worker().cp.kv_put(self._kv_key, payload.encode(),
                                   namespace="_collective")
 
     def _rendezvous(self, timeout: float) -> str:
@@ -439,11 +459,17 @@ class IciGroup(BaseGroup):
             coordinator = f"{ip}:{port}"
             self._publish(coordinator)
             return coordinator
+        import json
         t0 = time.time()
         while True:
             raw = worker.cp.kv_get(self._kv_key, namespace="_collective")
             if raw:
-                return raw.decode()
+                try:
+                    rec = json.loads(raw.decode())
+                    if rec["ts"] >= t0 - self._COORD_FRESH_S:
+                        return rec["addr"]
+                except (ValueError, KeyError, TypeError):
+                    pass  # stale/legacy record — keep polling
             if time.time() - t0 > timeout:
                 raise TimeoutError(
                     f"no ici coordinator published for group "
