@@ -68,7 +68,12 @@ def _split_block(block: Block, n: int, seed: Optional[int]) -> List[Block]:
 
 @ray_tpu.remote(max_retries=3)
 def _merge_blocks(*blocks: Block) -> Block:
-    return concat_blocks(list(blocks))
+    # with num_returns=1 an upstream _split_block resolves to the whole
+    # 1-element list rather than its only item — flatten
+    flat: List[Block] = []
+    for b in blocks:
+        flat.extend(b) if isinstance(b, list) else flat.append(b)
+    return concat_blocks(flat)
 
 
 # ------------------------------------------------------------------- plan
@@ -83,10 +88,48 @@ class _MapOp(_Op):
         self.kwargs = kwargs
 
 
+class _ActorMapOp(_Op):
+    def __init__(self, cls, *, pool_size: int, batch_size, batch_format,
+                 fn_constructor_args=None, fn_constructor_kwargs=None):
+        self.cls = cls
+        self.pool_size = pool_size
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs
+
+
 class _AllToAllOp(_Op):
     def __init__(self, kind: str, **kwargs):
         self.kind = kind
         self.kwargs = kwargs
+
+
+def _all_to_all_refs(refs_in: List[ObjectRef], kind: str,
+                     arg: Dict[str, Any]) -> List[ObjectRef]:
+    """Fan out one all-to-all stage over materialized upstream refs."""
+    if kind == "shuffle":
+        seed = arg.get("seed")
+        n = max(1, len(refs_in))
+        parts = [_split_block.options(num_returns=n).remote(
+            r, n, (seed + i) if seed is not None else None)
+            for i, r in enumerate(refs_in)]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        return [_merge_blocks.remote(
+            *[parts[j][i] for j in range(len(refs_in))])
+            for i in range(n)]
+    if kind == "repartition":
+        n = arg["num_blocks"]
+        parts = [_split_block.options(num_returns=n).remote(
+            r, n, None) for r in refs_in]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        return [_merge_blocks.remote(
+            *[parts[j][i] for j in range(len(refs_in))])
+            for i in range(n)]
+    if kind == "sort":
+        table = _sorted_table(refs_in, arg["key"], arg["descending"])
+        return [ray_tpu.put(table)]
+    raise ValueError(kind)
 
 
 class Dataset:
@@ -100,7 +143,25 @@ class Dataset:
         return Dataset(self._block_refs, self._ops + [op])
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    batch_format: str = "numpy", **ignored) -> "Dataset":
+                    batch_format: str = "numpy",
+                    concurrency: Optional[int] = None,
+                    compute=None, fn_constructor_args=None,
+                    fn_constructor_kwargs=None, **ignored) -> "Dataset":
+        """Map a function — or a *callable class* — over batches.
+
+        A class UDF runs on a fixed actor pool (constructed once per
+        actor; ``concurrency`` = pool size), the reference's
+        ``ActorPoolStrategy`` (``actor_pool_map_operator.py:1``).
+        """
+        if isinstance(fn, type):
+            pool = concurrency or getattr(compute, "size", None) or 2
+            if isinstance(pool, (tuple, list)):  # Ray's (min, max) form
+                pool = pool[-1]
+            return self._with_op(_ActorMapOp(
+                fn, pool_size=int(pool), batch_size=batch_size,
+                batch_format=batch_format,
+                fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs))
         return self._with_op(_MapOp("map_batches", fn,
                                     batch_size=batch_size,
                                     batch_format=batch_format))
@@ -165,57 +226,45 @@ class Dataset:
         return from_arrow(pa.table(cols))
 
     # --------------------------------------------------------- execution
-    def _execute(self, window: int = DEFAULT_WINDOW
-                 ) -> Iterator[ObjectRef]:
-        """Stream transformed block refs with bounded in-flight tasks."""
-        refs = list(self._block_refs)
-        ops = list(self._ops)
-        # collapse consecutive map ops into fused stages (the reference
-        # fuses map chains into one task per block)
-        stages: List[Tuple[str, Any]] = []
+    def _build_operators(self, window: int):
+        """Fuse consecutive function-map ops; class UDFs and all-to-alls
+        become their own physical operators."""
+        from ray_tpu.data.streaming_executor import (ActorPoolMapOperator,
+                                                     AllToAllOperator,
+                                                     MapOperator)
+        physical = []
         fused: List[Tuple[str, Callable, Dict]] = []
-        for op in ops:
+
+        def flush():
+            nonlocal fused
+            if fused:
+                physical.append(MapOperator(fused, budget=window))
+                fused = []
+
+        for op in self._ops:
             if isinstance(op, _MapOp):
                 fused.append((op.kind, op.fn, op.kwargs))
+            elif isinstance(op, _ActorMapOp):
+                flush()
+                physical.append(ActorPoolMapOperator(
+                    op.cls, pool_size=op.pool_size,
+                    fn_constructor_args=op.fn_constructor_args,
+                    fn_constructor_kwargs=op.fn_constructor_kwargs,
+                    batch_size=op.batch_size,
+                    batch_format=op.batch_format))
             else:
-                if fused:
-                    stages.append(("map", fused))
-                    fused = []
-                stages.append((op.kind, op.kwargs))
-        if fused:
-            stages.append(("map", fused))
+                flush()
+                physical.append(AllToAllOperator(op.kind, op.kwargs))
+        flush()
+        return physical
 
-        def apply_stage(refs_in: List[ObjectRef], stage) -> List[ObjectRef]:
-            kind, arg = stage
-            if kind == "map":
-                return [_map_block.remote(r, arg) for r in refs_in]
-            if kind == "shuffle":
-                seed = arg.get("seed")
-                n = max(1, len(refs_in))
-                parts = [_split_block.options(num_returns=n).remote(
-                    r, n, (seed + i) if seed is not None else None)
-                    for i, r in enumerate(refs_in)]
-                parts = [p if isinstance(p, list) else [p] for p in parts]
-                return [_merge_blocks.remote(
-                    *[parts[j][i] for j in range(len(refs_in))])
-                    for i in range(n)]
-            if kind == "repartition":
-                n = arg["num_blocks"]
-                parts = [_split_block.options(num_returns=n).remote(
-                    r, n, None) for r in refs_in]
-                parts = [p if isinstance(p, list) else [p] for p in parts]
-                return [_merge_blocks.remote(
-                    *[parts[j][i] for j in range(len(refs_in))])
-                    for i in range(n)]
-            if kind == "sort":
-                table = _sorted_table(refs_in, arg["key"],
-                                      arg["descending"])
-                return [ray_tpu.put(table)]
-            raise ValueError(kind)
-
-        for stage in stages:
-            refs = apply_stage(refs, stage)
-        yield from refs
+    def _execute(self, window: int = DEFAULT_WINDOW
+                 ) -> Iterator[ObjectRef]:
+        """Stream transformed block refs through the operator DAG with
+        per-operator in-flight budgets (``streaming_executor.py``)."""
+        from ray_tpu.data.streaming_executor import StreamingExecutor
+        executor = StreamingExecutor(self._build_operators(window))
+        yield from executor.execute(list(self._block_refs))
 
     def materialize(self) -> "Dataset":
         refs = list(self._execute())
@@ -229,13 +278,70 @@ class Dataset:
         return concat_blocks(blocks)
 
     # ------------------------------------------------------- consumption
+    def _iter_blocks_prefetched(self, prefetch_blocks: int
+                                ) -> Iterator[Block]:
+        """Materialize blocks on a background thread, ``prefetch_blocks``
+        ahead of the consumer (overlaps host fetch with accelerator
+        compute — reference ``iter_batches`` prefetching)."""
+        import queue
+        import threading
+
+        if prefetch_blocks <= 0:
+            for ref in self._execute():
+                yield ray_tpu.get(ref, timeout=600)
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch_blocks)
+        _END, _ERR = object(), object()
+        stop = threading.Event()
+
+        def feeder():
+            gen = self._execute()
+            try:
+                for ref in gen:
+                    block = ray_tpu.get(ref, timeout=600)
+                    while not stop.is_set():
+                        try:
+                            q.put(block, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                q.put(_END)
+            except BaseException as e:  # noqa: BLE001 — reraised below
+                if not stop.is_set():
+                    q.put((_ERR, e))
+            finally:
+                gen.close()  # runs the executor's shutdown (actor pools)
+
+        t = threading.Thread(target=feeder, daemon=True,
+                             name="data-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            # consumer abandoned the iterator: unblock + stop the feeder
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=30)
+
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
                      prefetch_blocks: int = 2) -> Iterator[Any]:
         carry: Optional[Block] = None
-        for ref in self._execute():
-            block = ray_tpu.get(ref, timeout=600)
+        for block in self._iter_blocks_prefetched(prefetch_blocks):
             if carry is not None and carry.num_rows > 0:
                 block = concat_blocks([carry, block])
                 carry = None

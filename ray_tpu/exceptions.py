@@ -42,6 +42,15 @@ class TaskError(RayError):
 
     def as_instanceof_cause(self) -> BaseException:
         """Return an exception that is-a ``type(cause)`` for except clauses."""
+        cause = self.cause
+        # errors that crossed intermediate tasks arrive as nested
+        # TaskErrors (see _Wrapped.__reduce__) — surface the original
+        # type so `except ValueError:` keeps matching across hops
+        while isinstance(cause, TaskError):
+            cause = cause.cause
+        if cause is not self.cause:
+            return TaskError(cause, self.remote_tb,
+                             self.task_id).as_instanceof_cause()
         cause_cls = type(self.cause)
         if cause_cls in (SystemExit, KeyboardInterrupt):
             return self
@@ -50,6 +59,14 @@ class TaskError(RayError):
                 def __init__(wrapped_self):
                     TaskError.__init__(wrapped_self, self.cause,
                                        self.remote_tb, self.task_id)
+
+                def __reduce__(wrapped_self):
+                    # the dynamic class can't unpickle (cause_cls's
+                    # __reduce__ would call __init__ with its own args);
+                    # cross process boundaries as a plain TaskError and
+                    # get re-wrapped at the final raise site
+                    return (TaskError, (self.cause, self.remote_tb,
+                                        self.task_id))
             _Wrapped.__name__ = f"TaskError({cause_cls.__name__})"
             _Wrapped.__qualname__ = _Wrapped.__name__
             return _Wrapped()
