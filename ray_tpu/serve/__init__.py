@@ -27,6 +27,10 @@ class Deployment:
     ray_actor_options: Optional[Dict[str, Any]] = None
     max_ongoing_requests: int = 8
     user_config: Optional[Dict[str, Any]] = None
+    # {min_replicas, max_replicas, target_ongoing_requests,
+    #  upscale_delay_s, downscale_delay_s} — queue-depth autoscaling
+    # (parity: serve/_private/autoscaling_policy.py)
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
     def options(self, **kwargs) -> "Deployment":
         import dataclasses
@@ -48,7 +52,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                ray_actor_options: Optional[Dict] = None,
                max_ongoing_requests: int = 8,
-               user_config: Optional[Dict] = None, **ignored):
+               user_config: Optional[Dict] = None,
+               autoscaling_config: Optional[Dict] = None, **ignored):
     """``@serve.deployment`` decorator (parity: serve/api.py:244)."""
     def wrap(target):
         return Deployment(
@@ -56,7 +61,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
             num_replicas=num_replicas,
             ray_actor_options=ray_actor_options,
             max_ongoing_requests=max_ongoing_requests,
-            user_config=user_config)
+            user_config=user_config,
+            autoscaling_config=autoscaling_config)
 
     if func_or_class is not None:
         return wrap(func_or_class)
@@ -98,6 +104,8 @@ def _collect_deployments(app: Application, app_name: str,
             "num_replicas": dep.num_replicas,
             "actor_options": dep.ray_actor_options,
             "max_ongoing": dep.max_ongoing_requests,
+            "user_config": dep.user_config,
+            "autoscaling_config": dep.autoscaling_config,
         })
     return dep.name
 

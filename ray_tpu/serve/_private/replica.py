@@ -16,7 +16,8 @@ import ray_tpu
 @ray_tpu.remote
 class ServeReplica:
     def __init__(self, app_name: str, deployment_name: str,
-                 cls_blob: bytes, init_args: Tuple, init_kwargs: Dict):
+                 cls_blob: bytes, init_args: Tuple, init_kwargs: Dict,
+                 user_config=None):
         import cloudpickle
         cls = cloudpickle.loads(cls_blob)
         if inspect.isfunction(cls):
@@ -26,6 +27,15 @@ class ServeReplica:
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._ongoing = 0
+        if user_config is not None and hasattr(self.instance,
+                                               "reconfigure"):
+            out = self.instance.reconfigure(user_config)
+            if inspect.iscoroutine(out):
+                import asyncio
+                try:
+                    asyncio.get_running_loop().create_task(out)
+                except RuntimeError:
+                    asyncio.run(out)
 
     def ping(self):
         return "pong"

@@ -41,12 +41,50 @@ class DeploymentHandle:
         self._method = method_name
         self._routing: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
+        self._poller: Optional[threading.Thread] = None
+        self._poller_stop = threading.Event()
 
-    # handle.method.remote(...) sugar
+    def _start_poller(self, deployment: str) -> None:
+        """Long-poll the control-plane pubsub for routing pushes
+        (autoscale/redeploy version bumps) — parity with the reference's
+        LongPollClient (``serve/_private/long_poll.py:173``)."""
+        with self._lock:
+            if self._poller is not None:
+                return
+            self._poller = True  # placeholder: claim before starting
+
+        def loop():
+            from ray_tpu._private.worker import global_worker
+            from ray_tpu.serve._private.controller import routing_channel
+            channel = routing_channel(self._app, deployment)
+            cursor = 0
+            while not self._poller_stop.is_set():
+                try:
+                    cursor, msgs = global_worker().cp.poll(
+                        channel, cursor, 10.0)
+                    if msgs:
+                        with self._lock:
+                            self._routing = None  # refetch on next use
+                except Exception:  # noqa: BLE001 — retry next round
+                    if self._poller_stop.wait(1.0):
+                        return
+
+        self._poller = threading.Thread(target=loop, daemon=True,
+                                        name="serve-handle-poll")
+        self._poller.start()
+
+    def __del__(self):
+        self._poller_stop.set()
+
+    # handle.method.remote(...) sugar (cached: each sub-handle owns a
+    # routing cache + long-poll thread, so recreating per access would
+    # churn threads)
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._app, self._deployment, name)
+        sub = DeploymentHandle(self._app, self._deployment, name)
+        self.__dict__[name] = sub
+        return sub
 
     def options(self, method_name: Optional[str] = None
                 ) -> "DeploymentHandle":
@@ -69,7 +107,9 @@ class DeploymentHandle:
                         f"{self._deployment or '(ingress)'} in app "
                         f"{self._app!r}")
                 self._routing = routing
-            return self._routing
+            routing = self._routing
+        self._start_poller(routing["deployment"])
+        return routing
 
     def _pick_replica(self):
         routing = self._get_routing()
@@ -94,3 +134,14 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle, (self._app, self._deployment,
                                    self._method))
+
+    # identity is the target, not the instance: the controller compares
+    # init_args across redeploys to decide in-place reconfigure vs
+    # restart, and composed apps carry handles in init_args
+    def __eq__(self, other):
+        return (isinstance(other, DeploymentHandle)
+                and (self._app, self._deployment, self._method)
+                == (other._app, other._deployment, other._method))
+
+    def __hash__(self):
+        return hash((self._app, self._deployment, self._method))

@@ -161,3 +161,76 @@ def test_status_and_update(ray_start_regular):
     assert handle.remote().result() == "v2"
     assert serve.status()["up"]["deployments"]["V"]["version"] == 2
     serve.delete("up")
+
+
+def test_autoscaling_up_and_down(ray_start_regular):
+    """Queue depth grows replicas 1 -> 3, idleness shrinks them back
+    (parity: serve/_private/autoscaling_policy.py)."""
+    import ray_tpu
+    import ray_tpu.serve as serve
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0,
+        "upscale_delay_s": 0.2, "downscale_delay_s": 0.5})
+    class Slow:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(2.0)
+            return x
+
+    handle = serve.run(Slow.bind(), name="auto")
+
+    def replicas():
+        return serve.status()["auto"]["deployments"]["Slow"][
+            "num_replicas"]
+
+    assert replicas() == 1
+    # pile on requests to inflate queue depth
+    responses = [handle.remote(i) for i in range(8)]
+    deadline = time.time() + 30
+    while replicas() < 3 and time.time() < deadline:
+        time.sleep(0.2)
+    assert replicas() == 3, "load did not grow replicas to max"
+    assert sorted(r.result(timeout_s=60) for r in responses) == list(
+        range(8))
+    # idle: scale back to min
+    deadline = time.time() + 30
+    while replicas() > 1 and time.time() < deadline:
+        time.sleep(0.2)
+    assert replicas() == 1, "idle deployment did not scale back down"
+    serve.delete("auto")
+
+
+def test_user_config_push_without_restart(ray_start_regular):
+    """A redeploy that only changes user_config reaches live replicas via
+    reconfigure() — same replica instance, no restart (parity:
+    long-poll config push, serve/_private/long_poll.py:173)."""
+    import os
+
+    import ray_tpu.serve as serve
+
+    @serve.deployment(user_config={"factor": 2})
+    class Scaler:
+        def __init__(self):
+            self.factor = 1
+            self.constructions = os.getpid()  # marks this instance
+
+        def reconfigure(self, config):
+            self.factor = config["factor"]
+
+        def __call__(self, x):
+            return {"y": x * self.factor, "pid": self.constructions}
+
+    app = Scaler.bind()
+    handle = serve.run(app, name="cfg")
+    first = handle.remote(10).result()
+    assert first["y"] == 20
+
+    # redeploy with a new user_config only
+    serve.run(serve.deployment(user_config={"factor": 5})(
+        Scaler.func_or_class).bind(), name="cfg")
+    second = handle.remote(10).result()
+    assert second["y"] == 50, "user_config update did not reach replica"
+    assert second["pid"] == first["pid"], "replica was restarted"
+    serve.delete("cfg")
