@@ -111,6 +111,11 @@ class ServeController:
             if d.get("autoscaling_config") is not None:
                 autoscale = dict(AUTOSCALE_DEFAULTS)
                 autoscale.update(d["autoscaling_config"])
+                # scale-to-zero needs handle-side queue metrics the
+                # replicas can't provide once dead; clamp to 1 (deviation
+                # from the reference, which meters at the handle)
+                autoscale["min_replicas"] = max(
+                    1, autoscale["min_replicas"])
                 autoscale.setdefault(
                     "max_replicas",
                     max(d["num_replicas"], autoscale["min_replicas"]))

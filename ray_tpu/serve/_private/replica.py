@@ -29,13 +29,15 @@ class ServeReplica:
         self._ongoing = 0
         if user_config is not None and hasattr(self.instance,
                                                "reconfigure"):
+            # applied synchronously: the replica must not serve requests
+            # (or report ready) with the config unapplied, and a failing
+            # reconfigure must fail the replica like the reference does.
+            # Actor __init__ runs before the actor's event loop starts,
+            # so asyncio.run is safe for async reconfigure.
             out = self.instance.reconfigure(user_config)
             if inspect.iscoroutine(out):
                 import asyncio
-                try:
-                    asyncio.get_running_loop().create_task(out)
-                except RuntimeError:
-                    asyncio.run(out)
+                asyncio.run(out)
 
     def ping(self):
         return "pong"

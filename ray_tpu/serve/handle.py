@@ -53,20 +53,29 @@ class DeploymentHandle:
                 return
             self._poller = True  # placeholder: claim before starting
 
+        import weakref
+        handle_ref = weakref.ref(self)  # don't keep the handle alive
+        stop = self._poller_stop
+        app = self._app
+
         def loop():
             from ray_tpu._private.worker import global_worker
             from ray_tpu.serve._private.controller import routing_channel
-            channel = routing_channel(self._app, deployment)
+            channel = routing_channel(app, deployment)
             cursor = 0
-            while not self._poller_stop.is_set():
+            while not stop.is_set():
                 try:
                     cursor, msgs = global_worker().cp.poll(
                         channel, cursor, 10.0)
+                    handle = handle_ref()
+                    if handle is None:
+                        return  # handle was GC'd: stop polling
                     if msgs:
-                        with self._lock:
-                            self._routing = None  # refetch on next use
+                        with handle._lock:
+                            handle._routing = None  # refetch on next use
+                    del handle
                 except Exception:  # noqa: BLE001 — retry next round
-                    if self._poller_stop.wait(1.0):
+                    if stop.wait(1.0):
                         return
 
         self._poller = threading.Thread(target=loop, daemon=True,

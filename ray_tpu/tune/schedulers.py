@@ -75,3 +75,96 @@ class ASHAScheduler:
 
 
 AsyncHyperBandScheduler = ASHAScheduler
+
+EXPLOIT = "EXPLOIT"
+
+
+class PopulationBasedTraining:
+    """PBT (parity: ``python/ray/tune/schedulers/pbt.py:1``).
+
+    Every ``perturbation_interval`` iterations a trial compares itself
+    against the population: bottom-quantile trials *exploit* (clone a
+    top-quantile trial's checkpoint + config) and *explore* (mutate the
+    cloned hyperparams — resample with ``resample_probability``, else
+    perturb by 1.2x / 0.8x, or step within a list).  The controller
+    enacts the decision by relaunching the trial from the donor's
+    checkpoint with the mutated config.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25, seed: int = 0):
+        import random
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}
+        self._configs: Dict[str, Dict] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_trial_add(self, trial_id: str, config: Dict) -> None:
+        self._configs[trial_id] = dict(config)
+        self._last_perturb.setdefault(trial_id, 0)
+
+    def _mutate(self, config: Dict) -> Dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            old = out.get(key)
+            if isinstance(spec, list):
+                if self._rng.random() < self.resample_prob \
+                        or old not in spec:
+                    out[key] = self._rng.choice(spec)
+                else:  # step to a neighbour value
+                    i = spec.index(old)
+                    j = i + self._rng.choice((-1, 1))
+                    out[key] = spec[max(0, min(len(spec) - 1, j))]
+            elif callable(spec):
+                if self._rng.random() < self.resample_prob \
+                        or not isinstance(old, (int, float)):
+                    out[key] = spec()
+                else:
+                    out[key] = old * self._rng.choice((0.8, 1.2))
+            elif hasattr(spec, "sample"):  # tune.uniform etc.
+                if self._rng.random() < self.resample_prob \
+                        or not isinstance(old, (int, float)):
+                    out[key] = spec.sample(self._rng)
+                else:
+                    out[key] = old * self._rng.choice((0.8, 1.2))
+        return out
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric) if self.metric else None
+        if t is None or metric is None:
+            return CONTINUE
+        self._scores[trial_id] = self._norm(float(metric))
+        self._configs.setdefault(trial_id, {}).update(
+            result.get("config") or {})
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        population = sorted(self._scores, key=self._scores.get,
+                            reverse=True)
+        if len(population) < 2:
+            return CONTINUE
+        k = max(1, int(len(population) * self.quantile))
+        top, bottom = population[:k], population[-k:]
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        src = self._rng.choice(top)
+        new_config = self._mutate(self._configs.get(src, {}))
+        return (EXPLOIT, src, new_config)
+
+    def on_trial_complete(self, trial_id: str):
+        self._scores.pop(trial_id, None)

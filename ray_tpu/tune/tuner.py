@@ -139,7 +139,8 @@ class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 _restored_trials: Optional[List[Trial]] = None):
         if hasattr(trainable, "as_trainable"):
             trainable = trainable.as_trainable()
         self.trainable = trainable
@@ -150,25 +151,118 @@ class Tuner:
             self.run_config.name = f"tune_{uuid.uuid4().hex[:8]}"
         self._resources = getattr(trainable, "_tune_resources",
                                   {"CPU": 1.0})
+        self._restored_trials = _restored_trials
 
+    # ------------------------------------------------ experiment state ----
+    def _save_experiment_state(self, storage: str,
+                               trials: List[Trial]) -> None:
+        """Journal the experiment for ``Tuner.restore`` (parity:
+        ``tune/execution/experiment_state.py``)."""
+        import json
+        state = {"trials": [{
+            "trial_id": t.trial_id, "config_idx": i,
+            "status": t.status, "iterations": t.iterations,
+            "last_result": _json_safe(t.last_result),
+            "history": [_json_safe(h) for h in t.history],
+            "checkpoint_path": t.checkpoint.path if t.checkpoint else None,
+            "error": t.error, "config": _json_safe(t.config),
+        } for i, t in enumerate(trials)]}
+        tmp = os.path.join(storage, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(storage, "experiment_state.json"))
+
+    def _save_tuner_blob(self, storage: str) -> None:
+        import cloudpickle
+        with open(os.path.join(storage, "tuner.pkl"), "wb") as f:
+            cloudpickle.dump({
+                "trainable": self.trainable,
+                "param_space": self.param_space,
+                "tune_config": self.tune_config,
+                "run_config": self.run_config,
+            }, f)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None,
+                resume_errored: bool = False,
+                restart_errored: bool = False) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory.
+
+        Finished trials keep their results; unfinished ones re-run from
+        their latest checkpoint.  ``resume_errored`` re-runs failed
+        trials from their checkpoints; ``restart_errored`` re-runs them
+        from scratch (parity: ``tune/tuner.py`` restore, reference
+        ``:346``).
+        """
+        import json
+
+        import cloudpickle
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            blob = cloudpickle.load(f)
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        trials = []
+        for rec in state["trials"]:
+            trial = Trial(trial_id=rec["trial_id"], config=rec["config"])
+            trial.iterations = rec["iterations"]
+            trial.last_result = rec["last_result"]
+            trial.history = rec["history"]
+            trial.error = rec["error"]
+            if rec["checkpoint_path"] and os.path.exists(
+                    rec["checkpoint_path"]):
+                trial.checkpoint = Checkpoint(rec["checkpoint_path"])
+            # only completed trials keep their terminal status; the rest
+            # (RUNNING/PENDING at interruption) re-run from checkpoint
+            status = rec["status"]
+            if status == "ERROR" and (resume_errored or restart_errored):
+                status = "PENDING"
+                trial.error = None
+                if restart_errored:
+                    trial.checkpoint = None
+                    trial.iterations = 0
+            elif status not in ("TERMINATED", "ERROR"):
+                status = "PENDING"
+            trial.status = status
+            trials.append(trial)
+        return cls(trainable or blob["trainable"],
+                   param_space=blob["param_space"],
+                   tune_config=blob["tune_config"],
+                   run_config=blob["run_config"],
+                   _restored_trials=trials)
+
+    # ---------------------------------------------------------- control ----
     def fit(self) -> ResultGrid:
-        configs = resolve(self.param_space, self.tune_config.num_samples,
-                          self.tune_config.seed)
+        from ray_tpu.tune.schedulers import EXPLOIT
         scheduler = self.tune_config.scheduler or FIFOScheduler()
         if getattr(scheduler, "metric", None) is None and \
                 hasattr(scheduler, "metric"):
             scheduler.metric = self.tune_config.metric
         storage = self.run_config.resolved_storage_path()
         os.makedirs(storage, exist_ok=True)
+        self._save_tuner_blob(storage)
 
-        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
-                  for i, cfg in enumerate(configs)]
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            configs = resolve(self.param_space,
+                              self.tune_config.num_samples,
+                              self.tune_config.seed)
+            trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                      for i, cfg in enumerate(configs)]
         max_concurrent = (self.tune_config.max_concurrent_trials
                           or len(trials))
 
-        pending = list(trials)
+        pending = [t for t in trials if t.status == "PENDING"]
         running: List[Trial] = []
-        finished: List[Trial] = []
+        by_id = {t.trial_id: t for t in trials}
+        reports: Dict[str, Any] = {}  # trial_id -> in-flight report ref
+        last_save = [0.0]
+
+        def save_state(throttled: bool = False):
+            if throttled and time.monotonic() - last_save[0] < 1.0:
+                return
+            last_save[0] = time.monotonic()
+            self._save_experiment_state(storage, trials)
 
         def launch(trial: Trial):
             opts = {"num_cpus": self._resources.get("CPU", 1.0)}
@@ -182,47 +276,65 @@ class Tuner:
             # fire-and-forget: the call is buffered client-side until the
             # trial actor is scheduled (it may queue behind resources)
             trial.actor.run.remote(self.trainable, trial.config, ctx,
-                                   None)
+                                   trial.checkpoint)
             trial.status = "RUNNING"
             running.append(trial)
+            if hasattr(scheduler, "on_trial_add"):
+                scheduler.on_trial_add(trial.trial_id, trial.config)
+
+        def retire(trial: Trial, status: str):
+            trial.status = status
+            running.remove(trial)
+            scheduler.on_trial_complete(trial.trial_id)
+            reports.pop(trial.trial_id, None)
+            ray_tpu.kill(trial.actor)
+            save_state()
 
         def actor_alive(trial: Trial) -> bool:
+            # O(1) directory lookup: this runs per running trial per
+            # poll round, so a full list_actors() scan would be
+            # quadratic in cluster size (and truncates at 1000)
             from ray_tpu._private.worker import global_worker
             info = global_worker().cp.get_actor_info(
                 trial.actor._actor_id)
             return bool(info) and info.get("state") == "ALIVE"
 
-        from ray_tpu.exceptions import GetTimeoutError
-
         while pending or running:
             while pending and len(running) < max_concurrent:
                 launch(pending.pop(0))
-            progressed = False
-            for trial in list(running):
-                if not actor_alive(trial):
-                    continue  # still queued on resources
+            # one outstanding report poll per running trial, drained in
+            # one wait() instead of a serial get() per trial
+            for trial in running:
+                if trial.trial_id not in reports and actor_alive(trial):
+                    reports[trial.trial_id] = \
+                        trial.actor.next_report.remote(0.2)
+            if not reports:
+                time.sleep(0.05)
+                continue
+            ref_to_id = {ref.binary(): tid
+                         for tid, ref in reports.items()}
+            ready, _ = ray_tpu.wait(list(reports.values()),
+                                    num_returns=1, timeout=5)
+            for ref in ready:
+                tid = ref_to_id[ref.binary()]
+                reports.pop(tid, None)
+                trial = by_id[tid]
+                if trial not in running:
+                    continue
                 try:
-                    item = ray_tpu.get(
-                        trial.actor.next_report.remote(0.2), timeout=60)
-                except GetTimeoutError:
+                    item = ray_tpu.get(ref, timeout=5)
+                except Exception:  # noqa: BLE001 — actor died mid-poll
+                    trial.error = "trial actor died"
+                    retire(trial, "ERROR")
                     continue
                 if item is None:
                     continue
-                progressed = True
                 kind = item[0]
                 if kind == "error":
-                    trial.status = "ERROR"
                     trial.error = item[1]["traceback"]
-                    running.remove(trial)
-                    finished.append(trial)
-                    scheduler.on_trial_complete(trial.trial_id)
-                    ray_tpu.kill(trial.actor)
+                    retire(trial, "ERROR")
                 elif kind == "done":
-                    trial.status = "TERMINATED"
-                    running.remove(trial)
-                    finished.append(trial)
-                    scheduler.on_trial_complete(trial.trial_id)
-                    ray_tpu.kill(trial.actor)
+                    retire(trial, "TERMINATED")
                 else:
                     metrics, checkpoint = item[1], item[2]
                     trial.iterations += 1
@@ -235,17 +347,27 @@ class Tuner:
                     if checkpoint is not None:
                         trial.checkpoint = checkpoint.persist(
                             os.path.join(storage, trial.trial_id))
+                        save_state(throttled=True)
                     decision = scheduler.on_result(trial.trial_id,
                                                    metrics)
                     if decision == STOP:
-                        trial.status = "TERMINATED"
-                        running.remove(trial)
-                        finished.append(trial)
-                        scheduler.on_trial_complete(trial.trial_id)
+                        retire(trial, "TERMINATED")
+                    elif isinstance(decision, tuple) \
+                            and decision[0] == EXPLOIT:
+                        _, src_id, new_config = decision
+                        src = by_id.get(src_id)
+                        # exploit: clone the donor's checkpoint, explore
+                        # with the mutated config, relaunch in place
                         ray_tpu.kill(trial.actor)
-            if not progressed:
-                time.sleep(0.05)
+                        reports.pop(trial.trial_id, None)
+                        running.remove(trial)
+                        trial.config = new_config
+                        if src is not None and src.checkpoint is not None:
+                            trial.checkpoint = src.checkpoint
+                        launch(trial)
+                        save_state()
 
+        self._save_experiment_state(storage, trials)
         results = []
         for trial in trials:
             err = None
@@ -260,3 +382,17 @@ class Tuner:
                 metrics_history=trial.history))
         return ResultGrid(results, self.tune_config.metric,
                           self.tune_config.mode)
+
+
+def _json_safe(obj):
+    """Best-effort JSON projection of metrics/config dicts."""
+    import json
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_json_safe(v) for v in obj]
+        return repr(obj)

@@ -1,6 +1,8 @@
 """Tune parity tests: grid/random search, ASHA early stopping, trainer
 integration.  Modeled on ``python/ray/tune/tests/test_tune_*.py``."""
 
+import os
+
 import pytest
 
 
@@ -116,3 +118,87 @@ def test_tuner_over_trainer(ray_start_regular, tmp_path):
     results = tuner.fit()
     best = results.get_best_result()
     assert abs(best.metrics["config"]["lr"] - 0.1) < 1e-9
+
+
+def test_pbt_mutates_and_exploits(ray_start_regular, tmp_path):
+    """PBT: bottom-quantile trials clone a top trial's checkpoint and
+    mutate hyperparams (parity: tune/schedulers/pbt.py)."""
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+    def trainable(config):
+        import ray_tpu.tune as session
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["score"] if ckpt else 0.0
+        score = start
+        for i in range(12):
+            # lr is the fitness: high lr climbs faster
+            score += config["lr"]
+            session.report(
+                {"score": score},
+                checkpoint=Checkpoint.from_dict({"score": score}))
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 1.0, 10.0]},
+        quantile_fraction=0.25, seed=7)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 0.1, 0.1, 10.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=pbt),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 12 * 10.0 * 0.9
+    # at least one losing trial must have been exploited onto lr=10.0
+    final_lrs = [r.metrics["config"]["lr"] for r in grid
+                 if r.metrics]
+    assert final_lrs.count(10.0) >= 2, final_lrs
+
+
+def test_tuner_restore_resumes_unfinished(ray_start_regular, tmp_path):
+    """Interrupted experiment resumes: finished trials keep results,
+    unfinished re-run from their checkpoint (parity: Tuner.restore,
+    tune/execution/experiment_state.py)."""
+    import ray_tpu.tune as tune
+    from ray_tpu.train import RunConfig
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    marker = tmp_path / "crash_once"
+    marker.write_text("arm")
+
+    def trainable(config):
+        import ray_tpu.tune as session
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] if ckpt else 0
+        for i in range(start, 6):
+            session.report({"i": i, "trial_tag": config["tag"]},
+                           checkpoint=Checkpoint.from_dict({"i": i + 1}))
+            if config["tag"] == "crasher" and i == 2 and \
+                    marker.exists():
+                marker.unlink()
+                raise RuntimeError("simulated interruption")
+
+    storage = str(tmp_path / "exp")
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"tag": tune.grid_search(["ok", "crasher"])},
+        tune_config=tune.TuneConfig(metric="i", mode="max"),
+        run_config=RunConfig(name="resume", storage_path=storage))
+    grid = tuner.fit()
+    assert len(grid.errors) == 1  # the crasher failed once
+
+    exp_dir = os.path.join(storage, "resume")
+    restored = tune.Tuner.restore(exp_dir, resume_errored=True)
+    grid2 = restored.fit()
+    assert not grid2.errors
+    by_tag = {r.metrics["trial_tag"]: r for r in grid2 if r.metrics}
+    assert by_tag["crasher"].metrics["i"] == 5
+    # restored history = run-1 reports (0,1,2) + resumed reports (3,4,5):
+    # resuming from the checkpoint means no iteration repeats
+    steps = [h["i"] for h in by_tag["crasher"].metrics_history]
+    assert steps == [0, 1, 2, 3, 4, 5], steps
