@@ -2,8 +2,10 @@
 
 Parity: reference ``rllib/algorithms/ppo/`` (new stack): Algorithm drives
 env-runner actors (sampling) and a Learner (jitted clipped-surrogate SGD).
-TPU-first: the learner's update is one pjit-compiled function over a
-device mesh (dp axis for minibatch sharding) rather than a DDP wrapper.
+TPU-first: a single learner's update is one jit-compiled function (a
+mesh's dp axis shards minibatches inside jit); ``num_learners>1`` scales
+out as a DDP LearnerGroup (``rllib/core/learner_group.py``) whose
+actors ring-allreduce gradients through the collective layer.
 """
 
 from __future__ import annotations
@@ -26,6 +28,9 @@ class PPOConfig:
     env_config: Dict[str, Any] = field(default_factory=dict)
     num_env_runners: int = 2
     rollout_length: int = 256
+    num_learners: int = 1          # >1: DDP LearnerGroup fan-out
+    num_cpus_per_learner: float = 1.0
+    num_tpus_per_learner: float = 0.0
     lr: float = 3e-4
     gamma: float = 0.99
     lambda_: float = 0.95
@@ -129,13 +134,34 @@ class PPOLearner:
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
+        # split grad/apply pair for the DDP LearnerGroup path: gradients
+        # leave jit, get allreduced across learner actors, come back
+        @jax.jit
+        def grad(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def apply(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+            return _optax.apply_updates(params, updates), opt_state
+
         self._update = update
+        self._grad = grad
+        self._apply = apply
 
     def init_state(self, key):
         params = self.module.init_params(key)
         return params, self.tx.init(params)
 
-    def update(self, params, opt_state, train_batch: Dict[str, np.ndarray]):
+    def update(self, params, opt_state, train_batch: Dict[str, np.ndarray],
+               allreduce: Optional[Callable] = None):
+        """Minibatch SGD epochs.  With ``allreduce`` (LearnerGroup DDP),
+        every step's gradients are averaged across learners before the
+        optimizer applies them — all learners take identical steps."""
         import jax.numpy as jnp
         cfg = self.config
         n = len(train_batch["obs"])
@@ -147,8 +173,14 @@ class PPOLearner:
                 idx = perm[start:start + cfg.minibatch_size]
                 mb = {k: jnp.asarray(v[idx]) for k, v in
                       train_batch.items() if k != "bootstrap_value"}
-                params, opt_state, metrics = self._update(
-                    params, opt_state, mb)
+                if allreduce is None:
+                    params, opt_state, metrics = self._update(
+                        params, opt_state, mb)
+                else:
+                    grads, metrics = self._grad(params, mb)
+                    grads = allreduce(grads)
+                    params, opt_state = self._apply(params, opt_state,
+                                                    grads)
         return params, opt_state, {k: float(v)
                                    for k, v in metrics.items()}
 
@@ -168,9 +200,19 @@ class PPO:
         self.module = DiscreteMLPModule(MLPModuleConfig(
             obs_dim=obs_dim, num_actions=num_actions,
             hidden=tuple(config.hidden)))
-        self.learner = PPOLearner(self.module, config)
-        self.params, self.opt_state = self.learner.init_state(
-            jax.random.PRNGKey(config.seed))
+        self.learner_group = None
+        if config.num_learners > 1:
+            from ray_tpu.rllib.core.learner_group import LearnerGroup
+            self.learner_group = LearnerGroup(
+                self.module, config, num_learners=config.num_learners,
+                num_cpus_per_learner=config.num_cpus_per_learner,
+                num_tpus_per_learner=config.num_tpus_per_learner)
+            self.params, self.opt_state = None, None
+            self.learner = None
+        else:
+            self.learner = PPOLearner(self.module, config)
+            self.params, self.opt_state = self.learner.init_state(
+                jax.random.PRNGKey(config.seed))
         blob = cloudpickle.dumps(self.module)
         self.env_runners = [
             SingleAgentEnvRunner.remote(
@@ -182,8 +224,13 @@ class PPO:
 
     def train(self) -> Dict[str, Any]:
         t0 = time.time()
-        params_np = ray_tpu.put(
-            __import__("jax").tree.map(np.asarray, self.params))
+        if self.learner_group is not None:
+            # ref straight from the rank-0 learner into the env runners:
+            # no driver round-trip or re-put of the full param tree
+            params_np = self.learner_group.get_params_ref()
+        else:
+            params_np = ray_tpu.put(
+                __import__("jax").tree.map(np.asarray, self.params))
         batches = ray_tpu.get(
             [runner.sample.remote(params_np)
              for runner in self.env_runners], timeout=600)
@@ -193,8 +240,12 @@ class PPO:
         train_batch = {
             k: np.concatenate([p[k] for p in processed])
             for k in processed[0] if k != "bootstrap_value"}
-        self.params, self.opt_state, learner_metrics = \
-            self.learner.update(self.params, self.opt_state, train_batch)
+        if self.learner_group is not None:
+            learner_metrics = self.learner_group.update(train_batch)
+        else:
+            self.params, self.opt_state, learner_metrics = \
+                self.learner.update(self.params, self.opt_state,
+                                    train_batch)
         runner_metrics = ray_tpu.get(
             [r.get_metrics.remote() for r in self.env_runners],
             timeout=120)
@@ -219,6 +270,8 @@ class PPO:
                 ray_tpu.kill(runner)
             except Exception:  # noqa: BLE001
                 pass
+        if self.learner_group is not None:
+            self.learner_group.stop()
 
     # Tune integration: PPO as a function trainable
     @staticmethod
