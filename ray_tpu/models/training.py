@@ -186,6 +186,10 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                 return y, None
             if cfg.remat:
                 body = jax.checkpoint(body)
+            if cfg.unroll_layers:
+                for i in range(Ls):
+                    a, _ = body(a, jax.tree.map(lambda t: t[i], sp))
+                return a
             a, _ = jax.lax.scan(body, a, sp)
             return a
 

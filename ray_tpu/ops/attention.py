@@ -305,7 +305,7 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, do):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-def supports(S: int, Sk: int, D: int, *, block_q: int = 512,
+def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
              block_k: int = 1024) -> bool:
     """Shapes the kernel grid can tile (fallback to einsum otherwise)."""
     bq, bk = min(block_q, S), min(block_k, Sk)
@@ -314,7 +314,7 @@ def supports(S: int, Sk: int, D: int, *, block_q: int = 512,
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    scale: Optional[float] = None, block_q: int = 512,
+                    scale: Optional[float] = None, block_q: int = 1024,
                     block_k: int = 1024):
     """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
 
@@ -336,7 +336,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
 
 
 def make_flash_attention_fn(mesh=None, *, causal: bool = True,
-                            block_q: int = 512, block_k: int = 1024):
+                            block_q: int = 1024, block_k: int = 1024):
     """Mesh-aware flash attention (drop-in for ``make_ring_attention_fn``).
 
     A ``pallas_call`` has no SPMD partitioning rule, so on a >1-device

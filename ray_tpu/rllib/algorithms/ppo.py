@@ -252,7 +252,10 @@ class PPO:
         returns = [m["episode_return_mean"] for m in runner_metrics
                    if not np.isnan(m["episode_return_mean"])]
         self.iteration += 1
-        self.timesteps_total += len(train_batch["obs"])
+        n = len(train_batch["obs"])
+        if self.learner_group is not None:
+            n -= n % self.learner_group.world  # trimmed rows never train
+        self.timesteps_total += n
         return {
             "training_iteration": self.iteration,
             "timesteps_total": self.timesteps_total,

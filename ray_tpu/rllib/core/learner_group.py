@@ -43,8 +43,7 @@ class _LearnerActor:
         self.params, self.opt_state = self.learner.init_state(
             jax.random.PRNGKey(config.seed))
         from jax.flatten_util import ravel_pytree
-        flat, self._unravel = ravel_pytree(self.params)
-        self._grad_size = flat.shape[0]
+        _, self._unravel = ravel_pytree(self.params)
 
     def _allreduce(self, grads):
         from jax.flatten_util import ravel_pytree
@@ -96,8 +95,12 @@ class LearnerGroup:
         batch is trimmed to a multiple of the world size."""
         n = len(train_batch["obs"])
         usable = n - n % self.world
-        shards: List[Dict[str, np.ndarray]] = []
         per = usable // self.world
+        if per == 0:
+            raise ValueError(
+                f"train batch of {n} rows cannot feed {self.world} "
+                "learners — reduce num_learners or sample more")
+        shards: List[Dict[str, np.ndarray]] = []
         for r in range(self.world):
             sl = slice(r * per, (r + 1) * per)
             shards.append({k: v[sl] for k, v in train_batch.items()

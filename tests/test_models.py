@@ -99,3 +99,25 @@ def test_graft_entry():
     out = jax.eval_shape(fn, *args)
     assert out.shape[-1] == 32768
     mod.dryrun_multichip(8)
+
+
+def test_unrolled_layers_match_scan():
+    """cfg.unroll_layers + ce_chunk are pure perf knobs: identical loss
+    to the scan path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt, training
+    from ray_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 4, 32, 256)
+    losses = []
+    for unroll, chunk in [(False, 4096), (True, 0), (True, 64)]:
+        cfg = gpt.GPTConfig(vocab_size=256, d_model=32, n_layers=3,
+                            n_heads=4, max_seq=32, dtype=jnp.float32,
+                            unroll_layers=unroll, ce_chunk=chunk)
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+        losses.append(float(gpt.loss_fn(params, batch, cfg)))
+    assert abs(losses[0] - losses[1]) < 1e-4
+    assert abs(losses[0] - losses[2]) < 1e-4
