@@ -127,4 +127,24 @@ class MethodNode(DAGNode):
         return self._resolve({}, args)
 
 
-MultiOutputNode = list  # API stub: DAGs with several outputs
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves into one executable DAG (parity:
+    ``python/ray/dag/output_node.py``): ``execute()`` resolves every
+    branch against one shared cache, so diamond dependencies submit
+    each upstream task exactly once, and returns one ref per output."""
+
+    def __init__(self, outputs):
+        self.outputs = list(outputs)
+
+    def __iter__(self):
+        return iter(self.outputs)
+
+    def __len__(self):
+        return len(self.outputs)
+
+    def __getitem__(self, i):
+        return self.outputs[i]
+
+    def _resolve(self, cache, exec_args):
+        return [o._resolve(cache, exec_args) if isinstance(o, DAGNode)
+                else o for o in self.outputs]

@@ -62,20 +62,31 @@ def _batch_sharding(mesh):
 
 
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
-                    optimizer=None) -> Dict[str, Callable]:
+                    optimizer=None,
+                    sp_impl: str = "ring") -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
     (state, metrics); batch = dict(tokens, targets) [B, S] int32.
+    ``sp_impl``: how sequence parallelism moves data on sp>1 meshes —
+    "ring" (ring attention) or "ulysses" (all-to-all head resharding).
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
 
     tx = optimizer or default_optimizer()
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
-    attn_fn = (make_ring_attention_fn(mesh, causal=True)
-               if mesh.shape.get("sp", 1) > 1
-               else make_flash_attention_fn(mesh, causal=True))
+    if mesh.shape.get("sp", 1) > 1:
+        if sp_impl == "ulysses":
+            from ray_tpu.parallel.ulysses import make_ulysses_attention_fn
+            attn_fn = make_ulysses_attention_fn(mesh, causal=True)
+        elif sp_impl == "ring":
+            attn_fn = make_ring_attention_fn(mesh, causal=True)
+        else:
+            raise ValueError(f"unknown sp_impl {sp_impl!r}; "
+                             "expected 'ring' or 'ulysses'")
+    else:
+        attn_fn = make_flash_attention_fn(mesh, causal=True)
     batch_sh = _batch_sharding(mesh)
 
     def loss(params, batch):

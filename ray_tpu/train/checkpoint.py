@@ -45,8 +45,13 @@ class Checkpoint:
 
     # ------------------------------------------------------------ metadata
     def set_metadata(self, metadata: Dict[str, Any]) -> None:
-        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+        # tmp + rename: a crash mid-write must not leave a torn file
+        # that breaks the next run's rehydration
+        target = os.path.join(self.path, _METADATA_FILE)
+        tmp = target + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(metadata, f)
+        os.replace(tmp, target)
 
     def get_metadata(self) -> Dict[str, Any]:
         p = os.path.join(self.path, _METADATA_FILE)

@@ -15,18 +15,61 @@ from ray_tpu.train.config import CheckpointConfig
 
 
 class CheckpointManager:
-    def __init__(self, storage_dir: str, config: CheckpointConfig):
+    def __init__(self, storage_dir: str, config: CheckpointConfig,
+                 resume: bool = False):
         self.storage_dir = storage_dir
         self.config = config
         self._index = 0
         # list of (score, index, checkpoint, metrics)
         self.best: List[Tuple[float, int, Checkpoint, Dict]] = []
         self.latest: Optional[Checkpoint] = None
+        if resume:
+            # only a restored trainer adopts prior checkpoints — a fresh
+            # run reusing an experiment name must not warm-start from a
+            # previous run's weights
+            self._rehydrate()
+
+    def _rehydrate(self) -> None:
+        """Adopt checkpoints a previous run left in the directory, so a
+        restored trainer resumes from its latest (reference:
+        experiment-state reconstruction on Trainer.restore)."""
+        import glob
+        import re
+        found = []
+        for path in glob.glob(os.path.join(self.storage_dir,
+                                           "checkpoint_*")):
+            m = re.search(r"checkpoint_(\d+)", os.path.basename(path))
+            if m and os.path.isdir(path):
+                found.append((int(m.group(1)), path))
+        for idx, path in sorted(found):
+            ckpt = Checkpoint(path)
+            self.latest = ckpt
+            self._index = max(self._index, idx + 1)
+            try:
+                metrics = ckpt.get_metadata().get("metrics", {})
+            except Exception:  # noqa: BLE001 — torn metadata write
+                metrics = {}
+            attr = self.config.checkpoint_score_attribute
+            if attr is not None and attr in metrics:
+                score = float(metrics[attr])
+            else:
+                score = float(idx + 1)
+            sign = (1.0 if self.config.checkpoint_score_order == "max"
+                    else -1.0)
+            self.best.append((sign * score, idx + 1, ckpt, metrics))
+        self.best.sort(key=lambda t: (t[0], t[1]), reverse=True)
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:
         persisted = checkpoint.persist(
             self.storage_dir, f"checkpoint_{self._index:06d}")
+        try:
+            meta = persisted.get_metadata()
+            meta["metrics"] = {k: v for k, v in metrics.items()
+                               if isinstance(v, (int, float, str, bool))}
+            persisted.set_metadata(meta)
+        except Exception:  # noqa: BLE001 — metadata is best-effort
+            pass
         self._index += 1
         self.latest = persisted
         attr = self.config.checkpoint_score_attribute

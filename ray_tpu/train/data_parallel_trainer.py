@@ -44,6 +44,7 @@ class DataParallelTrainer:
                 f"{type(self).__name__}_{uuid.uuid4().hex[:8]}")
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        self._restored = False  # set by restore(): adopt prior checkpoints
 
     # ------------------------------------------------------------------
     def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
@@ -61,12 +62,28 @@ class DataParallelTrainer:
                     shards[i][name] = ds
         return shards
 
+    def _save_trainer_blob(self, storage: str) -> None:
+        """Persist enough to reconstruct this trainer for ``restore``
+        (datasets are excluded: they hold live ObjectRefs; resupply them
+        at restore time)."""
+        import cloudpickle
+        with open(os.path.join(storage, "trainer.pkl"), "wb") as f:
+            cloudpickle.dump({
+                "cls": type(self),
+                "train_loop_per_worker": self.train_loop_per_worker,
+                "train_loop_config": self.train_loop_config,
+                "backend_config": self.backend_config,
+                "scaling_config": self.scaling_config,
+                "run_config": self.run_config,
+            }, f)
+
     def fit(self) -> Result:
         storage = self.run_config.resolved_storage_path()
         os.makedirs(storage, exist_ok=True)
+        self._save_trainer_blob(storage)
         ckpt_mgr = CheckpointManager(
             os.path.join(storage, "checkpoints"),
-            self.run_config.checkpoint_config)
+            self.run_config.checkpoint_config, resume=self._restored)
         max_failures = self.run_config.failure_config.max_failures
         attempts = (max_failures + 1) if max_failures >= 0 else 10**6
         history: List[Dict[str, Any]] = []
@@ -125,9 +142,31 @@ class DataParallelTrainer:
         return result
 
     @classmethod
-    def restore(cls, path: str, **kwargs) -> "DataParallelTrainer":
-        raise NotImplementedError(
-            "restore() lands with the Tune experiment-state integration")
+    def restore(cls, path: str,
+                train_loop_per_worker: Optional[Callable] = None,
+                datasets: Optional[Dict[str, Any]] = None,
+                **overrides) -> "DataParallelTrainer":
+        """Rebuild an interrupted trainer from its storage directory.
+
+        ``fit()`` then resumes from the latest checkpoint the previous
+        run registered (the checkpoint manager lives in the same
+        directory).  Parity: ``BaseTrainer.restore``
+        (``python/ray/train/base_trainer.py``).
+        """
+        import cloudpickle
+        with open(os.path.join(path, "trainer.pkl"), "rb") as f:
+            blob = cloudpickle.load(f)
+        trainer_cls = blob.pop("cls", cls)
+        loop = train_loop_per_worker or blob.pop("train_loop_per_worker")
+        blob.pop("train_loop_per_worker", None)
+        blob.update(overrides)
+        trainer = trainer_cls(loop, datasets=datasets, **blob)
+        trainer._restored = True
+        return trainer
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "trainer.pkl"))
 
     def as_trainable(self):
         """Adapter so Tune can run this trainer as a trial."""

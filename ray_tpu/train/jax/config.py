@@ -100,7 +100,11 @@ class JaxTrainer(DataParallelTrainer):
     """
 
     def __init__(self, train_loop_per_worker, *, jax_config:
-                 Optional[JaxConfig] = None, **kwargs):
+                 Optional[JaxConfig] = None,
+                 backend_config: Optional[JaxConfig] = None, **kwargs):
+        # backend_config accepted as an alias so restore() can rebuild
+        # a JaxTrainer from the generic trainer blob
         super().__init__(train_loop_per_worker,
-                         backend_config=jax_config or JaxConfig(),
+                         backend_config=jax_config or backend_config
+                         or JaxConfig(),
                          **kwargs)

@@ -108,7 +108,11 @@ def prepare_data_loader(data_loader):
 
 class TorchTrainer(DataParallelTrainer):
     def __init__(self, train_loop_per_worker, *,
-                 torch_config: Optional[TorchConfig] = None, **kwargs):
+                 torch_config: Optional[TorchConfig] = None,
+                 backend_config: Optional[TorchConfig] = None, **kwargs):
+        # backend_config accepted as an alias so restore() can rebuild
+        # a TorchTrainer from the generic trainer blob
         super().__init__(train_loop_per_worker,
-                         backend_config=torch_config or TorchConfig(),
+                         backend_config=torch_config or backend_config
+                         or TorchConfig(),
                          **kwargs)

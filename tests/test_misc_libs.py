@@ -157,3 +157,44 @@ def test_resnet18_step():
     labels = jnp.zeros((4,), jnp.int32)
     state, metrics = fns["step_fn"](state, (images, labels))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_dag_multi_output_node(ray_start_regular):
+    """MultiOutputNode bundles branches; shared upstream runs once
+    (parity: python/ray/dag/output_node.py)."""
+    ray = ray_start_regular
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray.remote
+    class Tally:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, x):
+            self.n += 1
+            return x + 100
+
+        def count(self):
+            return self.n
+
+    t = Tally.remote()
+
+    @ray.remote
+    def shared(x):
+        return ray.get(t.bump.remote(x))
+
+    @ray.remote
+    def left(x):
+        return x * 2
+
+    @ray.remote
+    def right(x):
+        return x * 3
+
+    with InputNode() as inp:
+        s = shared.bind(inp)
+        dag = MultiOutputNode([left.bind(s), right.bind(s)])
+
+    refs = dag.execute(1)
+    assert ray.get(refs, timeout=60) == [202, 303]
+    assert ray.get(t.count.remote(), timeout=30) == 1  # shared ran once

@@ -145,3 +145,33 @@ def test_gpt_pipeline_parallel_matches_dense():
     for _ in range(8):
         s, m = fns2["step_fn"](s, batch)
     assert float(m["loss"]) < l_ref - 0.5
+
+
+def test_ulysses_attention_matches_local():
+    """Ulysses all-to-all SP == unsharded attention, values and grads
+    (SURVEY §2.4 'Ulysses' row)."""
+    from ray_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+    mesh = make_mesh(dp=2, sp=4)
+    B, S, H, D = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D))
+    k = jax.random.normal(ks[1], (B, S, H, D))
+    v = jax.random.normal(ks[2], (B, S, H, D))
+
+    fn = make_ulysses_attention_fn(mesh, causal=True)
+    out = jax.jit(fn)(q, k, v)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    g1 = jax.jit(jax.grad(lambda q: (fn(q, k, v) ** 2).sum()))(q)
+    g2 = jax.grad(lambda q: (local_attention(q, k, v, causal=True) ** 2
+                             ).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-3, atol=2e-4)
+
+    # sp=1 mesh degrades to plain attention
+    fn1 = make_ulysses_attention_fn(make_mesh(dp=2), causal=True)
+    np.testing.assert_allclose(np.asarray(fn1(q, k, v)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
