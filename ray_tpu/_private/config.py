@@ -125,6 +125,11 @@ _d("cp_journal_sync", False,
 _d("cp_journal_compact_records", 100_000,
    "Snapshot-compact the journal once this many records accumulate.")
 
+# --- observability ---------------------------------------------------------
+_d("log_to_driver", True,
+   "Stream worker stdout/stderr lines to the driver console via the "
+   "control-plane pubsub (reference: _private/log_monitor.py).")
+
 # --- networking ------------------------------------------------------------
 _d("use_tcp", False,
    "Bind control plane and node managers on TCP instead of unix sockets "

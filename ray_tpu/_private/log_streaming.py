@@ -1,0 +1,123 @@
+"""Worker log streaming to the driver.
+
+Parity: the reference's log monitor → driver flow
+(``python/ray/_private/log_monitor.py``): worker stdout/stderr still
+land in per-worker files (subprocess redirection), but a tee inside the
+worker also publishes complete lines to the control-plane pubsub; the
+driver runs a background poller printing them with a
+``(worker_id, pid)`` prefix.  Disable with
+``init(_system_config={"log_to_driver": False})``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import threading
+from typing import Optional
+
+CHANNEL = "worker_logs"
+
+
+_MAX_BUFFERED = 8192
+
+
+class _TeeStream(io.TextIOBase):
+    """Write-through to the original stream + line-buffered publish.
+
+    Thread-safe ('\\n' and '\\r' both delimit lines; the buffer is
+    force-flushed at ``_MAX_BUFFERED`` so progress bars that never emit
+    a newline can't grow it without bound)."""
+
+    def __init__(self, base, publish, stream_name: str):
+        self._base = base
+        self._publish = publish
+        self._name = stream_name
+        self._buf = ""
+        self._lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        n = self._base.write(s)
+        lines = []
+        with self._lock:
+            self._buf += s
+            normalized = self._buf.replace("\r", "\n")
+            while "\n" in normalized:
+                line, normalized = normalized.split("\n", 1)
+                if line:
+                    lines.append(line)
+            if len(normalized) > _MAX_BUFFERED:
+                lines.append(normalized)
+                normalized = ""
+            self._buf = normalized
+        for line in lines:
+            self._publish(self._name, line)
+        return n
+
+    def flush(self) -> None:
+        self._base.flush()
+
+    @property
+    def encoding(self):
+        return getattr(self._base, "encoding", "utf-8")
+
+    def fileno(self):
+        return self._base.fileno()
+
+    def isatty(self):
+        return False
+
+
+def install_worker_tee(cp, worker_id: bytes) -> None:
+    """Route this worker's stdout/stderr lines to the CP pubsub."""
+    pid = os.getpid()
+    wid = worker_id.hex()[:12]
+
+    def publish(stream_name: str, line: str) -> None:
+        try:
+            cp.publish(CHANNEL, {"worker": wid, "pid": pid,
+                                 "stream": stream_name, "line": line})
+        except Exception:  # noqa: BLE001 — logging must never kill work
+            pass
+
+    sys.stdout = _TeeStream(sys.stdout, publish, "out")
+    sys.stderr = _TeeStream(sys.stderr, publish, "err")
+
+
+class DriverLogMonitor:
+    """Background poller printing streamed worker lines on the driver."""
+
+    def __init__(self, cp, out=None):
+        self._cp = cp
+        self._out = out
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="driver-log-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        cursor = 0
+        while not self._stop.is_set():
+            try:
+                cursor, msgs = self._cp.poll(CHANNEL, cursor, 2.0)
+            except Exception:  # noqa: BLE001 — head restarting
+                if self._stop.wait(1.0):
+                    return
+                continue
+            out = self._out or sys.stdout
+            for m in msgs:
+                tag = "" if m.get("stream") == "out" else " [err]"
+                try:
+                    print(f"({m['worker']} pid={m['pid']}){tag} "
+                          f"{m['line']}", file=out, flush=True)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)

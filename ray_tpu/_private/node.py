@@ -160,6 +160,11 @@ class HeadNode:
         self._gc_thread = threading.Thread(
             target=self._gc_loop, daemon=True, name="head-object-gc")
         self._gc_thread.start()
+        self.log_monitor = None
+        if GLOBAL_CONFIG.log_to_driver:
+            from ray_tpu._private.log_streaming import DriverLogMonitor
+            self.log_monitor = DriverLogMonitor(self.control_plane)
+            self.log_monitor.start()
         atexit.register(self.shutdown)
 
     # ------------------------------------------------------------------
@@ -359,6 +364,8 @@ class HeadNode:
             except subprocess.TimeoutExpired:
                 proc.kill()
         self.node_manager.stop()
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
         self.cp_server.shutdown()
         if self.cp_journal is not None:
             self.cp_journal.close()

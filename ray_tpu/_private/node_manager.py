@@ -731,6 +731,8 @@ class NodeManager:
             "RAY_TPU_NODE_ID": self.node_id.hex(),
             "RAY_TPU_SHM_ROOT": self.store.root,
             "RAY_TPU_SPILL_DIR": self.store.spill_dir or "",
+            "RAY_TPU_LOG_TO_DRIVER":
+                "1" if GLOBAL_CONFIG.log_to_driver else "0",
         })
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)

@@ -1,0 +1,81 @@
+"""Runtime environment application inside workers.
+
+Parity: the reference's runtime-env agent
+(``python/ray/_private/runtime_env/agent/runtime_env_agent.py:161``),
+compressed to what a single-image TPU cluster needs:
+
+- ``env_vars``: set for the duration of the task/actor (restored after
+  tasks; actors keep them for life — the process is theirs).
+- ``working_dir``: a local directory to chdir into (local paths only —
+  remote URIs need an artifact store; raise rather than half-apply).
+- ``pip`` / ``conda``: rejected loudly — the cluster image is immutable
+  by design (no network egress on TPU pods at runtime).
+
+``applied(spec)`` is a context manager used around non-actor tasks;
+``apply(env)`` applies permanently (actor creation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, Optional
+
+_SUPPORTED = {"env_vars", "working_dir"}
+_REJECTED = {"pip", "conda", "py_modules", "container"}
+
+
+def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    env = env or {}
+    bad = _REJECTED & set(env)
+    if bad:
+        raise ValueError(
+            f"runtime_env keys {sorted(bad)} are not supported: the "
+            "cluster image is immutable (install dependencies in the "
+            "image; reference parity: runtime_env_agent)")
+    unknown = set(env) - _SUPPORTED - _REJECTED
+    # unknown keys are ignored (forward compatibility), not fatal
+    return {k: env[k] for k in _SUPPORTED if k in env}
+
+
+def apply(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Apply permanently (actor creation); returns the undo state."""
+    env = validate(env)
+    undo: Dict[str, Any] = {"env_vars": {}, "cwd": None}
+    for key, value in (env.get("env_vars") or {}).items():
+        undo["env_vars"][key] = os.environ.get(key)
+        os.environ[key] = str(value)
+    wd = env.get("working_dir")
+    if wd:
+        if not os.path.isdir(wd):
+            raise ValueError(f"runtime_env working_dir {wd!r} does not "
+                             "exist on this node")
+        undo["cwd"] = os.getcwd()
+        os.chdir(wd)
+    return undo
+
+
+def undo(state: Dict[str, Any]) -> None:
+    for key, old in state.get("env_vars", {}).items():
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old
+    if state.get("cwd"):
+        try:
+            os.chdir(state["cwd"])
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
+def applied(env: Optional[Dict[str, Any]]):
+    """Scoped application around one task on a pooled worker."""
+    if not env:
+        yield
+        return
+    state = apply(env)
+    try:
+        yield
+    finally:
+        undo(state)

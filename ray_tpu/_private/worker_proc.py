@@ -50,6 +50,9 @@ class WorkerProcess:
         set_global_worker(self.core)
         from ray_tpu._private.ref_tracker import install_tracker
         install_tracker(self.worker_id.binary(), self.cp)
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") == "1":
+            from ray_tpu._private.log_streaming import install_worker_tee
+            install_worker_tee(self.cp, self.worker_id.binary())
         # actor execution machinery (populated on creation)
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
@@ -159,13 +162,15 @@ class WorkerProcess:
         error = False
         error_payload = None
         try:
+            from ray_tpu._private import runtime_env as _renv
             self._set_visible_chips(chips)
             fn = self.core.load_function(spec.function_key)
             args, kwargs = self._resolve_args(spec)
-            if inspect.iscoroutinefunction(fn):
-                result = asyncio.run(fn(*args, **kwargs))
-            else:
-                result = fn(*args, **kwargs)
+            with _renv.applied(spec.runtime_env):
+                if inspect.iscoroutinefunction(fn):
+                    result = asyncio.run(fn(*args, **kwargs))
+                else:
+                    result = fn(*args, **kwargs)
             self._commit_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             error = True
@@ -184,7 +189,11 @@ class WorkerProcess:
 
     def _execute_creation(self, spec: TaskSpec, chips):
         try:
+            from ray_tpu._private import runtime_env as _renv
             self._set_visible_chips(chips)
+            if spec.runtime_env:
+                # actors own their process: applied for life
+                _renv.apply(spec.runtime_env)
             cls = self.core.load_function(spec.function_key)
             args, kwargs = self._resolve_args(spec)
             instance = cls(*args, **kwargs)

@@ -1,0 +1,179 @@
+"""Autoscaler — demand-driven node provisioning.
+
+Parity: reference autoscaler v1/v2
+(``python/ray/autoscaler/_private/autoscaler.py:1``,
+``autoscaler/v2``): a monitor loop reads cluster load from the control
+plane (per-node queue depth piggybacked on heartbeats), launches worker
+nodes through a ``NodeProvider`` while demand is sustained, and reaps
+nodes that stay idle past ``idle_timeout_s``.
+
+Providers: ``LocalNodeProvider`` spawns real extra node-manager
+processes on this host (the multi-node-on-one-host simulation the test
+suite uses everywhere); a cloud provider for TPU pods implements the
+same three methods against its VM API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class NodeProvider:
+    """Minimal provider surface (reference: node_provider.py)."""
+
+    def create_node(self) -> bytes:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: bytes) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[bytes]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Real extra node processes on this host (cluster_utils parity)."""
+
+    def __init__(self, worker_resources: Optional[Dict[str, float]] = None):
+        from ray_tpu._private.worker import global_node
+        self._node = global_node()
+        self.worker_resources = worker_resources or {"CPU": 2.0}
+        self._nodes: List[bytes] = []
+
+    def create_node(self) -> bytes:
+        res = dict(self.worker_resources)
+        cpus = res.pop("CPU", 1.0)
+        tpus = res.pop("TPU", 0.0)
+        node_id = self._node.add_node(num_cpus=cpus, num_tpus=tpus,
+                                      resources=res or None)
+        self._nodes.append(node_id)
+        return node_id
+
+    def terminate_node(self, node_id: bytes) -> None:
+        self._node.remove_node(node_id)
+        if node_id in self._nodes:
+            self._nodes.remove(node_id)
+
+    def non_terminated_nodes(self) -> List[bytes]:
+        return list(self._nodes)
+
+
+@dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 2
+    # pending work must persist this long before a node launches
+    upscale_delay_s: float = 1.0
+    # a provider node with zero load/zero busy resources this long is
+    # terminated
+    idle_timeout_s: float = 10.0
+    tick_s: float = 0.5
+
+
+class StandardAutoscaler:
+    """Monitor thread: scale the provider between min and max workers."""
+
+    def __init__(self, provider: NodeProvider,
+                 config: Optional[AutoscalerConfig] = None):
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._stop = threading.Event()
+        self._pending_since: Optional[float] = None
+        self._idle_since: Dict[bytes, float] = {}
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[str] = []  # human-readable scaling decisions
+
+    # -- cluster state -------------------------------------------------
+    @staticmethod
+    def _nodes() -> List[Dict[str, Any]]:
+        from ray_tpu._private.worker import global_worker
+        return global_worker().cp.list_nodes()
+
+    def start(self) -> None:
+        for _ in range(self.config.min_workers):
+            self.provider.create_node()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — keep the monitor alive
+                pass
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        nodes = {n["node_id"]: n for n in self._nodes()
+                 if n.get("state") == "ALIVE"}
+        pending = sum((n.get("load") or {}).get("num_pending", 0)
+                      for n in nodes.values())
+        # the max_workers bound counts every provider node, including
+        # ones still booting (not ALIVE yet) — otherwise slow startup
+        # lets sustained demand overshoot the cap
+        provisioned = self.provider.non_terminated_nodes()
+        managed = [nid for nid in provisioned if nid in nodes]
+
+        # ---- scale up: sustained unservable demand
+        if pending > 0:
+            if self._pending_since is None:
+                self._pending_since = now
+            elif (now - self._pending_since >=
+                  self.config.upscale_delay_s
+                  and len(provisioned) < self.config.max_workers):
+                # record the decision before the (blocking) launch —
+                # node startup can take seconds and observability should
+                # reflect when scaling was *chosen*
+                self.events.append(f"up: +node (pending={pending})")
+                self._pending_since = None
+                node_id = self.provider.create_node()
+                self.events.append(
+                    f"up: node {node_id.hex()[:8]} ready")
+        else:
+            self._pending_since = None
+
+        # ---- scale down: provider nodes idle past the timeout
+        alive_count = len(managed)
+        for nid in list(managed):
+            info = nodes[nid]
+            load = (info.get("load") or {}).get("num_pending", 0)
+            avail = info.get("resources_available") or {}
+            total = info.get("resources_total") or {}
+            busy = any(avail.get(k, 0) < total.get(k, 0) for k in total)
+            if load == 0 and not busy:
+                self._idle_since.setdefault(nid, now)
+                if (now - self._idle_since[nid] >=
+                        self.config.idle_timeout_s
+                        and alive_count > self.config.min_workers):
+                    self.provider.terminate_node(nid)
+                    self.events.append(f"down: -node {nid.hex()[:8]}")
+                    self._idle_since.pop(nid, None)
+                    alive_count -= 1
+            else:
+                self._idle_since.pop(nid, None)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def request_resources(num_cpus: float = 0,
+                      bundles: Optional[List[Dict]] = None) -> None:
+    """API parity stub for ``ray.autoscaler.sdk.request_resources``:
+    demand is inferred from queue depth; explicit requests are recorded
+    as a KV hint for operators."""
+    import json
+
+    from ray_tpu._private.worker import global_worker
+    global_worker().cp.kv_put(
+        b"autoscaler_request",
+        json.dumps({"num_cpus": num_cpus,
+                    "bundles": bundles or []}).encode(),
+        namespace="_autoscaler")

@@ -161,6 +161,40 @@ def cmd_dashboard(args):
         time.sleep(3600)
 
 
+def cmd_jobs(args):
+    """``ray-tpu jobs ...`` against the live session's job table
+    (parity: ``ray job submit/status/logs/list/stop``)."""
+    import json
+    if args.jobs_command == "submit":
+        # submission starts a runtime in this shell, so the CLI always
+        # waits for completion: exiting earlier would tear the runtime
+        # (and the job's supervisor) down with it
+        import ray_tpu
+        from ray_tpu.job import JobSubmissionClient
+        ray_tpu.init(ignore_reinit_error=True)
+        c = JobSubmissionClient()
+        jid = c.submit_job(entrypoint=args.entrypoint)
+        print(jid)
+        status = c.wait_until_finished(jid, timeout=args.timeout)
+        print(status)
+        print(c.get_job_logs(jid), end="")
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+    client = _connect_cp()
+    # read-only commands ride the CP KV of the running session
+    if args.jobs_command == "list":
+        for key in client.call("kv_keys", b"", "_jobs"):
+            raw = client.call("kv_get", key, "_jobs")
+            info = json.loads(raw.decode())
+            print(f"{info['submission_id']}  {info['status']:9s}  "
+                  f"{info['entrypoint'][:60]}")
+    elif args.jobs_command == "status":
+        raw = client.call("kv_get", args.job_id.encode(), "_jobs")
+        if raw is None:
+            print(f"no job {args.job_id}", file=sys.stderr)
+            sys.exit(1)
+        print(json.loads(raw.decode())["status"])
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -176,11 +210,19 @@ def main(argv=None):
     p_mb.add_argument("--duration", type=float, default=2.0)
     p_db = sub.add_parser("dashboard")
     p_db.add_argument("--port", type=int, default=8265)
+    p_jobs = sub.add_parser("jobs")
+    jobs_sub = p_jobs.add_subparsers(dest="jobs_command", required=True)
+    p_submit = jobs_sub.add_parser("submit")
+    p_submit.add_argument("entrypoint")
+    p_submit.add_argument("--timeout", type=float, default=600.0)
+    jobs_sub.add_parser("list")
+    p_jstat = jobs_sub.add_parser("status")
+    p_jstat.add_argument("job_id")
     args = parser.parse_args(argv)
     {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
      "timeline": cmd_timeline, "memory": cmd_memory,
      "microbenchmark": cmd_microbenchmark,
-     "dashboard": cmd_dashboard}[args.command](args)
+     "dashboard": cmd_dashboard, "jobs": cmd_jobs}[args.command](args)
 
 
 if __name__ == "__main__":
