@@ -57,6 +57,10 @@ class _TeeStream(io.TextIOBase):
 
     def flush(self) -> None:
         self._base.flush()
+        with self._lock:
+            rest, self._buf = self._buf, ""
+        if rest:
+            self._publish(self._name, rest)
 
     @property
     def encoding(self):
@@ -70,17 +74,58 @@ class _TeeStream(io.TextIOBase):
 
 
 def install_worker_tee(cp, worker_id: bytes) -> None:
-    """Route this worker's stdout/stderr lines to the CP pubsub."""
+    """Route this worker's stdout/stderr lines to the CP pubsub.
+
+    Lines go through a bounded queue drained by one background thread —
+    a print must never block on a control-plane round trip, and a
+    storm of output drops lines (counted) rather than stalling work.
+    """
+    import atexit
+    import queue
+
     pid = os.getpid()
     wid = worker_id.hex()[:12]
+    q: "queue.Queue" = queue.Queue(maxsize=1000)
+    dropped = [0]
+
+    def pump():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            try:
+                cp.publish(CHANNEL, item)
+            except Exception:  # noqa: BLE001 — never kill work for logs
+                pass
+
+    t = threading.Thread(target=pump, daemon=True, name="log-tee-pump")
+    t.start()
 
     def publish(stream_name: str, line: str) -> None:
+        msg = {"worker": wid, "pid": pid, "stream": stream_name,
+               "line": line}
         try:
-            cp.publish(CHANNEL, {"worker": wid, "pid": pid,
-                                 "stream": stream_name, "line": line})
-        except Exception:  # noqa: BLE001 — logging must never kill work
-            pass
+            q.put_nowait(msg)
+        except queue.Full:
+            dropped[0] += 1
 
+    def drain():
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        if dropped[0]:
+            try:
+                cp.publish(CHANNEL, {
+                    "worker": wid, "pid": pid, "stream": "err",
+                    "line": f"[log tee dropped {dropped[0]} lines]"})
+            except Exception:  # noqa: BLE001
+                pass
+        q.put(None)
+        t.join(timeout=2)
+
+    atexit.register(drain)
     sys.stdout = _TeeStream(sys.stdout, publish, "out")
     sys.stderr = _TeeStream(sys.stderr, publish, "err")
 

@@ -41,15 +41,18 @@ def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
 def apply(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """Apply permanently (actor creation); returns the undo state."""
     env = validate(env)
+    # validate everything BEFORE mutating process state: a failure
+    # halfway must not leak env vars into a pooled worker (the undo
+    # state would never reach applied()'s finally)
+    wd = env.get("working_dir")
+    if wd and not os.path.isdir(wd):
+        raise ValueError(f"runtime_env working_dir {wd!r} does not "
+                         "exist on this node")
     undo: Dict[str, Any] = {"env_vars": {}, "cwd": None}
     for key, value in (env.get("env_vars") or {}).items():
         undo["env_vars"][key] = os.environ.get(key)
         os.environ[key] = str(value)
-    wd = env.get("working_dir")
     if wd:
-        if not os.path.isdir(wd):
-            raise ValueError(f"runtime_env working_dir {wd!r} does not "
-                             "exist on this node")
         undo["cwd"] = os.getcwd()
         os.chdir(wd)
     return undo

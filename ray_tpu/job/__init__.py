@@ -74,6 +74,11 @@ class _JobSupervisor:
         from ray_tpu._private.worker import global_worker
         self.submission_id = submission_id
         self._proc = None
+        existing = _get_info(submission_id)
+        if existing is not None and existing.status == "FAILED":
+            # the client gave up on this submission (tombstone): a
+            # late-starting supervisor must not resurrect the job
+            raise RuntimeError("job submission was aborted")
         session_dir = global_worker().session_dir if hasattr(
             global_worker(), "session_dir") else os.environ.get(
             "RAY_TPU_SESSION_DIR", "/tmp")
@@ -170,6 +175,13 @@ class JobSubmissionClient:
                               message=f"supervisor failed: {e}",
                               end_time=time.time(), metadata=metadata,
                               runtime_env=runtime_env))
+            # a slow supervisor may still come up later: kill it so it
+            # can't resurrect the job behind the caller's back
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(
+                    f"__job_{submission_id}"))
+            except Exception:  # noqa: BLE001
+                pass
             raise
         return submission_id
 

@@ -1,0 +1,242 @@
+"""DQN — deep Q-learning on JAX (double DQN + target network).
+
+Parity: reference ``rllib/algorithms/dqn/`` (new stack): env runners
+collect epsilon-greedy transitions into a replay buffer; the learner
+does jitted TD updates against a periodically-synced target network
+(double-DQN action selection).  TPU-first: one jit step over the
+sampled minibatch; the buffer stays in host numpy (HBM is for params
+and batches, not replay history).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule, MLPModuleConfig
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+
+@dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 1
+    rollout_length: int = 128
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    learn_start: int = 500          # min transitions before updates
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_freq: int = 200   # updates between target syncs
+    double_q: bool = True
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 5_000
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if rollout_length:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over numpy transition arrays (reference:
+    ``rllib/utils/replay_buffers/replay_buffer.py``)."""
+
+    def __init__(self, capacity: int, obs_shape, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity,) + tuple(obs_shape), np.float32)
+        self.next_obs = np.zeros_like(self.obs)
+        self.actions = np.zeros((capacity,), np.int64)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.terminateds = np.zeros((capacity,), np.float32)
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(batch["obs"])
+        for i in range(n):
+            j = self._idx
+            self.obs[j] = batch["obs"][i]
+            self.next_obs[j] = batch["next_obs"][i]
+            self.actions[j] = batch["actions"][i]
+            self.rewards[j] = batch["rewards"][i]
+            self.terminateds[j] = batch["terminateds"][i]
+            self._idx = (self._idx + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "terminateds": self.terminateds[idx]}
+
+
+class DQNLearner:
+    """Jitted double-DQN TD update (reference dqn_learner shape)."""
+
+    def __init__(self, module: DiscreteMLPModule, config: DQNConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.module = module
+        self.config = config
+        self.tx = optax.adam(config.lr)
+        cfg = config
+
+        def loss_fn(params, target_params, batch):
+            q, _ = module.forward(params, batch["obs"])
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], -1)[:, 0]
+            q_next_t, _ = module.forward(target_params,
+                                         batch["next_obs"])
+            if cfg.double_q:
+                q_next_online, _ = module.forward(params,
+                                                  batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, best[:, None], -1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=-1)
+            target = batch["rewards"] + cfg.gamma * \
+                (1.0 - batch["terminateds"]) * \
+                jax.lax.stop_gradient(q_next)
+            td = q_sa - target
+            loss = jnp.mean(optax.huber_loss(q_sa, target))
+            return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                          "q_mean": jnp.mean(q_sa)}
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+            params = _optax.apply_updates(params, updates)
+            metrics["loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = update
+
+    def init_state(self, key):
+        params = self.module.init_params(key)
+        return params, self.tx.init(params)
+
+
+class DQN:
+    """Algorithm driver (parity: ``DQN.train()``)."""
+
+    def __init__(self, config: DQNConfig):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_shape = probe.observation_space.shape
+        obs_dim = int(np.prod(obs_shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module = DiscreteMLPModule(MLPModuleConfig(
+            obs_dim=obs_dim, num_actions=num_actions,
+            hidden=tuple(config.hidden)))
+        self.learner = DQNLearner(self.module, config)
+        self.params, self.opt_state = self.learner.init_state(
+            jax.random.PRNGKey(config.seed))
+        self.target_params = self.params
+        blob = cloudpickle.dumps(self.module)
+        self.env_runners = [
+            SingleAgentEnvRunner.remote(
+                config.env, blob, config.rollout_length,
+                seed=config.seed + i, env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self.buffer = ReplayBuffer(config.buffer_size, obs_shape,
+                                   seed=config.seed)
+        self.iteration = 0
+        self.timesteps_total = 0
+        self.updates_total = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.timesteps_total /
+                   max(cfg.epsilon_decay_steps, 1))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end -
+                                           cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        t0 = time.time()
+        cfg = self.config
+        eps = self._epsilon()
+        params_np = ray_tpu.put(jax.tree.map(np.asarray, self.params))
+        batches = ray_tpu.get(
+            [r.sample_off_policy.remote(params_np, eps)
+             for r in self.env_runners], timeout=600)
+        for b in batches:
+            self.buffer.add_batch(b)
+            self.timesteps_total += len(b["obs"])
+
+        metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= cfg.learn_start:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.params, self.opt_state, metrics = \
+                    self.learner._update(self.params, self.target_params,
+                                         self.opt_state, mb)
+                self.updates_total += 1
+                if self.updates_total % cfg.target_update_freq == 0:
+                    self.target_params = self.params
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners],
+            timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if not np.isnan(m["episode_return_mean"])]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "updates_total": self.updates_total,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        for runner in self.env_runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass

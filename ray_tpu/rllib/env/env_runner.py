@@ -80,6 +80,50 @@ class SingleAgentEnvRunner:
                 "terminateds": done_buf,
                 "bootstrap_value": np.float32(last_v[0])}
 
+    def sample_off_policy(self, params,
+                          epsilon: float = 0.1) -> Dict[str, np.ndarray]:
+        """Epsilon-greedy rollout returning (s, a, r, s', done)
+        transitions — the replay-buffer food for value-based learners
+        (DQN; reference single_agent_env_runner in off-policy mode)."""
+        import jax
+        if not hasattr(self, "_jit_greedy") or self._jit_greedy is None:
+            import jax.numpy as jnp
+
+            def greedy(params, obs):
+                q, _ = self.module.forward(params, obs)
+                return jnp.argmax(q, axis=-1)
+
+            self._jit_greedy = jax.jit(greedy)
+        T = self.rollout_length
+        obs_buf = np.zeros((T,) + np.shape(self.obs), np.float32)
+        next_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros((T,), np.int64)
+        rew_buf = np.zeros((T,), np.float32)
+        done_buf = np.zeros((T,), np.float32)
+        n_actions = self.env.action_space.n
+        for t in range(T):
+            if self.rng.random() < epsilon:
+                a = int(self.rng.integers(n_actions))
+            else:
+                a = int(self._jit_greedy(params, self.obs[None, :])[0])
+            obs_buf[t] = self.obs
+            act_buf[t] = a
+            nxt, rew, terminated, truncated, _ = self.env.step(a)
+            rew_buf[t] = rew
+            done_buf[t] = float(terminated)
+            next_buf[t] = nxt
+            self._episode_return += rew
+            self._episode_len += 1
+            if terminated or truncated:
+                self.completed_returns.append(self._episode_return)
+                self.completed_lengths.append(self._episode_len)
+                self._episode_return = 0.0
+                self._episode_len = 0
+                nxt, _ = self.env.reset()
+            self.obs = nxt
+        return {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "next_obs": next_buf, "terminateds": done_buf}
+
     def get_metrics(self) -> Dict[str, Any]:
         out = {
             "episode_return_mean": (float(np.mean(

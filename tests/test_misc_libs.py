@@ -198,3 +198,50 @@ def test_dag_multi_output_node(ray_start_regular):
     refs = dag.execute(1)
     assert ray.get(refs, timeout=60) == [202, 303]
     assert ray.get(t.count.remote(), timeout=30) == 1  # shared ran once
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    """ray_tpu.util.multiprocessing.Pool — stdlib surface on tasks
+    (parity: ray/util/multiprocessing/pool.py)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def sq(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(4) as p:
+        assert p.map(sq, range(12)) == [i * i for i in range(12)]
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(p.imap(sq, range(5))) == [0, 1, 4, 9, 16]
+        assert sorted(p.imap_unordered(sq, range(5))) == [0, 1, 4, 9, 16]
+        ar = p.map_async(sq, range(4))
+        assert ar.get(timeout=60) == [0, 1, 4, 9]
+        assert p.apply(sq, (6,)) == 36
+    with pytest.raises(ValueError):
+        p.map(sq, [1])  # closed
+
+
+def test_joblib_backend(ray_start_regular):
+    """register_ray() joblib backend runs Parallel over cluster tasks
+    and propagates worker exceptions (parity: ray/util/joblib)."""
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+
+    def sq(x):
+        return x * x
+
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+    def boom(x):
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError):
+        with joblib.parallel_backend("ray_tpu"):
+            joblib.Parallel(n_jobs=2)(
+                joblib.delayed(boom)(i) for i in range(2))
