@@ -228,6 +228,12 @@ class NodeManager:
                     spec.actor_id.hex() if spec.actor_id else "",
                     "actor not found or dead"))
                 return
+            # dedup: a restart-requeued task and the driver watcher's
+            # resend of the same call must not both execute
+            if any(t.task_id == spec.task_id for t in astate.queued) or (
+                    astate.worker is not None and spec.task_id in
+                    astate.worker.inflight_actor_tasks):
+                return
             astate.queued.append(spec)
             self._flush_actor_queue_locked(astate)
         self._wake.set()
@@ -842,7 +848,9 @@ class NodeManager:
                 dead_worker.inflight_actor_tasks.clear()
         can_restart = (spec.max_restarts == -1
                        or astate.restarts_used < spec.max_restarts)
-        for t in inflight:
+        # reversed + appendleft keeps the original submission order at
+        # the front of the queue (forward appendleft would reverse it)
+        for t in reversed(inflight):
             if t.max_task_retries != 0 and can_restart:
                 with self._lock:
                     astate.queued.appendleft(t)

@@ -417,6 +417,8 @@ class CoreWorker:
             "class_name": getattr(cls, "__name__", "Actor"),
             "state": "PENDING",
             "max_restarts": opts.get("max_restarts", 0),
+            "max_task_retries": opts.get("max_task_retries", 0),
+            "method_num_returns": opts.get("method_num_returns") or {},
             "lifetime": opts.get("lifetime"),
             "resources": opts["resources"],
             # kept so the head can reschedule the actor on another node

@@ -32,7 +32,10 @@ class ActorMethod:
         worker = global_worker()
         return worker.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            {"num_returns": self._num_returns})
+            {"num_returns": self._num_returns,
+             # class-level retry policy applies to every method call
+             # (ray parity: Actor.options(max_task_retries=...))
+             "max_task_retries": self._handle._max_task_retries})
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -42,11 +45,13 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: bytes, class_name: str = "Actor",
-                 method_num_returns: Optional[Dict[str, int]] = None):
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 max_task_retries: int = 0):
         object.__setattr__(self, "_actor_id", actor_id)
         object.__setattr__(self, "_class_name", class_name)
         object.__setattr__(self, "_method_num_returns",
                            method_num_returns or {})
+        object.__setattr__(self, "_max_task_retries", max_task_retries)
 
     def __getattr__(self, name: str) -> ActorMethod:
         # __ray_call__ runs an arbitrary fn against the actor instance;
@@ -61,7 +66,8 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,
-                              self._method_num_returns))
+                              self._method_num_returns,
+                              self._max_task_retries))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -117,12 +123,14 @@ class ActorClass:
             "max_concurrency": opts.get("max_concurrency", 1),
             "runtime_env": opts.get("runtime_env"),
         }
-        actor_id = worker.create_actor(self._cls, args, kwargs, create_opts)
         num_returns = {
             n: getattr(m, "_num_returns")
             for n, m in vars(self._cls).items()
             if hasattr(m, "_num_returns")}
-        return ActorHandle(actor_id, self._cls.__name__, num_returns)
+        create_opts["method_num_returns"] = num_returns
+        actor_id = worker.create_actor(self._cls, args, kwargs, create_opts)
+        return ActorHandle(actor_id, self._cls.__name__, num_returns,
+                           opts.get("max_task_retries", 0))
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ClassNode
@@ -144,4 +152,6 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
         raise ValueError(
             f"Failed to look up actor '{name}' in namespace '{namespace}'")
     info = worker.cp.get_actor_info(actor_id) or {}
-    return ActorHandle(actor_id, info.get("class_name", "Actor"))
+    return ActorHandle(actor_id, info.get("class_name", "Actor"),
+                       info.get("method_num_returns") or {},
+                       max_task_retries=info.get("max_task_retries", 0))
