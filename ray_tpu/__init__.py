@@ -12,6 +12,7 @@ get, put, wait, ...), ``python/ray/actor.py``, ``python/ray/exceptions.py``.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -28,14 +29,17 @@ __version__ = "0.1.0"
 _init_lock = threading.RLock()
 
 
-def init(num_cpus: Optional[float] = None,
+def init(address: Optional[str] = None,
+         num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          namespace: str = "default",
          ignore_reinit_error: bool = False,
          _system_config: Optional[Dict[str, Any]] = None,
          **kwargs) -> "RuntimeContext":
-    """Start (or connect to) a ray_tpu runtime in this process."""
+    """Start a ray_tpu runtime — or, with ``address``, connect to a
+    running one as an additional driver ("auto", a session directory,
+    or a control-plane address; parity: ``ray.init(address=...)``)."""
     with _init_lock:
         if is_initialized():
             if ignore_reinit_error:
@@ -43,11 +47,28 @@ def init(num_cpus: Optional[float] = None,
             raise RuntimeError(
                 "ray_tpu.init() called twice; pass "
                 "ignore_reinit_error=True to ignore")
-        from ray_tpu._private.node import HeadNode
-        node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
-                        resources=resources, namespace=namespace,
-                        system_config=_system_config,
-                        session_name=kwargs.pop("session_name", None))
+        if address is None:
+            # job entrypoints etc. inherit the cluster via env
+            # (parity: RAY_ADDRESS)
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address is not None:
+            if any(v is not None for v in (num_cpus, num_tpus,
+                                           resources, _system_config)):
+                import warnings
+                warnings.warn(
+                    "init(address=...) attaches to an existing cluster; "
+                    "num_cpus/num_tpus/resources/_system_config are "
+                    "ignored (reference parity: ray.init warns too)",
+                    stacklevel=2)
+            from ray_tpu._private.node import AttachedNode
+            node = AttachedNode(address, namespace=namespace)
+        else:
+            from ray_tpu._private.node import HeadNode
+            node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
+                            resources=resources, namespace=namespace,
+                            system_config=_system_config,
+                            session_name=kwargs.pop("session_name",
+                                                    None))
         _worker_mod.set_global_worker(node.worker, node)
         return get_runtime_context()
 

@@ -18,7 +18,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import protocol
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -85,6 +85,139 @@ def default_resources(num_cpus: Optional[float],
     out.update({k: float(v) for k, v in (resources or {}).items()})
     out.setdefault("node:__internal_head__", 1.0)
     return out
+
+
+def _session_candidates(tmp_root: Optional[str] = None):
+    """(cp_address, session_dir) candidates, newest session first."""
+    import glob
+    root = tmp_root or _default_tmp_root()
+
+    def mtime(path):
+        try:
+            return os.path.getmtime(path)
+        except OSError:  # deleted between glob and stat
+            return 0.0
+
+    out = []
+    for session in sorted(glob.glob(os.path.join(root, "session_*")),
+                          key=mtime, reverse=True):
+        addr_file = os.path.join(session, "cp_address")
+        try:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    out.append((f.read().strip(), session))
+                continue
+        except OSError:
+            continue
+        sock = os.path.join(session, "sockets", "cp.sock")
+        if os.path.exists(sock):
+            out.append((sock, session))
+    return out
+
+
+def find_session_cp_address(tmp_root: Optional[str] = None
+                            ) -> Optional[Tuple[str, str]]:
+    """Newest session's (cp_address, session_dir) on this host (may be
+    stale — AttachedNode probes candidates with ping)."""
+    candidates = _session_candidates(tmp_root)
+    return candidates[0] if candidates else None
+
+
+class AttachedNode:
+    """A second driver connected to an EXISTING cluster.
+
+    The client-mode the reference reaches with ``ray.init(address=...)``
+    (``python/ray/_private/worker.py`` connect-to-existing): this
+    process gets its own CoreWorker/job but rides the running session's
+    control plane, head node manager, and shm store.  Same-host only
+    (the shm store is attached by path); cross-host clients would go
+    through a node manager on their own host.
+
+    ``shutdown()`` detaches — it never tears the session down.
+    """
+
+    def __init__(self, address: str = "auto",
+                 namespace: str = "default"):
+        if address == "auto":
+            # probe newest-first: a cleanly-shut-down session leaves its
+            # dir (and cp_address file) behind, so ping until live
+            cp_addr = session_dir = None
+            for cand_addr, cand_dir in _session_candidates():
+                try:
+                    protocol.RpcClient(cand_addr,
+                                       connect_timeout=2.0).ping()
+                    cp_addr, session_dir = cand_addr, cand_dir
+                    break
+                except Exception:  # noqa: BLE001 — dead session
+                    continue
+            if cp_addr is None:
+                raise ConnectionError(
+                    "address='auto': no live ray_tpu session on this "
+                    "host")
+        elif os.path.isdir(address):  # a session directory
+            with open(os.path.join(address, "cp_address")) as f:
+                cp_addr = f.read().strip()
+            session_dir = address
+        else:  # explicit cp address (tcp:// or socket path)
+            cp_addr = address
+            session_dir = None
+        self.cp_sock_path = cp_addr
+        cp = protocol.RpcClient(cp_addr)
+        cp.ping()  # fail fast on a dead session
+        # the head node hosts the shared store + default scheduler
+        head = None
+        for info in cp.list_nodes():
+            if info.get("state") != "ALIVE":
+                continue
+            if "node:__internal_head__" in (
+                    info.get("resources_total") or {}):
+                head = info
+                break
+        if head is None:
+            raise ConnectionError("no ALIVE head node in session")
+        self.session_dir = session_dir or head["session_dir"]
+        self.session_name = os.path.basename(self.session_dir)
+        self.node_id = head["node_id"]
+        nm = protocol.RpcClient(head["sock_path"])
+        # workers attach the same root the same way — per-object
+        # files + multi-process-safe arena.  spill_dir mirrors the
+        # head's default so spilled objects stay readable here.
+        store = ShmStore(_shm_root(self.session_name),
+                         spill_dir=GLOBAL_CONFIG.object_spill_dir
+                         or os.path.join(self.session_dir, "spill"))
+        self.store = store
+        self.control_plane = cp
+        self.job_id = JobID.from_random()
+        self.worker = CoreWorker(
+            mode="driver", job_id=self.job_id,
+            worker_id=WorkerID.from_random(), node_id=self.node_id,
+            control_plane=cp, node_manager=nm, shm_store=store,
+            session_dir=self.session_dir, namespace=namespace)
+        from ray_tpu._private.ref_tracker import install_tracker
+        install_tracker(self.worker.worker_id.binary(), cp)
+        self.log_monitor = None
+        if GLOBAL_CONFIG.log_to_driver:
+            from ray_tpu._private.log_streaming import DriverLogMonitor
+            self.log_monitor = DriverLogMonitor(cp)
+            self.log_monitor.start()
+        self._stopped = False
+
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        from ray_tpu._private.ref_tracker import uninstall_tracker
+        uninstall_tracker()
+        try:
+            # release every ref this driver still holds — nothing else
+            # purges an attached driver's holder id (a crashed attach
+            # leaks its pins until session end; bounded, but clean
+            # detach should not)
+            self.control_plane.purge_holder(self.worker.worker_id.binary())
+        except Exception:  # noqa: BLE001 — session may be gone
+            pass
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
 
 
 class HeadNode:

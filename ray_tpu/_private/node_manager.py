@@ -228,12 +228,20 @@ class NodeManager:
                     spec.actor_id.hex() if spec.actor_id else "",
                     "actor not found or dead"))
                 return
-            # dedup: a restart-requeued task and the driver watcher's
-            # resend of the same call must not both execute
+            # dedup (best-effort, matching the reference's at-least-once
+            # retry semantics): drop a resend whose twin is queued,
+            # in flight, or already committed a result
             if any(t.task_id == spec.task_id for t in astate.queued) or (
                     astate.worker is not None and spec.task_id in
                     astate.worker.inflight_actor_tasks):
                 return
+            ret_ids = spec.return_object_ids()
+            if ret_ids:
+                try:
+                    if self.cp.get_location(ret_ids[0]) is not None:
+                        return  # the retried copy already finished
+                except Exception:  # noqa: BLE001
+                    pass
             astate.queued.append(spec)
             self._flush_actor_queue_locked(astate)
         self._wake.set()

@@ -8,10 +8,10 @@ captured to a per-job log, and lifecycle state
 (PENDING → RUNNING → SUCCEEDED/FAILED/STOPPED) lives in the
 control-plane KV so any client can query it.
 
-Deviation from the reference, on purpose: entrypoints run as plain
-subprocesses on the node that hosts the supervisor; a script that calls
-``ray_tpu.init()`` starts its own runtime rather than attaching as a
-driver (client-mode attach is not implemented).
+Entrypoints inherit ``RAY_TPU_ADDRESS``, so a script calling
+``ray_tpu.init()`` attaches to the submitting cluster as a driver
+(``AttachedNode``) — tasks/actors it creates run on the cluster, like
+the reference's RAY_ADDRESS injection.
 """
 
 from __future__ import annotations
@@ -92,6 +92,12 @@ class _JobSupervisor:
             env[k] = str(v)
         if renv.get("working_dir"):
             cwd = renv["working_dir"]
+        # entrypoints that call ray_tpu.init() attach to THIS cluster
+        # instead of starting their own (parity: RAY_ADDRESS injection;
+        # supervisors are workers, so the CP address is in their env)
+        cp_addr = os.environ.get("RAY_TPU_CP_SOCK", "")
+        if cp_addr:
+            env.setdefault("RAY_TPU_ADDRESS", cp_addr)
         info = JobInfo(submission_id=submission_id, entrypoint=entrypoint,
                        status="RUNNING", start_time=time.time(),
                        metadata=metadata, runtime_env=runtime_env)

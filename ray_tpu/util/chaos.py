@@ -104,6 +104,12 @@ class ResourceKiller:
                 pass
 
     def start(self) -> "ResourceKiller":
+        if self.kind in ("worker", "node"):
+            from ray_tpu._private.worker import global_node
+            if getattr(global_node(), "node_manager", None) is None:
+                raise ValueError(
+                    f"chaos kind={self.kind!r} needs the head driver "
+                    "(an attached driver has no local node manager)")
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"chaos-{self.kind}")
         self._thread.start()

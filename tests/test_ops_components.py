@@ -4,9 +4,12 @@ Parity models: runtime_env_agent.py, log_monitor.py,
 dashboard/modules/job/job_manager.py, autoscaler/_private/autoscaler.py.
 """
 
+import os
 import time
 
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_runtime_env_env_vars_and_working_dir(ray_start_regular, tmp_path):
@@ -149,3 +152,66 @@ def test_autoscaler_up_and_down(ray_start_cluster):
         assert any(e.startswith("down:") for e in sc.events)
     finally:
         sc.stop()
+
+
+_ATTACH_SCRIPT = """
+import ray_tpu
+ray_tpu.init(address="auto")
+@ray_tpu.remote
+def double(v):
+    return v * 2
+kv = ray_tpu.get_actor("attachkv")
+x = ray_tpu.get(kv.get.remote("x"), timeout=60)
+ray_tpu.get(kv.put.remote("y", ray_tpu.get(double.remote(x),
+                                           timeout=60)), timeout=60)
+ref = ray_tpu.put(b"z" * 150000)          # shm from the attached driver
+assert len(ray_tpu.get(ref, timeout=30)) == 150000
+print("ATTACH_OK")
+ray_tpu.shutdown()
+"""
+
+
+def test_attach_second_driver(ray_start_regular):
+    """init(address='auto') joins the running cluster as another driver:
+    shared named actors, tasks on cluster resources, shm objects
+    (parity: ray.init(address=...) connect-to-existing)."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    kv = KV.options(name="attachkv").remote()
+    ray_tpu.get(kv.put.remote("x", 21), timeout=60)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run([sys.executable, "-c", _ATTACH_SCRIPT], env=env,
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert p.returncode == 0, p.stderr
+    assert "ATTACH_OK" in p.stdout
+    assert ray_tpu.get(kv.get.remote("y"), timeout=60) == 42
+
+
+def test_job_entrypoint_uses_cluster(ray_start_regular):
+    """A submitted job's python entrypoint attaches to the submitting
+    cluster via RAY_TPU_ADDRESS and runs tasks on it."""
+    from ray_tpu.job import JobSubmissionClient
+    c = JobSubmissionClient()
+    code = ("import ray_tpu; ray_tpu.init(); "
+            "f = ray_tpu.remote(lambda x: x + 1); "
+            "print('cluster result:', ray_tpu.get(f.remote(41)))")
+    jid = c.submit_job(entrypoint=f"python -c \"{code}\"")
+    assert c.wait_until_finished(jid, timeout=120) == "SUCCEEDED"
+    assert "cluster result: 42" in c.get_job_logs(jid)
