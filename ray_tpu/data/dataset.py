@@ -361,6 +361,50 @@ class Dataset:
         if carry is not None and carry.num_rows > 0 and not drop_last:
             yield format_batch(carry, batch_format)
 
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, drop_last: bool = True,
+                         prefetch: int = 2,
+                         batch_format: str = "numpy") -> Iterator[Any]:
+        """``iter_batches`` that lands each batch on device ahead of the
+        consumer — host decode, H2D transfer, and accelerator compute
+        overlap (the TPU input-pipeline pattern; reference parity:
+        ``iter_torch_batches(device=...)``).
+
+        ``sharding``: a ``jax.sharding.Sharding`` (e.g.
+        ``NamedSharding(mesh, P('dp'))``) applied to every array;
+        defaults to the default device.  With a sharded batch axis,
+        every batch must divide the axis size — hence ``drop_last``
+        defaults to True here (unlike ``iter_batches``): a trailing
+        partial batch would fail to shard.
+        """
+        import jax
+
+        if batch_format != "numpy":
+            raise ValueError(
+                "iter_jax_batches requires batch_format='numpy' "
+                "(pandas/pyarrow batches are not jax pytrees)")
+
+        def put(batch):
+            if sharding is None:
+                return jax.tree.map(jax.numpy.asarray, batch)
+            return jax.tree.map(
+                lambda a: jax.device_put(a, sharding), batch)
+
+        it = self.iter_batches(batch_size=batch_size,
+                               batch_format=batch_format,
+                               drop_last=drop_last,
+                               prefetch_blocks=prefetch)
+        # keep `prefetch` device batches in flight: device_put is async,
+        # so the queue overlaps H2D with the consumer's compute
+        from collections import deque
+        window: deque = deque()
+        for batch in it:
+            window.append(put(batch))
+            if len(window) > prefetch:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for ref in self._execute():
             block = ray_tpu.get(ref, timeout=600)

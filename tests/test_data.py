@@ -226,3 +226,28 @@ def test_iter_batches_prefetch_thread(ray_start_regular):
     # prefetch disabled path agrees
     batches0 = list(ds.iter_batches(batch_size=8, prefetch_blocks=0))
     assert sum(len(b["id"]) for b in batches0) == 40
+
+
+def test_iter_jax_batches_device_and_sharding(ray_start_regular):
+    """Batches land on device (optionally sharded) ahead of the
+    consumer — the TPU input-pipeline feed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import ray_tpu.data as data
+    from ray_tpu.parallel.mesh import make_mesh
+
+    ds = data.range(64, override_num_blocks=4)
+    seen = 0
+    for b in ds.iter_jax_batches(batch_size=8):
+        assert isinstance(b["id"], jnp.ndarray)
+        seen += int(b["id"].shape[0])
+    assert seen == 64
+
+    mesh = make_mesh(dp=4)
+    sh = NamedSharding(mesh, P("dp"))
+    for b in ds.iter_jax_batches(batch_size=8, sharding=sh):
+        assert b["id"].sharding == sh
+        total = int(jax.jit(lambda x: x.sum())(b["id"]))
+        assert total >= 0
