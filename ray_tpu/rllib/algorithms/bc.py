@@ -1,0 +1,151 @@
+"""BC — behavior cloning from offline data.
+
+Parity: reference ``rllib/algorithms/bc/`` (offline RL new stack): no
+env interaction — the policy is supervised on logged (obs, action)
+pairs read through ``ray_tpu.data`` (the reference reads offline
+datasets through ray.data the same way).  Evaluation optionally rolls
+the cloned policy in a live env.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule, MLPModuleConfig
+
+
+@dataclass
+class BCConfig:
+    env: str = "CartPole-v1"              # for eval rollouts + spaces
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    updates_per_iteration: int = 32
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    evaluation_num_episodes: int = 5
+
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def offline_data(self, dataset) -> "BCConfig":
+        """``dataset``: ray_tpu.data.Dataset with 'obs' and 'actions'
+        columns (reference: config.offline_data(input_=...))."""
+        self._dataset = dataset
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "BC":
+        return BC(self, getattr(self, "_dataset", None))
+
+
+class BC:
+    """Offline supervised policy cloning + optional live evaluation."""
+
+    def __init__(self, config: BCConfig, dataset):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+        if dataset is None:
+            raise ValueError("BCConfig.offline_data(dataset) is required")
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module = DiscreteMLPModule(MLPModuleConfig(
+            obs_dim=obs_dim, num_actions=num_actions,
+            hidden=tuple(config.hidden)))
+        self.tx = optax.adam(config.lr)
+        self.params = self.module.init_params(
+            jax.random.PRNGKey(config.seed))
+        self.opt_state = self.tx.init(self.params)
+        module = self.module
+
+        def loss_fn(params, obs, actions):
+            logits, _ = module.forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None], -1)[:, 0]
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == actions).astype(jnp.float32))
+            return jnp.mean(nll), acc
+
+        @jax.jit
+        def update(params, opt_state, obs, actions):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, obs, actions)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    loss, acc)
+
+        @jax.jit
+        def act(params, obs):
+            logits, _ = module.forward(params, obs)
+            return jnp.argmax(logits, -1)
+
+        self._update = update
+        self._act = act
+        # materialize the offline dataset once; epochs shuffle in-memory
+        table = dataset.to_arrow()
+        self._obs = np.stack([np.asarray(o, np.float32)
+                              for o in table.column("obs").to_pylist()])
+        self._actions = np.asarray(table.column("actions").to_pylist(),
+                                   np.int64)
+        self._rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        cfg = self.config
+        n = len(self._obs)
+        loss = acc = 0.0
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._rng.integers(0, n, size=min(
+                cfg.train_batch_size, n))
+            self.params, self.opt_state, loss, acc = self._update(
+                self.params, self.opt_state, self._obs[idx],
+                self._actions[idx])
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "loss": float(loss), "action_accuracy": float(acc),
+                "num_samples": n,
+                "time_this_iter_s": time.time() - t0}
+
+    def evaluate(self, num_episodes: Optional[int] = None
+                 ) -> Dict[str, Any]:
+        """Greedy rollouts of the cloned policy in the live env."""
+        import gymnasium as gym
+        episodes = num_episodes or self.config.evaluation_num_episodes
+        act = self._act  # jitted once in __init__ (no per-call recompile)
+        env = gym.make(self.config.env, **self.config.env_config)
+        returns = []
+        for ep in range(episodes):
+            obs, _ = env.reset(seed=self.config.seed + ep)
+            done, total = False, 0.0
+            while not done:
+                a = int(act(self.params, obs[None, :])[0])
+                obs, rew, term, trunc, _ = env.step(a)
+                total += rew
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": episodes}
+
+    def stop(self):
+        pass

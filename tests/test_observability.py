@@ -3,6 +3,7 @@
 import json
 import subprocess
 import sys
+import os
 import time
 
 import pytest
@@ -118,3 +119,25 @@ def test_native_store_stats_exposed(ray_start_regular):
     stats = global_node().store.stats()
     if "arena" in stats:  # native lib built
         assert stats["arena"]["num_puts"] >= 1
+
+
+def test_device_profiling_helpers(ray_start_regular, tmp_path):
+    """profile_device captures an xplane trace; annotate + memory stats
+    work on the active backend."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.util.profiling import (annotate, device_memory_stats,
+                                        profile_device)
+
+    with profile_device(str(tmp_path / "prof")) as logdir:
+        with annotate("test-matmul"):
+            x = jnp.ones((128, 128))
+            (x @ x).block_until_ready()
+    traces = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                       recursive=True)
+    assert traces, f"no xplane trace under {logdir}"
+    stats = device_memory_stats()
+    assert len(stats) >= 1
