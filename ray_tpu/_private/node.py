@@ -316,21 +316,20 @@ class HeadNode:
         res.update(resources or {})
         proc_env = dict(os.environ)
         proc_env.update(env or {})
-        proc_env.update({
-            "RAY_TPU_SESSION_DIR": self.session_dir,
-            "RAY_TPU_CP_SOCK": self.cp_sock_path,
-            "RAY_TPU_USE_TCP": "1" if GLOBAL_CONFIG.use_tcp else "0",
-            "RAY_TPU_NODE_ID": node_id.hex(),
-            # Every node owns a DISTINCT shm root: objects move between
-            # nodes only via the chunked pull protocol (node_manager
-            # fetch_object_chunk), never via a shared filesystem.  This is
-            # what makes the single-host simulation faithful to multi-host
-            # (reference: per-node plasma + object_manager Push/Pull).
-            "RAY_TPU_SHM_ROOT": f"{self.shm_root}_node_{node_id.hex()[:12]}",
-            "RAY_TPU_SPILL_DIR": os.path.join(
-                self.spill_dir, f"node_{node_id.hex()[:12]}"),
-            "RAY_TPU_NODE_RESOURCES": json.dumps(res),
-        })
+        from ray_tpu._private.node_proc import build_env
+        # Every node owns a DISTINCT shm root: objects move between
+        # nodes only via the chunked pull protocol (node_manager
+        # fetch_object_chunk), never via a shared filesystem.  This is
+        # what makes the single-host simulation faithful to multi-host
+        # (reference: per-node plasma + object_manager Push/Pull).
+        proc_env.update(build_env(
+            session_dir=self.session_dir, cp_addr=self.cp_sock_path,
+            node_id=node_id,
+            shm_root=f"{self.shm_root}_node_{node_id.hex()[:12]}",
+            spill_dir=os.path.join(self.spill_dir,
+                                   f"node_{node_id.hex()[:12]}"),
+            resources=res, use_tcp=GLOBAL_CONFIG.use_tcp,
+            node_ip=GLOBAL_CONFIG.node_ip))
         log = open(os.path.join(self.session_dir, "logs",
                                 f"node-{node_id.hex()[:12]}.log"), "ab")
         proc = subprocess.Popen(

@@ -14,6 +14,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 from typing import Optional
 
 
@@ -161,6 +162,153 @@ def cmd_dashboard(args):
         time.sleep(3600)
 
 
+_HEAD_DAEMON = """
+import signal
+# block BEFORE sigwait: with the default disposition unblocked SIGTERM
+# would kill the process and skip the graceful shutdown
+signal.pthread_sigmask(signal.SIG_BLOCK, {{signal.SIGTERM,
+                                           signal.SIGINT}})
+import ray_tpu
+ray_tpu.init(_system_config={system_config!r}, **{kwargs!r})
+from ray_tpu._private.worker import global_node
+print("ray_tpu head up:", global_node().cp_sock_path, flush=True)
+signal.sigwait({{signal.SIGTERM, signal.SIGINT}})
+ray_tpu.shutdown()
+"""
+
+
+def _pidfile() -> str:
+    import getpass
+    return os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu_{getpass.getuser()}", "daemons.pids")
+
+
+def _record_pid(pid: int) -> None:
+    path = _pidfile()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(f"{pid}\n")
+
+
+def cmd_start(args):
+    """``ray-tpu start --head`` / ``--address`` — standalone daemons
+    (parity: ``ray start``).  The head runs as its own process; drivers
+    attach with ``init(address='auto')``; worker nodes on any host join
+    a TCP head with --address."""
+    import subprocess
+    import uuid
+    if args.head:
+        system_config = {}
+        if args.tcp:
+            system_config["use_tcp"] = True
+            if args.node_ip:
+                system_config["node_ip"] = args.node_ip
+        if args.persist:
+            system_config["cp_persistence"] = True
+        kwargs = {}
+        if args.num_cpus is not None:
+            kwargs["num_cpus"] = args.num_cpus
+        if args.num_tpus is not None:
+            kwargs["num_tpus"] = args.num_tpus
+        code = _HEAD_DAEMON.format(kwargs=kwargs,
+                                   system_config=system_config)
+        log_dir = os.path.dirname(_pidfile())
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, "head.log")
+        log = open(log_path, "ab")
+        # log file, not a pipe: the daemon outlives this CLI, and later
+        # stdout writes to an abandoned pipe would BrokenPipeError it
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=log, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        log.close()
+        deadline = time.time() + 60
+        addr = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                with open(log_path) as f:
+                    tail = f.read()[-2000:]
+                print(f"head daemon exited rc={proc.returncode}:\n"
+                      f"{tail}", file=sys.stderr)
+                sys.exit(1)
+            from ray_tpu._private.node import find_session_cp_address
+            found = find_session_cp_address()
+            if found:
+                try:
+                    from ray_tpu._private.protocol import RpcClient
+                    RpcClient(found[0], connect_timeout=2.0).ping()
+                    addr = found[0]
+                    break
+                except Exception:  # noqa: BLE001 — not up yet
+                    pass
+            time.sleep(0.3)
+        if addr is None:
+            print("head did not come up within 60s; see "
+                  f"{log_path}", file=sys.stderr)
+            sys.exit(1)
+        _record_pid(proc.pid)
+        print(f"ray_tpu head up: {addr} (pid {proc.pid}, "
+              f"log {log_path})")
+        print("attach drivers with: ray_tpu.init(address='auto')")
+        return
+    if not args.address:
+        print("start needs --head or --address <cp_addr>",
+              file=sys.stderr)
+        sys.exit(2)
+    # worker node daemon joining an existing (TCP) head
+    from ray_tpu._private.protocol import RpcClient
+    cp = RpcClient(args.address)
+    cp.ping()
+    node_id = uuid.uuid4().bytes[:16]
+    local_dir = os.path.join(tempfile.gettempdir(),
+                             f"ray_tpu_node_{node_id.hex()[:12]}")
+    os.makedirs(os.path.join(local_dir, "sockets"), exist_ok=True)
+    os.makedirs(os.path.join(local_dir, "logs"), exist_ok=True)
+    shm_base = "/dev/shm" if os.path.isdir("/dev/shm") \
+        else tempfile.gettempdir()
+    res = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
+    if args.num_tpus:
+        res["TPU"] = float(args.num_tpus)
+    from ray_tpu._private.node_proc import build_env
+    env = dict(os.environ)
+    env.update(build_env(
+        session_dir=local_dir, cp_addr=args.address, node_id=node_id,
+        shm_root=os.path.join(shm_base,
+                              f"ray_tpu_node_{node_id.hex()[:12]}"),
+        spill_dir=os.path.join(local_dir, "spill"), resources=res,
+        use_tcp=args.address.startswith("tcp://"),
+        node_ip=args.node_ip or "127.0.0.1"))
+    log = open(os.path.join(local_dir, "logs", "node.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_proc"],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    log.close()
+    _record_pid(proc.pid)
+    print(f"node {node_id.hex()[:12]} joining {args.address} "
+          f"(pid {proc.pid}, logs {local_dir}/logs/node.log)")
+
+
+def cmd_stop(args):
+    """Kill daemons started by ``ray-tpu start`` on this host."""
+    import signal
+    path = _pidfile()
+    if not os.path.exists(path):
+        print("no ray_tpu daemons recorded")
+        return
+    with open(path) as f:
+        pids = [int(ln) for ln in f.read().split() if ln.strip()]
+    stopped = 0
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    os.unlink(path)
+    print(f"stopped {stopped} daemon(s)")
+
+
 def cmd_jobs(args):
     """``ray-tpu jobs ...`` against the live session's job table
     (parity: ``ray job submit/status/logs/list/stop``)."""
@@ -210,6 +358,19 @@ def main(argv=None):
     p_mb.add_argument("--duration", type=float, default=2.0)
     p_db = sub.add_parser("dashboard")
     p_db.add_argument("--port", type=int, default=8265)
+    p_start = sub.add_parser("start")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", default=None)
+    p_start.add_argument("--num-cpus", type=float, default=None,
+                         dest="num_cpus")
+    p_start.add_argument("--num-tpus", type=float, default=None,
+                         dest="num_tpus")
+    p_start.add_argument("--tcp", action="store_true",
+                         help="bind the head on TCP (multi-host)")
+    p_start.add_argument("--node-ip", default=None, dest="node_ip")
+    p_start.add_argument("--persist", action="store_true",
+                         help="journal the control plane (restartable)")
+    sub.add_parser("stop")
     p_jobs = sub.add_parser("jobs")
     jobs_sub = p_jobs.add_subparsers(dest="jobs_command", required=True)
     p_submit = jobs_sub.add_parser("submit")
@@ -222,7 +383,8 @@ def main(argv=None):
     {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
      "timeline": cmd_timeline, "memory": cmd_memory,
      "microbenchmark": cmd_microbenchmark,
-     "dashboard": cmd_dashboard, "jobs": cmd_jobs}[args.command](args)
+     "dashboard": cmd_dashboard, "jobs": cmd_jobs,
+     "start": cmd_start, "stop": cmd_stop}[args.command](args)
 
 
 if __name__ == "__main__":

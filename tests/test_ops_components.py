@@ -215,3 +215,54 @@ def test_job_entrypoint_uses_cluster(ray_start_regular):
     jid = c.submit_job(entrypoint=f"python -c \"{code}\"")
     assert c.wait_until_finished(jid, timeout=120) == "SUCCEEDED"
     assert "cluster result: 42" in c.get_job_logs(jid)
+
+
+def test_cli_start_stop_standalone_cluster(tmp_path):
+    """ray-tpu start --head --tcp + start --address joins a worker over
+    TCP; an external driver attaches and runs tasks; stop reaps all
+    daemons (parity: ray start/stop)."""
+    import glob
+    import subprocess
+    import sys
+    import time as _t
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def cli(*argv, timeout=90):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", *argv], env=env,
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+
+    try:
+        out = cli("start", "--head", "--tcp", "--num-cpus", "2",
+                  timeout=120)
+        assert out.returncode == 0, out.stderr + out.stdout
+        # the CLI liveness-probes and prints the address itself
+        addr = next(tok for tok in out.stdout.split()
+                    if tok.startswith("tcp://"))
+
+        out = cli("start", "--address", addr, "--num-cpus", "2")
+        assert out.returncode == 0, out.stderr
+
+        driver = (
+            "import ray_tpu\n"
+            "ray_tpu.init(address='auto')\n"
+            "f = ray_tpu.remote(lambda x: x * 3)\n"
+            "print('R:', sorted(ray_tpu.get([f.remote(i) "
+            "for i in range(6)], timeout=90)))\n"
+            "print('CPUS:', ray_tpu.cluster_resources().get('CPU'))\n"
+            "ray_tpu.shutdown()\n")
+        deadline = _t.time() + 60
+        ok = False
+        while _t.time() < deadline and not ok:
+            p = subprocess.run([sys.executable, "-c", driver], env=env,
+                               capture_output=True, text=True,
+                               timeout=120, cwd=REPO)
+            ok = p.returncode == 0 and "CPUS: 4.0" in p.stdout
+            if not ok:
+                _t.sleep(1)
+        assert ok, p.stdout + p.stderr
+        assert "R: [0, 3, 6, 9, 12, 15]" in p.stdout
+    finally:
+        cli("stop")
