@@ -57,6 +57,9 @@ class ServeController:
         # actor's event loop exists, so a task created here would never
         # be scheduled
         self._autoscaler: Optional[asyncio.Task] = None
+        # strong refs: the loop holds tasks weakly, and a GC'd drain
+        # task would leak its unrouted victims forever
+        self._drain_tasks: set = set()
 
     def _ensure_autoscaler(self) -> None:
         if self._autoscaler is None or self._autoscaler.done():
@@ -131,8 +134,10 @@ class ServeController:
                     target_n = max(autoscale["min_replicas"],
                                    min(autoscale["max_replicas"],
                                        len(entry["replicas"])))
-                while len(entry["replicas"]) > target_n:
-                    ray_tpu.kill(entry["replicas"].pop())
+                if len(entry["replicas"]) > target_n:
+                    victims = entry["replicas"][target_n:]
+                    del entry["replicas"][target_n:]
+                    self._schedule_drain(victims)
                 while len(entry["replicas"]) < target_n:
                     entry["replicas"].append(
                         self._spawn_replica(app_name, d))
@@ -202,6 +207,9 @@ class ServeController:
         replicas = entry["replicas"]
         if not replicas:
             return
+        # snapshot: a same-code redeploy can mutate the list in place
+        # while we await probes (counts must pair with these replicas)
+        replicas = list(replicas)
 
         async def probe(r):
             try:
@@ -239,16 +247,60 @@ class ServeController:
         d["cls_blob"] = entry["blob"]
         if direction == "up":
             for _ in range(desired - current):
-                replicas.append(self._spawn_replica(app_name, d))
+                entry["replicas"].append(self._spawn_replica(app_name, d))
+            entry["version"] += 1
+            self._publish(app_name, name, entry["version"])
         else:
-            # kill the least-loaded replicas first (in-flight requests on
-            # busy ones would fail; a full drain is future work)
+            # drain-then-kill: remove the least-loaded replicas from the
+            # routing table first (version bump pushes the new table to
+            # handles), wait for their in-flight requests to finish,
+            # then kill (reference: replica graceful shutdown /
+            # drain_replicas)
             order = sorted(range(current), key=lambda i: counts[i])
-            victims = sorted(order[:current - desired], reverse=True)
-            for i in victims:
-                ray_tpu.kill(replicas.pop(i))
-        entry["version"] += 1
-        self._publish(app_name, name, entry["version"])
+            victims = [replicas[i] for i in order[:current - desired]]
+            for v in victims:
+                if v in entry["replicas"]:
+                    entry["replicas"].remove(v)
+            entry["version"] += 1
+            self._publish(app_name, name, entry["version"])
+            self._schedule_drain(victims)
+
+    def _schedule_drain(self, victims) -> None:
+        task = asyncio.ensure_future(self._drain_and_kill(victims))
+        self._drain_tasks.add(task)
+        task.add_done_callback(self._drain_tasks.discard)
+
+    async def _drain_and_kill(self, victims, timeout_s: float = 30.0,
+                              grace_s: float = 1.0):
+        # grace: handles learn about the routing change via the pubsub
+        # push; requests dispatched from a stale table in that window
+        # are invisible to num_ongoing until they start executing
+        await asyncio.sleep(grace_s)
+        deadline = time.monotonic() + timeout_s
+        pending = list(victims)
+        while pending and time.monotonic() < deadline:
+            still = []
+            for r in pending:
+                busy = False
+                try:
+                    busy = await r.num_ongoing.remote() > 0
+                except Exception:  # noqa: BLE001 — probe failed: kill
+                    pass           # anyway (kill tolerates dead actors)
+                if busy:
+                    still.append(r)
+                    continue
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            pending = still
+            if pending:
+                await asyncio.sleep(0.2)
+        for r in pending:  # drain timeout: cut them loose
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------- routing ----
     def get_routing(self, app_name: str,

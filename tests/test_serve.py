@@ -234,3 +234,45 @@ def test_user_config_push_without_restart(ray_start_regular):
     assert second["y"] == 50, "user_config update did not reach replica"
     assert second["pid"] == first["pid"], "replica was restarted"
     serve.delete("cfg")
+
+
+def test_downscale_drains_inflight_requests(ray_start_regular):
+    """Scale-down removes replicas from routing, waits for their
+    in-flight requests, then kills — no dropped requests (parity:
+    replica graceful shutdown / drain)."""
+    import ray_tpu
+    import ray_tpu.serve as serve
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0,
+        "upscale_delay_s": 0.2, "downscale_delay_s": 0.4})
+    class Slow:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(3.0)
+            return x
+
+    handle = serve.run(Slow.bind(), name="drain")
+
+    def replicas():
+        return serve.status()["drain"]["deployments"]["Slow"][
+            "num_replicas"]
+
+    # build load to force upscale, then send a final wave and watch the
+    # downscale happen while those requests are still in flight
+    first = [handle.remote(i) for i in range(6)]
+    deadline = time.time() + 30
+    while replicas() < 3 and time.time() < deadline:
+        time.sleep(0.2)
+    assert replicas() == 3
+    tail = [handle.remote(100 + i) for i in range(3)]
+    # every request completes despite replicas draining away
+    results = [r.result(timeout_s=120) for r in first + tail]
+    assert sorted(results) == sorted(list(range(6)) +
+                                     [100, 101, 102])
+    deadline = time.time() + 30
+    while replicas() > 1 and time.time() < deadline:
+        time.sleep(0.2)
+    assert replicas() == 1
+    serve.delete("drain")
