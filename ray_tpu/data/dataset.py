@@ -127,9 +127,76 @@ def _all_to_all_refs(refs_in: List[ObjectRef], kind: str,
             *[parts[j][i] for j in range(len(refs_in))])
             for i in range(n)]
     if kind == "sort":
-        table = _sorted_table(refs_in, arg["key"], arg["descending"])
-        return [ray_tpu.put(table)]
+        return _distributed_sort(refs_in, arg["key"], arg["descending"])
     raise ValueError(kind)
+
+
+@ray_tpu.remote(max_retries=3)
+def _sample_keys(block: Block, key: str, n: int):
+    """Uniform key sample from one block (boundary estimation)."""
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    if len(col) == 0:
+        return col
+    idx = np.random.default_rng(0).integers(0, len(col),
+                                            size=min(n, len(col)))
+    return col[idx]
+
+
+@ray_tpu.remote(max_retries=3)
+def _range_partition(block: Block, key: str, boundaries,
+                     descending: bool) -> List[Block]:
+    """Split one block into len(boundaries)+1 key ranges."""
+    import pyarrow as pa
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    part = np.searchsorted(boundaries, col, side="right")
+    if descending:
+        part = len(boundaries) - part
+    out = []
+    for p in range(len(boundaries) + 1):
+        mask = part == p
+        out.append(block.filter(pa.array(mask)) if mask.any()
+                   else block.slice(0, 0))
+    return out
+
+
+@ray_tpu.remote(max_retries=3)
+def _merge_sorted(key: str, descending: bool, *parts: Block) -> Block:
+    import pyarrow.compute as pc
+    flat: List[Block] = []
+    for p in parts:
+        flat.extend(p) if isinstance(p, list) else flat.append(p)
+    table = concat_blocks(flat)
+    order = "descending" if descending else "ascending"
+    return table.take(pc.sort_indices(table, sort_keys=[(key, order)]))
+
+
+def _distributed_sort(refs_in: List[ObjectRef], key: str,
+                      descending: bool) -> List[ObjectRef]:
+    """Sample sort (parity: ray.data push-based shuffle sort): sample
+    keys -> pick partition boundaries -> range-partition every block in
+    parallel -> sort each partition in parallel.  Output block i holds
+    keys <= block i+1 (or >= when descending); nothing funnels through
+    the driver except the O(blocks * sample) key sample."""
+    if not refs_in:
+        return []
+    n = len(refs_in)
+    if n == 1:
+        return [_merge_sorted.remote(key, descending, refs_in[0])]
+    samples = np.concatenate(
+        ray_tpu.get([_sample_keys.remote(r, key, 64) for r in refs_in],
+                    timeout=600))
+    if len(samples) == 0:
+        return list(refs_in)
+    # boundaries by rank in the sorted sample (not np.quantile: no
+    # interpolation, so string/datetime keys sort too)
+    srt = np.sort(samples)
+    boundaries = srt[(np.arange(1, n) * len(srt)) // n]
+    parts = [_range_partition.options(num_returns=n).remote(
+        r, key, boundaries, descending) for r in refs_in]
+    parts = [p if isinstance(p, list) else [p] for p in parts]
+    return [_merge_sorted.remote(key, descending,
+                                 *[parts[j][i] for j in range(n)])
+            for i in range(n)]
 
 
 class Dataset:
@@ -518,14 +585,6 @@ class Dataset:
     def __repr__(self):
         return (f"Dataset(num_blocks={len(self._block_refs)}, "
                 f"ops={len(self._ops)})")
-
-
-def _sorted_table(refs: List[ObjectRef], key: str, descending: bool):
-    import pyarrow.compute as pc
-    table = concat_blocks(ray_tpu.get(list(refs), timeout=600))
-    order = "descending" if descending else "ascending"
-    idx = pc.sort_indices(table, sort_keys=[(key, order)])
-    return table.take(idx)
 
 
 class GroupedData:
