@@ -124,6 +124,13 @@ def cancel(ref: ObjectRef, *, force: bool = False,
     global_worker().cancel_task(ref)
 
 
+def timeline(filename: Optional[str] = None) -> str:
+    """Chrome-trace of task events (parity: ``ray.timeline``): returns
+    the JSON string; also writes it to ``filename`` when given."""
+    from ray_tpu._private.profiling import timeline as _tl
+    return _tl(filename)
+
+
 def nodes() -> List[Dict[str, Any]]:
     out = []
     for info in global_worker().cp.list_nodes():
@@ -209,7 +216,8 @@ def __getattr__(name: str):
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "kill", "cancel", "get_actor", "method", "nodes", "timeline",
+    "cluster_resources",
     "available_resources", "get_runtime_context", "ObjectRef",
     "ObjectRefGenerator", "ActorClass", "ActorHandle", "RemoteFunction",
     "exceptions", "__version__",

@@ -47,7 +47,8 @@ def chrome_tracing_dump(task_events: List[Dict[str, Any]],
     return out
 
 
-def timeline(filename: Optional[str] = None) -> str:
+def timeline(filename: Optional[str] = None,
+             limit: int = 100_000) -> str:
     from ray_tpu._private.worker import global_worker
-    events = global_worker().cp.list_task_events()
+    events = global_worker().cp.list_task_events(limit)
     return chrome_tracing_dump(events, filename)

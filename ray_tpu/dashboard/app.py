@@ -24,6 +24,8 @@ _INDEX_HTML = """<!doctype html>
 <li><a href="/api/objects">objects</a></li>
 <li><a href="/api/placement_groups">placement groups</a></li>
 <li><a href="/api/timeline">timeline (chrome trace)</a></li>
+<li><a href="/api/jobs">jobs</a></li>
+<li><a href="/api/serve">serve apps</a></li>
 <li><a href="/metrics">prometheus metrics</a></li>
 </ul>"""
 
@@ -96,6 +98,34 @@ class Dashboard:
             return web.Response(text=prometheus_text(),
                                 content_type="text/plain")
 
+        def _list_jobs_blocking():
+            from ray_tpu.job import JobSubmissionClient
+            try:
+                return [j.__dict__
+                        for j in JobSubmissionClient().list_jobs()]
+            except Exception:  # noqa: BLE001 — no jobs submitted yet
+                return []
+
+        def _serve_apps_blocking():
+            import ray_tpu as rt
+            try:
+                controller = rt.get_actor("__serve_controller__")
+                return rt.get(controller.list_applications.remote(),
+                              timeout=10)
+            except Exception:  # noqa: BLE001 — serve not running
+                return {}
+
+        async def jobs(request):
+            # cross-process RPC: keep it off the dashboard event loop
+            loop = asyncio.get_running_loop()
+            return json_response(
+                await loop.run_in_executor(None, _list_jobs_blocking))
+
+        async def serve_apps(request):
+            loop = asyncio.get_running_loop()
+            return json_response(
+                await loop.run_in_executor(None, _serve_apps_blocking))
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/nodes", nodes)
@@ -105,6 +135,8 @@ class Dashboard:
         app.router.add_get("/api/placement_groups", pgs)
         app.router.add_get("/api/cluster", cluster)
         app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/api/jobs", jobs)
+        app.router.add_get("/api/serve", serve_apps)
         app.router.add_get("/metrics", metrics)
         runner = web.AppRunner(app)
         await runner.setup()

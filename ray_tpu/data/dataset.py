@@ -111,18 +111,16 @@ def _all_to_all_refs(refs_in: List[ObjectRef], kind: str,
     if kind == "shuffle":
         seed = arg.get("seed")
         n = max(1, len(refs_in))
-        parts = [_split_block.options(num_returns=n).remote(
+        parts = _fan_out([_split_block.options(num_returns=n).remote(
             r, n, (seed + i) if seed is not None else None)
-            for i, r in enumerate(refs_in)]
-        parts = [p if isinstance(p, list) else [p] for p in parts]
+            for i, r in enumerate(refs_in)])
         return [_merge_blocks.remote(
             *[parts[j][i] for j in range(len(refs_in))])
             for i in range(n)]
     if kind == "repartition":
         n = arg["num_blocks"]
-        parts = [_split_block.options(num_returns=n).remote(
-            r, n, None) for r in refs_in]
-        parts = [p if isinstance(p, list) else [p] for p in parts]
+        parts = _fan_out([_split_block.options(num_returns=n).remote(
+            r, n, None) for r in refs_in])
         return [_merge_blocks.remote(
             *[parts[j][i] for j in range(len(refs_in))])
             for i in range(n)]
@@ -131,10 +129,17 @@ def _all_to_all_refs(refs_in: List[ObjectRef], kind: str,
     raise ValueError(kind)
 
 
+def _fan_out(parts: List) -> List[List]:
+    """num_returns>1 task handles resolve to either a list of refs or a
+    single ref (n==1); normalize to list-of-lists."""
+    return [p if isinstance(p, list) else [p] for p in parts]
+
+
 @ray_tpu.remote(max_retries=3)
 def _sample_keys(block: Block, key: str, n: int):
-    """Uniform key sample from one block (boundary estimation)."""
-    col = block.column(key).to_numpy(zero_copy_only=False)
+    """Uniform key sample from one block (boundary estimation; nulls
+    are excluded — they route to the last partition)."""
+    col = block.column(key).drop_null().to_numpy(zero_copy_only=False)
     if len(col) == 0:
         return col
     idx = np.random.default_rng(0).integers(0, len(col),
@@ -147,10 +152,17 @@ def _range_partition(block: Block, key: str, boundaries,
                      descending: bool) -> List[Block]:
     """Split one block into len(boundaries)+1 key ranges."""
     import pyarrow as pa
-    col = block.column(key).to_numpy(zero_copy_only=False)
+    import pyarrow.compute as pc
+    chunked = block.column(key)
+    null_mask = np.asarray(pc.is_null(chunked).combine_chunks())
+    # searchsorted can't order None: substitute the first boundary, then
+    # force nulls into the last partition (pyarrow sorts nulls at_end)
+    col = np.asarray(chunked.fill_null(boundaries[0]).to_numpy(
+        zero_copy_only=False))
     part = np.searchsorted(boundaries, col, side="right")
     if descending:
         part = len(boundaries) - part
+    part = np.where(null_mask, len(boundaries), part)
     out = []
     for p in range(len(boundaries) + 1):
         mask = part == p
@@ -191,9 +203,8 @@ def _distributed_sort(refs_in: List[ObjectRef], key: str,
     # interpolation, so string/datetime keys sort too)
     srt = np.sort(samples)
     boundaries = srt[(np.arange(1, n) * len(srt)) // n]
-    parts = [_range_partition.options(num_returns=n).remote(
-        r, key, boundaries, descending) for r in refs_in]
-    parts = [p if isinstance(p, list) else [p] for p in parts]
+    parts = _fan_out([_range_partition.options(num_returns=n).remote(
+        r, key, boundaries, descending) for r in refs_in])
     return [_merge_sorted.remote(key, descending,
                                  *[parts[j][i] for j in range(n)])
             for i in range(n)]
