@@ -170,6 +170,73 @@ def start_http_proxy(port: int = 8000):
     return proxy
 
 
+# --------------------------------------------------------- multiplexing
+def multiplexed(_func=None, *, max_num_models_per_replica: int = 3):
+    """``@serve.multiplexed`` — per-replica LRU of loaded model
+    versions (parity: ``serve/api.py`` multiplexed + model
+    multiplexing): decorate an async ``load_model(self, model_id)``;
+    calls hit the cache, misses load and evict least-recently-used.
+    Route requests with ``handle.options(multiplexed_model_id=...)``
+    and read the id inside with ``get_multiplexed_model_id()``.
+    """
+    import collections
+    import functools
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        async def wrapper(self, model_id: str):
+            import asyncio
+            import inspect as _inspect
+            cache = getattr(self, "_mux_models", None)
+            if cache is None:
+                cache = collections.OrderedDict()
+                self._mux_models = cache
+                self._mux_pending = {}
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # dedup concurrent misses: one loader per model id, the
+            # rest await its future (double-loading a model can OOM a
+            # TPU replica)
+            pending = self._mux_pending
+            fut = pending.get(model_id)
+            if fut is not None:
+                return await fut
+            fut = asyncio.get_running_loop().create_future()
+            pending[model_id] = fut
+            try:
+                model = fn(self, model_id)
+                if _inspect.iscoroutine(model):
+                    model = await model
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                # eviction drops the cache reference only; the object
+                # finalizes when the last in-flight user releases it
+                # (no explicit __del__: double-finalize hazard)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+                fut.set_result(model)
+                return model
+            except BaseException as e:
+                fut.set_exception(e)
+                raise
+            finally:
+                pending.pop(model_id, None)
+
+        wrapper._is_multiplexed = True
+        return wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id the current request was routed with."""
+    from ray_tpu.serve._private.replica import get_multiplexed_model_id
+    return get_multiplexed_model_id()
+
+
 # ------------------------------------------------------------- batching
 def batch(_func=None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01):
@@ -236,5 +303,6 @@ __all__ = [
     "deployment", "Deployment", "Application", "run", "get_app_handle",
     "get_deployment_handle", "status", "delete", "shutdown",
     "DeploymentHandle", "DeploymentResponse", "batch",
+    "multiplexed", "get_multiplexed_model_id",
     "start_http_proxy",
 ]

@@ -13,6 +13,11 @@ from typing import Any, Dict, Tuple
 import ray_tpu
 
 
+def get_multiplexed_model_id() -> str:
+    from ray_tpu.serve._private.mux_context import get_model_id
+    return get_model_id()
+
+
 @ray_tpu.remote
 class ServeReplica:
     def __init__(self, app_name: str, deployment_name: str,
@@ -45,12 +50,12 @@ class ServeReplica:
     def num_ongoing(self) -> int:
         return self._ongoing
 
-    async def handle_request(self, method_name: str, args, kwargs):
+    async def handle_request(self, method_name: str, args, kwargs,
+                             mux_model_id: str = ""):
+        from ray_tpu.serve._private import mux_context
         self._ongoing += 1
+        token = mux_context.set_model_id(mux_model_id)
         try:
-            target = (self.instance if method_name == "__call__"
-                      and not hasattr(self.instance, "__call__")
-                      else None)
             if callable(self.instance) and method_name == "__call__":
                 fn = self.instance
             else:
@@ -60,6 +65,7 @@ class ServeReplica:
                 result = await result
             return result
         finally:
+            mux_context.reset(token)
             self._ongoing -= 1
 
     async def reconfigure(self, user_config):

@@ -35,7 +35,8 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: Optional[str] = None,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: Optional[str] = None):
         self._app = app_name
         self._deployment = deployment_name
         self._method = method_name
@@ -43,6 +44,11 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
         self._poller_stop = threading.Event()
+        # model multiplexing: requests for one model id stick to the
+        # replica that already loaded it (reference: model-affinity
+        # routing in the pow-2 scheduler)
+        self._mux_id: Optional[str] = multiplexed_model_id
+        self._mux_affinity: Dict[str, Any] = {}
 
     def _start_poller(self, deployment: str) -> None:
         """Long-poll the control-plane pubsub for routing pushes
@@ -91,14 +97,25 @@ class DeploymentHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        sub = DeploymentHandle(self._app, self._deployment, name)
+        sub = DeploymentHandle(self._app, self._deployment, name,
+                               self._mux_id)
+        sub._mux_affinity = self._mux_affinity
+        sub._get_routing = self._get_routing
         self.__dict__[name] = sub
         return sub
 
-    def options(self, method_name: Optional[str] = None
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
-        return DeploymentHandle(self._app, self._deployment,
-                                method_name or self._method)
+        sub = DeploymentHandle(
+            self._app, self._deployment, method_name or self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._mux_id)
+        # per-request sub-handles delegate routing state to the parent:
+        # they must not each pay a controller RPC + long-poll thread
+        sub._mux_affinity = self._mux_affinity
+        sub._get_routing = self._get_routing
+        return sub
 
     def _controller(self):
         from ray_tpu.serve._private.controller import CONTROLLER_NAME
@@ -136,13 +153,31 @@ class DeploymentHandle:
         return a if qa <= qb else b
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        mux = self._mux_id
+        if mux:
+            routing = self._get_routing()
+            replica = self._mux_affinity.get(mux)
+            if replica is not None and replica in routing["replicas"]:
+                try:  # cheap liveness probe, like the pow-2 path
+                    ray_tpu.get(replica.num_ongoing.remote(), timeout=5)
+                except Exception:  # noqa: BLE001 — crashed: re-pin
+                    self._get_routing(refresh=True)
+                    replica = None
+            else:
+                replica = None
+            if replica is None:
+                replica = self._pick_replica()
+                self._mux_affinity[mux] = replica
+            ref = replica.handle_request.remote(self._method, args,
+                                                kwargs, mux)
+            return DeploymentResponse(ref)
         replica = self._pick_replica()
         ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(ref)
 
     def __reduce__(self):
         return (DeploymentHandle, (self._app, self._deployment,
-                                   self._method))
+                                   self._method, self._mux_id))
 
     # identity is the target, not the instance: the controller compares
     # init_args across redeploys to decide in-place reconfigure vs

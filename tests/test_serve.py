@@ -276,3 +276,50 @@ def test_downscale_drains_inflight_requests(ray_start_regular):
         time.sleep(0.2)
     assert replicas() == 1
     serve.delete("drain")
+
+
+def test_model_multiplexing(ray_start_regular):
+    """@serve.multiplexed LRU-caches models per replica; requests with
+    a multiplexed_model_id stick to the replica that loaded the model
+    (parity: serve model multiplexing)."""
+    import os
+
+    import ray_tpu.serve as serve
+
+    @serve.deployment(num_replicas=2)
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"weights-{model_id}"
+
+        async def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model, "pid": os.getpid(),
+                    "loads": list(self.loads)}
+
+    handle = serve.run(MuxModel.bind(), name="mux")
+    # same model id -> same replica, loaded exactly once
+    outs = [handle.options(multiplexed_model_id="m1").remote(i).result(
+        timeout_s=60) for i in range(4)]
+    assert all(o["model"] == "weights-m1" for o in outs)
+    assert len({o["pid"] for o in outs}) == 1
+    assert outs[-1]["loads"].count("m1") == 1
+    # a second model coexists in the LRU (capacity 2)
+    o2 = handle.options(multiplexed_model_id="m2").remote(0).result(
+        timeout_s=60)
+    assert o2["model"] == "weights-m2"
+    # third model on the same replica evicts the LRU entry; reloading
+    # the evicted model counts a second load on that replica
+    sticky = handle.options(multiplexed_model_id="m1")
+    pid1 = outs[0]["pid"]
+    for mid in ("m3", "m4"):
+        handle.options(multiplexed_model_id=mid).remote(0).result(
+            timeout_s=60)
+    again = sticky.remote(9).result(timeout_s=60)
+    assert again["model"] == "weights-m1"
+    serve.delete("mux")
