@@ -26,6 +26,7 @@ Group state is per-process, keyed by group name (reference
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -90,6 +91,18 @@ class CollectiveStore:
             self._consumed.pop(op_id, None)
         return result
 
+    async def set_config(self, key: str, value: Any):
+        """Group-wide config agreed at init (e.g. the ring threshold):
+        rank 0 sets, everyone else waits — per-rank env divergence
+        would silently deadlock mixed algorithm choices."""
+        self._p2p[("cfg", key)] = value
+        ev = self._event(f"cfg:{key}")
+        ev.set()
+
+    async def get_config(self, key: str):
+        await self._event(f"cfg:{key}").wait()
+        return self._p2p[("cfg", key)]
+
     async def put_p2p(self, key: str, value: Any):
         self._p2p[key] = value
         if key not in self._p2p_events:
@@ -133,6 +146,7 @@ class HostGroup(BaseGroup):
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
         self._p2p_seq: Dict[Any, int] = {}
+        self._ring_min: Optional[int] = None
         store_name = f"__collective_{group_name}"
         if rank == 0:
             try:
@@ -140,6 +154,13 @@ class HostGroup(BaseGroup):
                     name=store_name, lifetime="detached").remote(world_size)
             except ValueError:
                 self.store = get_actor(store_name)
+            try:
+                ring_min = int(os.environ.get(
+                    "RAY_TPU_COLLECTIVE_RING_MIN", self.RING_MIN_BYTES))
+            except ValueError:
+                ring_min = self.RING_MIN_BYTES
+            ray_tpu.get(self.store.set_config.remote("ring_min",
+                                                     ring_min))
         else:
             deadline = 30.0
             import time
@@ -155,9 +176,27 @@ class HostGroup(BaseGroup):
 
     def _exchange(self, verb: str, value: Any) -> List[Any]:
         """Full gather through the actor — only for tiny payloads
-        (barrier tokens, broadcast refs)."""
+        (barrier tokens, refs)."""
         op = self._next_op(verb)
         return ray_tpu.get(self.store.gather.remote(op, self.rank, value))
+
+    def _exchange_arrays(self, verb: str, arr) -> List[np.ndarray]:
+        """All ranks see all arrays; payloads ride the object store,
+        the actor shuttles only refs.  The trailing exchange is the ack
+        barrier keeping every rank's ref (the GC pin) alive until all
+        have fetched."""
+        ref = ray_tpu.put(np.ascontiguousarray(arr))
+        refs = self._exchange(verb, [ref])
+        values = ray_tpu.get([r[0] for r in refs])
+        self._exchange(verb + "_ack", None)
+        return [np.asarray(v) for v in values]
+
+    def _ring_threshold(self) -> int:
+        t = self._ring_min
+        if t is None:
+            t = ray_tpu.get(self.store.get_config.remote("ring_min"))
+            self._ring_min = t
+        return t
 
     # -- ring plumbing ------------------------------------------------
     def _ring_send(self, op: str, step: int, dst: int, arr) -> None:
@@ -200,6 +239,15 @@ class HostGroup(BaseGroup):
             chunks[recv_idx] = self._ring_recv(op_id, step, prv)
         return chunks
 
+    # Below this payload size the ring's 2(W-1) sequential hops cost
+    # more than one rendezvous round trip — the latency-vs-bandwidth
+    # algorithm switch NCCL makes between tree/direct and ring.
+    # Measured crossover on a 1-core host is ~2-4 MiB (32KiB: 18ms
+    # direct vs 547ms ring; 8MiB: 1.7s direct vs 0.76s ring); tune per
+    # deployment via RAY_TPU_COLLECTIVE_RING_MIN (rank 0's value is
+    # published to the group so every rank picks the same algorithm).
+    RING_MIN_BYTES = 4 * 1024 * 1024
+
     # -- verbs --------------------------------------------------------
     def allreduce(self, tensor, op: str = "sum"):
         arr = np.asarray(tensor)
@@ -207,6 +255,10 @@ class HostGroup(BaseGroup):
         if W == 1:
             return arr
         binop = _BINOPS[op]
+        if arr.nbytes < self._ring_threshold():
+            # latency path: one rendezvous round trip
+            return REDUCE_OPS[op](self._exchange_arrays("allreduce",
+                                                        arr))
         flat = arr.reshape(-1)
         chunks = [c.copy() for c in np.array_split(flat, W)]
         op_rs = self._next_op("ar_rs")
@@ -221,6 +273,8 @@ class HostGroup(BaseGroup):
         W = self.world_size
         if W == 1:
             return [arr]
+        if arr.nbytes < self._ring_threshold():
+            return self._exchange_arrays("allgather", arr)
         chunks: List[Any] = [None] * W
         chunks[self.rank] = arr
         op_ag = self._next_op("ag")
@@ -233,6 +287,10 @@ class HostGroup(BaseGroup):
         if W == 1:
             return arr
         binop = _BINOPS[op]
+        if arr.nbytes < self._ring_threshold():
+            red = REDUCE_OPS[op](self._exchange_arrays("rs_direct",
+                                                       arr))
+            return np.array_split(red, W)[self.rank]
         chunks = [c.copy() for c in np.array_split(arr, W)]
         op_rs = self._next_op("rs")
         chunks = self._ring_reduce_scatter(op_rs, chunks, binop)
@@ -266,6 +324,10 @@ class HostGroup(BaseGroup):
         if W == 1:
             return arr
         binop = _BINOPS[op]
+        if arr.nbytes < self._ring_threshold():
+            arrs = self._exchange_arrays("red_direct", arr)
+            return (REDUCE_OPS[op](arrs) if self.rank == dst_rank
+                    else arr)
         chunks = [c.copy() for c in np.array_split(arr.reshape(-1), W)]
         op_rs = self._next_op("red_rs")
         chunks = self._ring_reduce_scatter(op_rs, chunks, binop)

@@ -132,6 +132,9 @@ def test_host_ring_ops_world4(ray_start_regular):
     class W:
         def __init__(self, rank, world):
             from ray_tpu.util import collective
+            # force the ring algorithm: small test tensors would
+            # otherwise take the direct latency path
+            collective.HostGroup.RING_MIN_BYTES = 0
             collective.init_collective_group(world, rank, backend="host",
                                              group_name="ring4")
             self.rank = rank
