@@ -48,8 +48,12 @@ class GPTConfig:
     # time (O(L)) for free scheduling.  scan stays the default for deep
     # models / fast iteration.
     unroll_layers: bool = False
-    # cross-entropy chunk rows (0 = one chunk over the whole batch);
-    # smaller chunks bound the [chunk, V] f32 logits transient
+    # cross-entropy chunk rows (0 = one chunk over the whole batch;
+    # -1 = one chunk *without* rematerialization: backward reuses the
+    # saved [N, V] f32 logits instead of recomputing them — one fewer
+    # full vocab matmul per step, at the cost of keeping the logits
+    # resident between forward and backward).  Smaller positive chunks
+    # bound the [chunk, V] f32 logits transient.
     ce_chunk: int = 4096
 
     @property
@@ -346,10 +350,10 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
     unrolled chunks whose remat boundaries XLA schedules freely.
     """
     N, d = x.shape
+    remat = chunk >= 0
     if chunk <= 0:
         chunk = N
 
-    @jax.checkpoint
     def chunk_loss(xc, tc):
         logits = jnp.einsum("nd,dv->nv", xc, head,
                             preferred_element_type=jnp.float32)
@@ -358,6 +362,9 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
             logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]
         mask = (tc >= 0).astype(jnp.float32)
         return jnp.sum((lse - true) * mask), jnp.sum(mask)
+
+    if remat:
+        chunk_loss = jax.checkpoint(chunk_loss)
 
     if N <= chunk:
         return chunk_loss(x, targets)

@@ -55,6 +55,23 @@ def _block_live(i, j, *, causal: bool, block_q: int, block_k: int):
     return (j * block_k <= i * block_q + block_q - 1) if causal else True
 
 
+def _grad_blocks(q, k, v, do, lse, delta, i, j, *, scale: float,
+                 causal: bool, block_q: int, block_k: int):
+    """Shared backward block math: (p [bq,bk] f32, ds [bq,bk] f32).
+
+    p = exp(s - lse) recomputed from the block scores; ds is the score
+    gradient.  dq/dk/dv follow as single matmuls against k/q/do in the
+    caller (which differ per kernel in what they accumulate)."""
+    s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bq, bk]
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -163,19 +180,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _compute():
-        q = q_ref[0, 0]
         k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0, 0][:, 0:1]                   # [bq, 1]
-        delta = delta_ref[0, 0, 0][:, 0:1]               # [bq, 1]
-        s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse)                             # [bq, bk]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta) * scale
+        _, ds = _grad_blocks(
+            q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
+            lse_ref[0, 0, 0][:, 0:1], delta_ref[0, 0, 0][:, 0:1], i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         dq_sc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -183,6 +192,53 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(j == num_kv - 1)
     def _finalize():
         dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                      scale: float, causal: bool, block_q: int,
+                      block_k: int, num_q: int):
+    """Single-kv-block backward: dq, dk, dv in one pass over (b, h, i).
+
+    The two-kernel backward (`_bwd_dq_kernel` + `_bwd_dkv_kernel`)
+    recomputes the score block and dp in each kernel — 2 extra K=head_dim
+    matmuls per block pair, the expensive kind on the MXU (contraction
+    = 64 runs the systolic array at half rate).  When the whole kv
+    sequence fits one block (num_kv == 1: the S<=block_k case, e.g.
+    GPT-2 @ 1024 with 1024 blocks) s/p/dp can be computed once and feed
+    all three gradients: dq is written exactly once per q block, dk/dv
+    accumulate in VMEM scratch across the sequential i sweep.  Longer
+    sequences take the two-kernel path (`_bwd`), whose per-block
+    accumulations don't need cross-step output revisiting.
+    """
+    i = pl.program_id(2)                        # q block index
+
+    @pl.when(i == 0)
+    def _init_kv():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    do = do_ref[0, 0]
+    p, ds = _grad_blocks(
+        q, k, v_ref[0, 0], do, lse_ref[0, 0, 0][:, 0:1],
+        delta_ref[0, 0, 0][:, 0:1], i, 0,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    dv_sc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bk, D]
+    dk_sc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bk, D]
+    dq_ref[0, 0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -200,21 +256,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          block_k=block_k))
     def _compute():
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0, 0][:, 0:1]
-        delta = delta_ref[0, 0, 0][:, 0:1]
-        s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse)                             # [bq, bk]
+        p, ds = _grad_blocks(
+            q, k_ref[0, 0], v_ref[0, 0], do, lse_ref[0, 0, 0][:, 0:1],
+            delta_ref[0, 0, 0][:, 0:1], i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         dv_sc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta) * scale                    # [bq, bk]
         dk_sc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
@@ -235,6 +284,30 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
         jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                 axis=-1).reshape(B, H, num_q, bq, 1),
         (B, H, num_q, bq, STATS_LANES))
+
+    if num_kv == 1:
+        qs = pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0))
+        ks = pl.BlockSpec((1, 1, bk, D), lambda b, h, i: (b, h, 0, 0))
+        rs = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                          lambda b, h, i: (b, h, i, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale,
+                              causal=causal, block_q=bq, block_k=bk,
+                              num_q=num_q),
+            grid=(B, H, num_q),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            in_specs=[qs, ks, ks, qs, rs, rs],
+            out_specs=[qs, ks, ks],
+            out_shape=[jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+                       jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+                       jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+            interpret=_use_interpret(),
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))

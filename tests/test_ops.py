@@ -45,6 +45,44 @@ def test_flash_grads_match_einsum():
         assert float(jnp.abs(a - b).max()) < 5e-4
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_fused_single_kv_block(causal):
+    # block_k >= S selects the fused one-pass backward (num_kv == 1)
+    key = jax.random.PRNGKey(6)
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss_fused(q, k, v):
+        return (A.flash_attention(q, k, v, causal=causal, block_q=128,
+                                  block_k=256) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (local_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.abs(a - b).max()) < 5e-4
+
+
+def test_chunked_ce_noremat_matches_dense():
+    from ray_tpu.models.gpt import _chunked_ce
+    key = jax.random.PRNGKey(7)
+    N, d, V = 512, 32, 101
+    x = jax.random.normal(key, (N, d), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(8), (d, V), jnp.float32)
+    tgt = jax.random.randint(jax.random.PRNGKey(9), (N,), 0, V)
+
+    s0, n0 = _chunked_ce(x, head, tgt, chunk=0)     # remat single chunk
+    s1, n1 = _chunked_ce(x, head, tgt, chunk=-1)    # no-remat
+    assert abs(float(s0) - float(s1)) < 1e-2
+    assert int(n0) == int(n1)
+    g0 = jax.grad(lambda x: _chunked_ce(x, head, tgt, chunk=0)[0])(x)
+    g1 = jax.grad(lambda x: _chunked_ce(x, head, tgt, chunk=-1)[0])(x)
+    assert float(jnp.abs(g0 - g1).max()) < 1e-5
+
+
 def test_flash_fallback_small_shapes():
     # shapes the grid cannot tile fall back to the einsum path
     key = jax.random.PRNGKey(2)
