@@ -180,16 +180,12 @@ def _rope(x, positions, theta: float):
 
     Angles/cos/sin in f32 (position precision), the rotation itself in
     the activation dtype — the f32 q/k intermediates otherwise double
-    HBM traffic for every layer (~7% of a GPT-2 training step)."""
-    B, S, H, D = x.shape
-    half = D // 2
-    freqs = jnp.exp(-jnp.log(theta) * jnp.arange(half) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
-    x1, x2 = x[..., :half], x[..., half:]
-    return jnp.concatenate(
-        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    HBM traffic for every layer.  Delegates to
+    ``ray_tpu.ops.attention.rope_rotate`` so the XLA-side rotation and
+    the in-kernel fused one (``make_flash_attention_fn(rope_theta=...)``)
+    share one formulation."""
+    from ray_tpu.ops.attention import rope_rotate
+    return rope_rotate(x, positions, theta)
 
 
 def _dense_ffn(lp, x, cfg: GPTConfig):
@@ -235,13 +231,18 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-    if cfg.pos == "rope":
+    fused_rope = (cfg.pos == "rope"
+                  and getattr(attn_fn, "fused_rope", False))
+    if cfg.pos == "rope" and not fused_rope:
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
     q = constrain(q, ("batch", "seq", "heads", None))
     k = constrain(k, ("batch", "seq", "heads", None))
     v = constrain(v, ("batch", "seq", "heads", None))
-    attn = attn_fn(q, k, v)
+    if fused_rope:
+        attn = attn_fn(q, k, v, positions=positions)
+    else:
+        attn = attn_fn(q, k, v)
     attn = constrain(attn, ("batch", "seq", "heads", None))
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
     h2 = _norm(x, lp["ln2"], cfg.norm)

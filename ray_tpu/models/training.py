@@ -86,7 +86,9 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
             raise ValueError(f"unknown sp_impl {sp_impl!r}; "
                              "expected 'ring' or 'ulysses'")
     else:
-        attn_fn = make_flash_attention_fn(mesh, causal=True)
+        attn_fn = make_flash_attention_fn(
+            mesh, causal=True,
+            rope_theta=cfg.rope_theta if cfg.pos == "rope" else None)
     batch_sh = _batch_sharding(mesh)
 
     def loss(params, batch):

@@ -33,6 +33,65 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ---------------------------------------------------------------------------
+# fused RoPE
+#
+# Applied outside the kernel, the rotation is 4+ HBM passes over q and k
+# per layer in a lane-32 layout XLA handles badly (~18 ms/step on the
+# GPT-2 bench).  Fused, the rotation is a few VPU ops on VMEM-resident
+# blocks.  Formulation that avoids lane-32 slicing: with duplicated
+# tables cos2 = [cos, cos], sinm = [-sin, sin] (each [S, D]),
+#   rot(x)  = x * cos2 + roll(x, D/2) * sinm       (the RoPE rotation)
+#   rotT(g) = g * cos2 - roll(g, D/2) * sinm       (its transpose)
+# since roll(x, D/2) swaps halves and the sign pattern folds into sinm.
+# ---------------------------------------------------------------------------
+
+def rope_tables(positions, D: int, theta: float, dtype):
+    """positions [S] -> (cos2, sinm) each [S, D] for the fused kernels."""
+    half = D // 2
+    freqs = jnp.exp(-jnp.log(theta) * jnp.arange(half) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    cos2 = jnp.concatenate([cos, cos], -1).astype(dtype)
+    sinm = jnp.concatenate([-sin, sin], -1).astype(dtype)
+    return cos2, sinm
+
+
+def rope_rotate(x, positions, theta: float):
+    """XLA-side RoPE: x [B, S, H, D] rotated per-position.
+
+    The single source of truth for the rotation outside the kernels —
+    ``ray_tpu.models.gpt._rope`` and the ``flash_attention`` fallback
+    both call this, so it stays numerically identical to the in-kernel
+    ``_rot`` (same duplicated-table formulation)."""
+    D = x.shape[-1]
+    cos2, sinm = rope_tables(positions, D, theta, x.dtype)
+    return (x * cos2[None, :, None, :]
+            + jnp.roll(x, D // 2, -1) * sinm[None, :, None, :])
+
+
+def _roll_half(x, D: int):
+    # Mosaic's lane rotate is 32-bit only; callers pass f32.
+    if _use_interpret():
+        return jnp.roll(x, D // 2, axis=-1)
+    return pltpu.roll(x, D // 2, 1)
+
+
+def _rot(x, cos2, sinm, D: int):
+    xf = x.astype(jnp.float32)
+    out = (xf * cos2.astype(jnp.float32)
+           + _roll_half(xf, D) * sinm.astype(jnp.float32))
+    return out.astype(x.dtype)
+
+
+def _rot_t(g, cos2, sinm, D: int):
+    gf = g.astype(jnp.float32)
+    out = (gf * cos2.astype(jnp.float32)
+           - _roll_half(gf, D) * sinm.astype(jnp.float32))
+    return out.astype(g.dtype)
+
+
 def _masked_scores(q, k, i, j, *, scale: float, causal: bool,
                    block_q: int, block_k: int):
     """f32 scaled q@k^T for blocks (i, j) with the causal mask applied."""
@@ -76,9 +135,14 @@ def _grad_blocks(q, k, v, do, lse, delta, i, j, *, scale: float,
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *, scale: float, causal: bool,
-                block_q: int, block_k: int, num_kv: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
+                block_q: int, block_k: int, num_kv: int,
+                has_rope: bool):
+    if has_rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, acc_sc, m_sc, l_sc) = rest
+    else:
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -93,6 +157,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0, 0]                      # [bq, D]
         k = k_ref[0, 0]                      # [bk, D]
         v = v_ref[0, 0]
+        if has_rope:
+            D = q.shape[-1]
+            q = _rot(q, cq_ref[...], sq_ref[...], D)
+            k = _rot(k, ck_ref[...], sk_ref[...], D)
         s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         m_prev = m_sc[:]                      # [bq, 128] (col-bcast)
@@ -117,9 +185,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd(q, k, v, *, scale: float, causal: bool,
-         block_q: int, block_k: int):
+         block_q: int, block_k: int, rope=None):
     """q,k,v: [B, H, S, D] -> (o [B, H, S, D],
-    lse [B, H, S // bq, bq, STATS_LANES] f32 — lane-padded row stats)."""
+    lse [B, H, S // bq, bq, STATS_LANES] f32 — lane-padded row stats).
+
+    ``rope``: optional (cos2 [S, D], sinm [S, D]) tables from
+    ``rope_tables``; q/k blocks are rotated in-kernel."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
     bq, bk = min(block_q, S), min(block_k, Sk)
@@ -128,7 +199,17 @@ def _fwd(q, k, v, *, scale: float, causal: bool,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        num_kv=num_kv)
+        num_kv=num_kv, has_rope=rope is not None)
+    rope_args, rope_specs = (), []
+    if rope is not None:
+        cos2, sinm = rope
+        rope_args = (cos2, sinm, cos2, sinm)
+        rope_specs = [
+            pl.BlockSpec((bq, D), lambda b, h, i, j: (i, 0)),
+            pl.BlockSpec((bq, D), lambda b, h, i, j: (i, 0)),
+            pl.BlockSpec((bk, D), lambda b, h, i, j: (j, 0)),
+            pl.BlockSpec((bk, D), lambda b, h, i, j: (j, 0)),
+        ]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -139,6 +220,7 @@ def _fwd(q, k, v, *, scale: float, causal: bool,
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            *rope_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -160,7 +242,7 @@ def _fwd(q, k, v, *, scale: float, causal: bool,
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(q, k, v)
+    )(q, k, v, *rope_args)
     return o, lse
 
 
@@ -195,9 +277,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
-                      scale: float, causal: bool, block_q: int,
-                      block_k: int, num_q: int):
+                      *rest, scale: float, causal: bool, block_q: int,
+                      block_k: int, num_q: int, has_rope: bool):
     """Single-kv-block backward: dq, dk, dv in one pass over (b, h, i).
 
     The two-kernel backward (`_bwd_dq_kernel` + `_bwd_dkv_kernel`)
@@ -210,7 +291,18 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     accumulate in VMEM scratch across the sequential i sweep.  Longer
     sequences take the two-kernel path (`_bwd`), whose per-block
     accumulations don't need cross-step output revisiting.
+
+    With ``has_rope``, q/k are rotated in-kernel for the score
+    recompute; score-gradients land on the *rotated* q/k, so dq takes
+    the transposed rotation before its store and dk takes it at
+    finalize (the rotation is per-row, so it commutes with the
+    accumulation over q blocks).
     """
+    if has_rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         dq_ref, dk_ref, dv_ref, dk_sc, dv_sc) = rest
+    else:
+        dq_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
     i = pl.program_id(2)                        # q block index
 
     @pl.when(i == 0)
@@ -221,6 +313,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     do = do_ref[0, 0]
+    D = q.shape[-1]
+    if has_rope:
+        q = _rot(q, cq_ref[...], sq_ref[...], D)
+        k = _rot(k, ck_ref[...], sk_ref[...], D)
     p, ds = _grad_blocks(
         q, k, v_ref[0, 0], do, lse_ref[0, 0, 0][:, 0:1],
         delta_ref[0, 0, 0][:, 0:1], i, 0,
@@ -231,13 +327,19 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_sc[:] += jax.lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)          # [bk, D]
-    dq_ref[0, 0] = jax.lax.dot_general(
+    dq = jax.lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        preferred_element_type=jnp.float32)
+    if has_rope:
+        dq = _rot_t(dq, cq_ref[...], sq_ref[...], D)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
     @pl.when(i == num_q - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dk = dk_sc[:]
+        if has_rope:
+            dk = _rot_t(dk, ck_ref[...], sk_ref[...], D)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
 
 
@@ -275,7 +377,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
-         block_q: int, block_k: int):
+         block_q: int, block_k: int, rope=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     bq, bk = min(block_q, S), min(block_k, Sk)
@@ -290,15 +392,25 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
         ks = pl.BlockSpec((1, 1, bk, D), lambda b, h, i: (b, h, 0, 0))
         rs = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
                           lambda b, h, i: (b, h, i, 0, 0))
+        rope_args, rope_specs = (), []
+        if rope is not None:
+            cos2, sinm = rope
+            rope_args = (cos2, sinm, cos2, sinm)
+            rope_specs = [
+                pl.BlockSpec((bq, D), lambda b, h, i: (i, 0)),
+                pl.BlockSpec((bq, D), lambda b, h, i: (i, 0)),
+                pl.BlockSpec((bk, D), lambda b, h, i: (0, 0)),
+                pl.BlockSpec((bk, D), lambda b, h, i: (0, 0)),
+            ]
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, scale=scale,
                               causal=causal, block_q=bq, block_k=bk,
-                              num_q=num_q),
+                              num_q=num_q, has_rope=rope is not None),
             grid=(B, H, num_q),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary")),
-            in_specs=[qs, ks, ks, qs, rs, rs],
+            in_specs=[qs, ks, ks, qs, rs, rs, *rope_specs],
             out_specs=[qs, ks, ks],
             out_shape=[jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
                        jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
@@ -306,8 +418,9 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
             scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                             pltpu.VMEM((bk, D), jnp.float32)],
             interpret=_use_interpret(),
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse, delta, *rope_args)
         return dq, dk, dv
+    assert rope is None, "fused rope requires a single kv block"
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
@@ -378,6 +491,32 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, do):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bhsd_rope(q, k, v, cos2, sinm, scale, causal, block_q,
+                     block_k):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, rope=(cos2, sinm))
+    return o
+
+
+def _flash_bhsd_rope_fwd(q, k, v, cos2, sinm, scale, causal, block_q,
+                         block_k):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, rope=(cos2, sinm))
+    return o, (q, k, v, cos2, sinm, o, lse)
+
+
+def _flash_bhsd_rope_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, cos2, sinm, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k,
+                      rope=(cos2, sinm))
+    return dq, dk, dv, None, None
+
+
+_flash_bhsd_rope.defvjp(_flash_bhsd_rope_fwd, _flash_bhsd_rope_bwd)
+
+
 def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
              block_k: int = 1024) -> bool:
     """Shapes the kernel grid can tile (fallback to einsum otherwise)."""
@@ -388,38 +527,67 @@ def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 1024,
-                    block_k: int = 1024):
+                    block_k: int = 1024, positions=None,
+                    rope_theta: float = 10000.0):
     """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
 
     Drop-in for ``ray_tpu.parallel.ring_attention.local_attention``;
     falls back to the einsum path for shapes the grid cannot tile.
+
+    ``positions`` [S] enables fused RoPE: q/k are rotated inside the
+    kernels (zero extra HBM passes) when the kv sequence fits one
+    block; otherwise the rotation is applied here before dispatch
+    (same math as ``ray_tpu.models.gpt._rope``).
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    if not supports(S, Sk, D, block_q=block_q, block_k=block_k):
+    kernel_ok = supports(S, Sk, D, block_q=block_q, block_k=block_k)
+    # in-kernel rope needs the fused single-kv-block backward
+    fuse_rope = (positions is not None and kernel_ok
+                 and S == Sk and Sk <= block_k)
+    if positions is not None and S != Sk:
+        raise ValueError(f"rope needs q and kv positions to match: "
+                         f"S={S} vs Sk={Sk}")
+    if positions is not None and not fuse_rope:
+        q = rope_rotate(q, positions, rope_theta)
+        k = rope_rotate(k, positions, rope_theta)
+    if not kernel_ok:
         from ray_tpu.parallel.ring_attention import local_attention
         return local_attention(q, k, v, causal=causal, scale=scale)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k)
+    if fuse_rope:
+        cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
+        o = _flash_bhsd_rope(qt, kt, vt, cos2, sinm, scale, causal,
+                             block_q, block_k)
+    else:
+        o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k)
     return jnp.swapaxes(o, 1, 2)
 
 
 def make_flash_attention_fn(mesh=None, *, causal: bool = True,
-                            block_q: int = 1024, block_k: int = 1024):
+                            block_q: int = 1024, block_k: int = 1024,
+                            rope_theta: Optional[float] = None):
     """Mesh-aware flash attention (drop-in for ``make_ring_attention_fn``).
 
     A ``pallas_call`` has no SPMD partitioning rule, so on a >1-device
     mesh the kernel runs under ``shard_map``: batch over (dp, fsdp),
     heads over tp — each device runs the kernel on its local shard.
     Sequence stays unsharded (sp>1 uses ring attention instead).
+
+    With ``rope_theta`` the returned fn accepts ``positions`` and
+    applies RoPE inside the kernels (``fn.fused_rope`` marks this so
+    the model skips its own rotation).
     """
     fn = functools.partial(flash_attention, causal=causal,
                            block_q=block_q, block_k=block_k)
+    if rope_theta is not None:
+        fn = functools.partial(fn, rope_theta=rope_theta)
     if mesh is None or getattr(mesh, "size", 1) <= 1:
+        fn.fused_rope = rope_theta is not None
         return fn
 
     from jax.sharding import PartitionSpec as P
@@ -430,9 +598,23 @@ def make_flash_attention_fn(mesh=None, *, causal: bool = True,
     tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
     spec = P(data_axes(mesh), None, tp, None)
 
+    if rope_theta is not None:
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(spec,) * 3 + (P(None),),
+                           out_specs=spec)
+        def sharded(q, k, v, positions):
+            return fn(q, k, v, positions=positions)
+
+        wrapped = lambda q, k, v, positions: sharded(  # noqa: E731
+            q, k, v, positions)
+        wrapped.fused_rope = True
+        return wrapped
+
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec)
     def sharded(q, k, v):
         return fn(q, k, v)
 
-    return sharded
+    sharded_fn = lambda q, k, v: sharded(q, k, v)     # noqa: E731
+    sharded_fn.fused_rope = False
+    return sharded_fn

@@ -66,6 +66,51 @@ def test_flash_grads_fused_single_kv_block(causal):
         assert float(jnp.abs(a - b).max()) < 5e-4
 
 
+def test_flash_fused_rope_matches_external_rotation():
+    # in-kernel rope (fwd + fused bwd) vs rotate-then-attend reference
+    from ray_tpu.models.gpt import _rope
+    key = jax.random.PRNGKey(10)
+    B, S, H, D = 2, 256, 2, 64
+    theta = 10000.0
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    positions = jnp.arange(S)
+
+    def loss_fused(q, k, v):
+        o = A.flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=256, positions=positions,
+                              rope_theta=theta)
+        return (o ** 2).sum()
+
+    def loss_ref(q, k, v):
+        qr = _rope(q, positions, theta)
+        kr = _rope(k, positions, theta)
+        return (local_attention(qr, kr, v, causal=True) ** 2).sum()
+
+    l1, g1 = jax.value_and_grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    l2, g2 = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert abs(float(l1) - float(l2)) / abs(float(l2)) < 1e-4
+    for a, b in zip(g1, g2):
+        assert float(jnp.abs(a - b).max()) < 5e-4
+
+
+def test_flash_rope_multiblock_falls_back_to_external():
+    # kv split over several blocks: rotation applied outside the kernel
+    from ray_tpu.models.gpt import _rope
+    key = jax.random.PRNGKey(11)
+    B, S, H, D = 1, 256, 2, 64
+    theta = 500.0
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    positions = jnp.arange(S)
+    out = A.flash_attention(q, k, v, causal=True, block_q=128,
+                            block_k=128, positions=positions,
+                            rope_theta=theta)
+    ref = local_attention(_rope(q, positions, theta),
+                          _rope(k, positions, theta), v, causal=True)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
 def test_chunked_ce_noremat_matches_dense():
     from ray_tpu.models.gpt import _chunked_ce
     key = jax.random.PRNGKey(7)
