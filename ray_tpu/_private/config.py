@@ -98,6 +98,18 @@ _d("object_gc_period_s", 1.0, "Control-plane GC sweep period.")
 
 # --- scheduler -------------------------------------------------------------
 _d("worker_pool_min_workers", 0, "Prestarted workers per node.")
+
+# --- memory monitor (reference: common/memory_monitor.h,
+# raylet/worker_killing_policy.cc) --------------------------------------
+_d("memory_monitor_refresh_ms", 250,
+   "Node memory sampling period; 0 disables OOM killing.")
+_d("memory_usage_threshold", 0.95,
+   "Node memory usage fraction above which the OOM policy kills a "
+   "worker (newest retriable task first).")
+_d("memory_monitor_limit_bytes", 0,
+   "If >0, usage = sum(worker RSS)/limit instead of /proc/meminfo — "
+   "lets tests (and containers without cgroup visibility) bound the "
+   "worker pool explicitly.")
 _d("worker_lease_timeout_s", 30.0, "Timeout for leasing a worker.")
 _d("scheduler_spread_threshold", 0.5,
    "Hybrid policy: pack nodes below this utilization, then spread.")

@@ -26,19 +26,88 @@ from typing import Any, Dict, List, Optional, Tuple
 ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
 
 
-class _Waiters:
-    """Condition-variable fan-out keyed by arbitrary hashable keys."""
+_WILDCARD = object()   # fired marker for notify_all
+
+
+class _Waiter:
+    __slots__ = ("event", "fired", "lock")
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self.event = threading.Event()
+        self.fired = set()
+        self.lock = threading.Lock()
 
-    def notify(self):
-        with self._cond:
-            self._cond.notify_all()
+    def take_fired(self) -> set:
+        # clear-then-swap under the lock: a notify that lands after the
+        # swap re-sets the event, so the next wait wakes immediately
+        # (clearing after the swap could strand a fired key behind a
+        # cleared event for a full poll interval)
+        with self.lock:
+            self.event.clear()
+            fired, self.fired = self.fired, set()
+        return fired
 
-    def wait_for(self, predicate, timeout: Optional[float]):
+
+class _Waiters:
+    """Per-key waiter registry.
+
+    The previous design was one condition variable per table:
+    every object commit woke every blocked ``get()``/``wait()`` in the
+    process and each re-ran its full predicate — an O(waiters x events)
+    wakeup storm at 10k+ queued tasks.  Here waiters register the exact
+    keys they care about (object ids, actor ids, channels); an event
+    wakes only the waiters registered on its key and tells them *which*
+    keys fired, so e.g. a 10k-ref ``wait`` re-checks only fired ids
+    (reference analogue: per-object waiter lists in
+    ``raylet/wait_manager.cc``).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_key: Dict[Any, set] = {}
+
+    def register(self, keys) -> _Waiter:
+        w = _Waiter()
+        with self._lock:
+            for k in keys:
+                self._by_key.setdefault(k, set()).add(w)
+        return w
+
+    def unregister(self, keys, w: _Waiter) -> None:
+        with self._lock:
+            for k in keys:
+                s = self._by_key.get(k)
+                if s is not None:
+                    s.discard(w)
+                    if not s:
+                        del self._by_key[k]
+
+    def notify(self, keys) -> None:
+        hit = []
+        with self._lock:
+            for k in keys:
+                s = self._by_key.get(k)
+                if s:
+                    for w in s:
+                        hit.append((w, k))
+        for w, k in hit:
+            with w.lock:
+                w.fired.add(k)
+            w.event.set()
+
+    def notify_all(self) -> None:
+        with self._lock:
+            waiters = {w for s in self._by_key.values() for w in s}
+        for w in waiters:
+            with w.lock:
+                w.fired.add(_WILDCARD)
+            w.event.set()
+
+    def wait_for(self, predicate, timeout: Optional[float], keys):
+        """Re-evaluate ``predicate`` when any of ``keys`` fires."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
+        w = self.register(keys)
+        try:
             while True:
                 value = predicate()
                 if value is not None:
@@ -48,7 +117,10 @@ class _Waiters:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
-                self._cond.wait(remaining if remaining is not None else 1.0)
+                w.event.wait(remaining if remaining is not None else 1.0)
+                w.take_fired()
+        finally:
+            self.unregister(keys, w)
 
 
 class ControlPlane:
@@ -138,15 +210,26 @@ class ControlPlane:
     def post_restore(self) -> None:
         """Fixups after replay: give restored nodes one fresh heartbeat
         window to reconnect (survivors re-heartbeat within 1s over the
-        rebound socket; the death watcher reaps the rest)."""
+        rebound socket; the death watcher reaps the rest).
+
+        The *previous head's* node entry is marked DEAD immediately: the
+        restarted head re-registers its own fresh entry, and leaving two
+        ALIVE nodes advertising ``node:__internal_head__`` lets
+        ``init(address='auto')`` attach to the dead one."""
         now = time.time()
         with self._lock:
             for info in self._nodes.values():
-                if info.get("state") == "ALIVE":
+                if info.get("state") != "ALIVE":
+                    continue
+                if "node:__internal_head__" in (
+                        info.get("resources_total") or {}):
+                    info["state"] = "DEAD"
+                    info["death_reason"] = "head restarted"
+                else:
                     info["last_heartbeat"] = now
-        self._object_waiters.notify()
-        self._actor_waiters.notify()
-        self._pg_waiters.notify()
+        self._object_waiters.notify_all()
+        self._actor_waiters.notify_all()
+        self._pg_waiters.notify_all()
 
     def compact_journal(self) -> bool:
         """Snapshot-compact now. Holds the CP lock across dump+swap so a
@@ -207,7 +290,7 @@ class ControlPlane:
                 "owner": owner, "commit_time": time.time(),
             }
             self._j("put_inline", object_id, data, is_error, owner)
-        self._object_waiters.notify()
+        self._object_waiters.notify([object_id])
 
     def commit_shm(self, object_id: bytes, size: int,
                    node_id: bytes = b"", is_error: bool = False,
@@ -219,7 +302,7 @@ class ControlPlane:
                 "commit_time": time.time(),
             }
             self._j("commit_shm", object_id, size, node_id, is_error, owner)
-        self._object_waiters.notify()
+        self._object_waiters.notify([object_id])
 
     def get_location(self, object_id: bytes) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -234,25 +317,68 @@ class ControlPlane:
                     timeout: Optional[float]) -> Optional[Dict[str, Any]]:
         """Block until the object is committed; returns its location."""
         return self._object_waiters.wait_for(
-            lambda: self.get_location(object_id), timeout)
+            lambda: self.get_location(object_id), timeout, [object_id])
+
+    def get_locations(self, object_ids: List[bytes]
+                      ) -> Dict[bytes, Optional[Dict[str, Any]]]:
+        """Bulk location lookup (one RPC for a whole dependency set)."""
+        with self._lock:
+            return {bytes(o): (dict(self._objects[bytes(o)])
+                               if bytes(o) in self._objects else None)
+                    for o in object_ids}
+
+    def kick_waiters(self, key: bytes) -> None:
+        """Wake a ``wait_any(..., kick=key)`` blocked on stale ids.
+
+        Node managers use this to interrupt their dependency-resolver's
+        standing wait when newly submitted tasks add ids to the set."""
+        self._object_waiters.notify([("__kick__", bytes(key))])
 
     def wait_any(self, object_ids: List[bytes], num_returns: int,
-                 timeout: Optional[float]) -> List[bytes]:
-        """Return ids of committed objects once >= num_returns are ready."""
-        ids = [bytes(o) for o in object_ids]
+                 timeout: Optional[float],
+                 kick: Optional[bytes] = None) -> List[bytes]:
+        """Return ids of committed objects once >= num_returns are ready.
 
-        def ready():
+        Incremental: the id set is scanned once, then only ids whose
+        commit actually fired are checked — a 10k-ref wait does O(ids +
+        commits) work instead of O(ids x wakeups).  With ``kick``, a
+        ``kick_waiters(kick)`` call returns the currently ready subset
+        early (possibly short of ``num_returns``).
+        """
+        ids = [bytes(o) for o in object_ids]
+        kick_key = ("__kick__", bytes(kick)) if kick is not None else None
+        keys = list(ids) + ([kick_key] if kick_key else [])
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        w = self._object_waiters.register(keys)
+        try:
             with self._lock:
                 done = [o for o in ids if o in self._objects]
-            if len(done) >= num_returns:
-                return done
-            return None
-
-        result = self._object_waiters.wait_for(ready, timeout)
-        if result is None:
-            with self._lock:
-                return [o for o in ids if o in self._objects]
-        return result
+            remaining = set(ids) - set(done)
+            while len(done) < num_returns and remaining:
+                wait_t = 1.0
+                if deadline is not None:
+                    wait_t = deadline - time.monotonic()
+                    if wait_t <= 0:
+                        break
+                w.event.wait(min(wait_t, 5.0))
+                fired = w.take_fired()
+                if not fired and deadline is None:
+                    continue
+                if _WILDCARD in fired:
+                    check = list(remaining)
+                else:
+                    check = [o for o in fired if o in remaining]
+                if check:
+                    with self._lock:
+                        newly = [o for o in check if o in self._objects]
+                    done.extend(newly)
+                    remaining.difference_update(newly)
+                if kick_key is not None and kick_key in fired:
+                    break
+            return done
+        finally:
+            self._object_waiters.unregister(keys, w)
 
     def free_objects(self, object_ids: List[bytes]) -> int:
         freed = 0
@@ -377,7 +503,7 @@ class ControlPlane:
             info["actor_id"] = actor_id
             self._actors[actor_id] = info
             self._j("register_actor", actor_id, info)
-        self._actor_waiters.notify()
+        self._actor_waiters.notify([actor_id])
 
     def update_actor(self, actor_id: bytes, **updates) -> None:
         with self._lock:
@@ -389,7 +515,7 @@ class ControlPlane:
                 self._named_actors.pop(
                     (info.get("namespace", "default"), info["name"]), None)
             self._j("update_actor", actor_id, updates)
-        self._actor_waiters.notify()
+        self._actor_waiters.notify([actor_id])
         self.publish(f"actor:{actor_id.hex()}", updates)
 
     def get_actor_info(self, actor_id: bytes) -> Optional[Dict[str, Any]]:
@@ -404,7 +530,7 @@ class ControlPlane:
             if info and info.get("state") in states:
                 return info
             return None
-        return self._actor_waiters.wait_for(check, timeout)
+        return self._actor_waiters.wait_for(check, timeout, [actor_id])
 
     def resolve_named_actor(self, name: str,
                             namespace: str = "default") -> Optional[bytes]:
@@ -475,7 +601,7 @@ class ControlPlane:
             info.setdefault("state", "PENDING")
             self._placement_groups[pg_id] = info
             self._j("register_placement_group", pg_id, info)
-        self._pg_waiters.notify()
+        self._pg_waiters.notify([pg_id])
 
     def update_placement_group(self, pg_id: bytes, **updates) -> None:
         with self._lock:
@@ -484,7 +610,7 @@ class ControlPlane:
                 return
             info.update(updates)
             self._j("update_placement_group", pg_id, updates)
-        self._pg_waiters.notify()
+        self._pg_waiters.notify([pg_id])
 
     def get_placement_group(self, pg_id: bytes) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -498,7 +624,7 @@ class ControlPlane:
             if info and info.get("state") in ("CREATED", "REMOVED"):
                 return info
             return None
-        return self._pg_waiters.wait_for(check, timeout)
+        return self._pg_waiters.wait_for(check, timeout, [pg_id])
 
     def list_placement_groups(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -513,7 +639,7 @@ class ControlPlane:
             ring.append((seq, message))
             if len(ring) > 4096:
                 del ring[: len(ring) - 4096]
-        self._pub_waiters.notify()
+        self._pub_waiters.notify([channel])
         return seq
 
     def poll(self, channel: str, cursor: int,
@@ -526,7 +652,7 @@ class ControlPlane:
             if msgs:
                 return msgs
             return None
-        msgs = self._pub_waiters.wait_for(fetch, timeout)
+        msgs = self._pub_waiters.wait_for(fetch, timeout, [channel])
         if not msgs:
             return cursor, []
         new_cursor = max(s for s, _ in msgs)

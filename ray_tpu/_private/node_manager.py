@@ -90,6 +90,8 @@ class _Worker:
         self.actor_id: Optional[bytes] = None
         self.blocked = False
         self.inflight_actor_tasks: Dict[bytes, TaskSpec] = {}
+        self.task_started_at = 0.0
+        self.oom_killed: Optional[float] = None  # usage at OOM kill
 
     def send(self, msg: Any) -> bool:
         if self.sock is None:
@@ -110,6 +112,77 @@ class _ActorState:
         self.queued: deque = deque()  # actor TaskSpecs awaiting a live worker
         self.restarts_used = 0
         self.resources = dict(creation_spec.resources)
+
+
+class _PendingQueues:
+    """Ready-to-schedule tasks bucketed by scheduling shape.
+
+    The dispatch loop previously drained and re-queued one flat deque
+    each wake: with N queued tasks and bounded worker capacity that is
+    O(N) scanned per dispatched task — O(N^2) to drain a 100k backlog.
+    A task that cannot dispatch blocks only tasks of its own *shape*
+    (same resources + strategy target), so dispatch walks each shape's
+    head and stops that shape at the first failure: one wake is
+    O(shapes + dispatched).  Reference analogue: per-SchedulingClass
+    deques in ``raylet/local_task_manager.h``.
+    """
+
+    def __init__(self):
+        self._queues: Dict[Any, deque] = {}
+        self._count = 0
+
+    @staticmethod
+    def shape_key(spec: TaskSpec) -> Any:
+        strat = spec.scheduling_strategy
+        return (tuple(sorted(spec.resources.items())), strat.kind,
+                getattr(strat, "node_id", None),
+                getattr(strat, "pg_id", None))
+
+    def append(self, spec: TaskSpec) -> None:
+        key = self.shape_key(spec)
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = deque()
+        q.append(spec)
+        self._count += 1
+
+    def push_front(self, key: Any, spec: TaskSpec) -> None:
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = deque()
+        q.appendleft(spec)
+        self._count += 1
+
+    def pop_front(self, key: Any) -> Optional[TaskSpec]:
+        q = self._queues.get(key)
+        if not q:
+            if q is not None:
+                del self._queues[key]   # prune drained shapes
+            return None
+        self._count -= 1
+        spec = q.popleft()
+        if not q:
+            del self._queues[key]
+        return spec
+
+    def shapes(self) -> List[Any]:
+        return [k for k, q in self._queues.items() if q]
+
+    def remove(self, task_id: bytes) -> Optional[TaskSpec]:
+        for q in self._queues.values():
+            for i, spec in enumerate(q):
+                if spec.task_id == task_id:
+                    del q[i]
+                    self._count -= 1
+                    return spec
+        return None
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __iter__(self):
+        for q in self._queues.values():
+            yield from q
 
 
 class NodeManager:
@@ -142,9 +215,19 @@ class NodeManager:
         self._idle: deque = deque()
         self._starting = 0
         self._actors: Dict[bytes, _ActorState] = {}
-        self._pending: deque = deque()           # ready-to-schedule specs
+        self._pending = _PendingQueues()         # ready-to-schedule specs
         self._waiting: Dict[bytes, TaskSpec] = {}  # task_id -> waiting on deps
+        # dependency resolution (one resolver thread, not one per task):
+        # dep object id -> task ids blocked on it, task id -> unready deps
+        self._dep_map: Dict[bytes, set] = {}
+        self._task_unready: Dict[bytes, set] = {}
+        self._dep_kick = threading.Event()
+        self._dep_blocked = False
         self._retries_left: Dict[bytes, int] = {}
+        # CP-side effects that outlasted _ResilientCP's retry window
+        # (head outage): retried from the heartbeat loop so a caller's
+        # get() can't hang forever on a result that was never committed
+        self._deferred_cp: List[Any] = []
         self._lock = threading.RLock()
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -167,6 +250,13 @@ class NodeManager:
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="nm-dispatch", daemon=True)
         self._dispatch_thread.start()
+        self._dep_thread = threading.Thread(
+            target=self._dep_resolver_loop, name="nm-depresolve",
+            daemon=True)
+        self._dep_thread.start()
+        if GLOBAL_CONFIG.memory_monitor_refresh_ms > 0:
+            threading.Thread(target=self._memory_monitor_loop,
+                             name="nm-memmon", daemon=True).start()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="nm-heartbeat", daemon=True)
         self._hb_thread.start()
@@ -262,16 +352,20 @@ class NodeManager:
         return True
 
     def cancel_task(self, task_id: bytes) -> bool:
+        from ray_tpu.exceptions import TaskCancelledError
         with self._lock:
-            for i, spec in enumerate(self._pending):
-                if spec.task_id == task_id:
-                    del self._pending[i]
-                    from ray_tpu.exceptions import TaskCancelledError
-                    self._fail_task(spec, TaskCancelledError(task_id.hex()))
-                    return True
-            spec = self._waiting.pop(task_id, None)
+            spec = self._pending.remove(task_id)
+            if spec is None:
+                spec = self._waiting.pop(task_id, None)
+                if spec is not None:
+                    # drop its dependency bookkeeping
+                    for d in self._task_unready.pop(task_id, ()):
+                        tids = self._dep_map.get(d)
+                        if tids is not None:
+                            tids.discard(task_id)
+                            if not tids:
+                                del self._dep_map[d]
         if spec is not None:
-            from ray_tpu.exceptions import TaskCancelledError
             self._fail_task(spec, TaskCancelledError(task_id.hex()))
             return True
         return False
@@ -431,11 +525,13 @@ class NodeManager:
                             self._pending.append(spec)
                             retrying = True
                     if not retrying:
-                        for oid in spec.return_object_ids():
-                            self.cp.put_inline(oid, msg["error_payload"],
-                                               is_error=True)
-                        self._fail_generator_stream(spec,
-                                                    msg["error_payload"])
+                        def commit_error(spec=spec,
+                                         payload=msg["error_payload"]):
+                            for oid in spec.return_object_ids():
+                                self.cp.put_inline(oid, payload,
+                                                   is_error=True)
+                            self._fail_generator_stream(spec, payload)
+                        self._cp_effect_or_defer(commit_error)
                 with self._lock:
                     if not retrying:
                         self._retries_left.pop(spec.task_id, None)
@@ -458,10 +554,11 @@ class NodeManager:
                     worker.actor_id = msg["actor_id"]
                     worker.state = "actor"
                     self._flush_actor_queue_locked(astate)
-            self.cp.update_actor(msg["actor_id"], state="ALIVE",
-                                 node_id=self.node_id,
-                                 nm_sock=self.sock_path,
-                                 pid=msg.get("pid"))
+            def publish_alive(actor_id=msg["actor_id"], pid=msg.get("pid")):
+                self.cp.update_actor(actor_id, state="ALIVE",
+                                     node_id=self.node_id,
+                                     nm_sock=self.sock_path, pid=pid)
+            self._cp_effect_or_defer(publish_alive)
             self._wake.set()
         elif kind == "actor_init_failed":
             with self._lock:
@@ -518,40 +615,92 @@ class NodeManager:
 
     def _dispatch_once(self):
         with self._lock:
-            queue = list(self._pending)
-            self._pending.clear()
-        requeue: List[TaskSpec] = []
-        for spec in queue:
-            if self._stopped.is_set():
-                return
-            deps = spec.dependencies()
-            unready = [d for d in deps if self.cp.get_location(d) is None]
-            if unready:
-                self._wait_for_deps(spec, unready)
-                continue
-            if not self._try_dispatch(spec):
-                requeue.append(spec)
-        if requeue:
-            with self._lock:
-                # preserve order ahead of newly arrived tasks
-                self._pending.extendleft(reversed(requeue))
+            shape_keys = self._pending.shapes()
+        for key in shape_keys:
+            while not self._stopped.is_set():
+                with self._lock:
+                    spec = self._pending.pop_front(key)
+                if spec is None:
+                    break
+                deps = spec.dependencies()
+                if deps:
+                    locs = self.cp.get_locations(deps)
+                    unready = [d for d in deps if locs.get(d) is None]
+                    if unready:
+                        self._register_dep_wait(spec, unready)
+                        continue
+                if not self._try_dispatch(spec):
+                    with self._lock:
+                        # head-of-shape blocks only its own shape
+                        self._pending.push_front(key, spec)
+                    break
 
-    def _wait_for_deps(self, spec: TaskSpec, deps: List[bytes]):
+    def _register_dep_wait(self, spec: TaskSpec, deps: List[bytes]):
         with self._lock:
             self._waiting[spec.task_id] = spec
+            pend = self._task_unready.setdefault(spec.task_id, set())
+            for d in deps:
+                pend.add(d)
+                self._dep_map.setdefault(d, set()).add(spec.task_id)
+            blocked = self._dep_blocked
+        self._dep_kick.set()
+        if blocked:
+            # interrupt the resolver's standing server-side wait so the
+            # new ids join the waited set
+            try:
+                self.cp.kick_waiters(self.node_id)
+            except Exception:  # noqa: BLE001
+                pass
 
-        def waiter():
-            remaining = list(deps)
-            while remaining and not self._stopped.is_set():
-                ready = self.cp.wait_any(remaining, len(remaining), 5.0)
-                remaining = [d for d in remaining if d not in set(ready)]
+    def _dep_resolver_loop(self):
+        """One thread resolves all tasks' dependencies.
+
+        Replaces the thread-per-waiting-task design (10k queued tasks
+        meant 10k ``nm-depwait`` threads): a single standing
+        ``cp.wait_any`` over the union of unready deps, interrupted via
+        ``kick_waiters`` when registration adds new ids.  Reference
+        analogue: ``raylet/dependency_manager.cc``.
+        """
+        while not self._stopped.is_set():
             with self._lock:
-                if self._waiting.pop(spec.task_id, None) is not None:
-                    self._pending.append(spec)
-            self._wake.set()
+                deps = list(self._dep_map)
+            if not deps:
+                self._dep_kick.wait(timeout=1.0)
+                self._dep_kick.clear()
+                continue
+            with self._lock:
+                self._dep_blocked = True
+            try:
+                ready = self.cp.wait_any(deps, 1, 30.0, kick=self.node_id)
+            except Exception:  # noqa: BLE001
+                if self._stopped.is_set():
+                    return
+                time.sleep(0.5)
+                continue
+            finally:
+                with self._lock:
+                    self._dep_blocked = False
+            self._dep_kick.clear()
+            if ready:
+                self._resolve_deps(ready)
 
-        threading.Thread(target=waiter, daemon=True,
-                         name="nm-depwait").start()
+    def _resolve_deps(self, ready: List[bytes]):
+        moved = False
+        with self._lock:
+            for d in ready:
+                for tid in self._dep_map.pop(d, ()):
+                    pend = self._task_unready.get(tid)
+                    if pend is None:
+                        continue
+                    pend.discard(d)
+                    if not pend:
+                        del self._task_unready[tid]
+                        spec = self._waiting.pop(tid, None)
+                        if spec is not None:
+                            self._pending.append(spec)
+                            moved = True
+        if moved:
+            self._wake.set()
 
     def _pick_node(self, spec: TaskSpec) -> Optional[Dict[str, Any]]:
         """Choose a target node; None => run locally.
@@ -669,6 +818,7 @@ class NodeManager:
             return False
         with self._lock:
             worker.current_task = spec
+            worker.task_started_at = time.time()
             worker.state = "busy" if not spec.actor_creation else "actor"
         ok = worker.send({"type": "task", "spec": spec, "chips": chips})
         if not ok:
@@ -814,7 +964,13 @@ class NodeManager:
         if spec is not None:
             self._release_task_resources(spec, worker)
             if actor_id is None and not spec.actor_creation:
-                self._maybe_retry(spec)
+                reason = ""
+                if worker.oom_killed is not None:
+                    reason = ("killed by the memory monitor: node "
+                              f"memory usage {worker.oom_killed:.0%} "
+                              "exceeded "
+                              f"{GLOBAL_CONFIG.memory_usage_threshold:.0%}")
+                self._maybe_retry(spec, reason)
         if actor_id is not None or (spec is not None and spec.actor_creation):
             aid = actor_id or spec.actor_id
             with self._lock:
@@ -824,7 +980,7 @@ class NodeManager:
                                             worker=worker)
         self._wake.set()
 
-    def _maybe_retry(self, spec: TaskSpec):
+    def _maybe_retry(self, spec: TaskSpec, reason: str = ""):
         with self._lock:
             left = self._retries_left.get(spec.task_id, 0)
             if left > 0:
@@ -840,7 +996,8 @@ class NodeManager:
             self._wake.set()
         else:
             self._fail_task(spec, WorkerCrashedError(
-                f"worker died while running task {spec.name}"))
+                f"worker died while running task {spec.name}"
+                + (f" ({reason})" if reason else "")))
 
     def _on_actor_worker_death(self, astate: _ActorState, reason: str,
                                from_msg: bool = False,
@@ -942,6 +1099,119 @@ class NodeManager:
             self._peers[nid] = client
         return client
 
+    # ------------------------------------------------------------------
+    # Memory monitor + OOM worker-killing policy (reference:
+    # common/memory_monitor.h node sampling thread +
+    # raylet/worker_killing_policy.cc "newest retriable task first")
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _worker_rss(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _memory_usage(self) -> float:
+        limit = GLOBAL_CONFIG.memory_monitor_limit_bytes
+        if limit > 0:
+            with self._lock:
+                pids = [w.proc.pid for w in self._workers.values()
+                        if w.proc is not None and w.state != "dead"]
+            return sum(self._worker_rss(p) for p in pids) / limit
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+            if total and avail is not None:
+                return 1.0 - avail / total
+        except OSError:
+            pass
+        return 0.0
+
+    def _pick_oom_victim(self) -> Optional[_Worker]:
+        """Newest retriable task first; actors are never chosen (their
+        in-flight calls are not idempotent by default)."""
+        with self._lock:
+            cands = [w for w in self._workers.values()
+                     if w.state == "busy" and w.current_task is not None
+                     and w.proc is not None
+                     and not w.current_task.actor_creation]
+            if not cands:
+                return None
+
+            def key(w):
+                retriable = self._retries_left.get(
+                    w.current_task.task_id, 0) > 0
+                return (retriable, getattr(w, "task_started_at", 0.0))
+
+            return max(cands, key=key)
+
+    def _memory_monitor_loop(self):
+        period = GLOBAL_CONFIG.memory_monitor_refresh_ms / 1000.0
+        threshold = GLOBAL_CONFIG.memory_usage_threshold
+        while not self._stopped.wait(period):
+            try:
+                usage = self._memory_usage()
+                if usage < threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                spec = victim.current_task
+                logger.warning(
+                    "memory usage %.0f%% over threshold %.0f%%: OOM "
+                    "policy killing worker %s (task %s)", usage * 100,
+                    threshold * 100, victim.worker_id.hex()[:12],
+                    spec.name if spec else "?")
+                victim.oom_killed = usage
+                if spec is not None:
+                    self._cp_effect_or_defer(
+                        lambda s=spec: self.cp.add_task_event(
+                            {"task_id": s.task_id.hex(),
+                             "state": "OOM_KILL",
+                             "node": self.node_id.hex()}))
+                victim.proc.kill()
+                # let the worker-reader thread run the death handling
+                # before re-sampling (the RSS drop takes a beat)
+                time.sleep(period)
+            except Exception:  # noqa: BLE001 — keep the monitor alive
+                traceback.print_exc()
+
+    def _cp_effect_or_defer(self, fn) -> None:
+        """Run a control-plane side effect now; on an outage longer than
+        _ResilientCP's window, queue it for heartbeat-loop retry instead
+        of dropping it (a dropped result commit hangs the caller's get)."""
+        try:
+            fn()
+        except self._CONN_ERRORS:
+            logger.warning("control plane unreachable; deferring %s",
+                           getattr(fn, "__name__", "cp effect"))
+            with self._lock:
+                self._deferred_cp.append(fn)
+
+    def _drain_deferred_cp(self) -> None:
+        with self._lock:
+            if not self._deferred_cp:
+                return
+            pending, self._deferred_cp = self._deferred_cp, []
+        survivors = []
+        for fn in pending:
+            try:
+                fn()
+            except self._CONN_ERRORS:
+                survivors.append(fn)
+            except Exception:  # noqa: BLE001 — effect itself is broken
+                logger.exception("deferred control-plane effect failed")
+        if survivors:
+            with self._lock:
+                self._deferred_cp = survivors + self._deferred_cp
+
     def _heartbeat_loop(self):
         period = GLOBAL_CONFIG.health_check_period_s
         while not self._stopped.wait(period):
@@ -954,6 +1224,7 @@ class NodeManager:
                 self.cp.heartbeat_node(self.node_id, avail, load)
             except Exception:  # noqa: BLE001
                 pass
+            self._drain_deferred_cp()
 
     def stop(self):
         if self._stopped.is_set():

@@ -40,6 +40,14 @@ class Journal:
         self.sync = sync
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # A crash mid-write leaves a torn tail record.  Replay stops at
+        # it — so if we blindly append after it, everything appended now
+        # sits *behind* the tear and silently vanishes from every future
+        # replay.  Truncate to the last valid record boundary first.
+        valid = self.scan_valid(path)
+        if valid is not None and valid < os.path.getsize(path):
+            with open(path, "r+b") as f:
+                f.truncate(valid)
         self._f = open(path, "ab")
         self._records_since_snapshot = 0
 
@@ -51,6 +59,27 @@ class Journal:
             if self.sync:
                 os.fsync(self._f.fileno())
             self._records_since_snapshot += 1
+
+    @staticmethod
+    def scan_valid(path: str) -> "int | None":
+        """Byte offset of the end of the last well-formed record."""
+        if not os.path.exists(path):
+            return None
+        valid = 0
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(_LEN.size)
+                if len(head) < _LEN.size:
+                    return valid
+                (length,) = _LEN.unpack(head)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return valid
+                try:
+                    pickle.loads(payload)
+                except Exception:  # noqa: BLE001 — corrupt record ends log
+                    return valid
+                valid += _LEN.size + length
 
     @staticmethod
     def replay(path: str) -> Iterator[Tuple[str, Tuple[Any, ...]]]:

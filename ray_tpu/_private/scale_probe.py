@@ -1,0 +1,206 @@
+"""Scalability-envelope probes.
+
+Parity target: the reference's published envelope
+(``release/benchmarks/README.md``: 1M+ queued tasks on one node, 10k+
+concurrent tasks, 40k actors across 2k nodes, 1 GiB broadcast, 10k-ref
+``wait``) scaled to one host.  Each probe prints one line and the driver
+records the dict; run via ``python -m ray_tpu._private.scale_probe``
+(writes ``SCALE_r*.json`` at the repo root when invoked by the round
+driver or by hand).
+
+These are *probes*, not unit tests: they exist to find the knee of the
+curve.  Budget guards keep a regression from hanging the round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+def probe_queue_tasks(n: int = 100_000) -> Dict[str, Any]:
+    """Queue ``n`` no-op tasks on one node, then drain them all."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    submit_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    # drain in windows so the driver-side wait set stays bounded
+    done = 0
+    while refs:
+        chunk, refs = refs[:10_000], refs[10_000:]
+        ray_tpu.get(chunk)
+        done += len(chunk)
+    drain_s = time.perf_counter() - t1
+    return {
+        "n": n,
+        "submit_per_s": round(n / submit_s, 1),
+        "drain_per_s": round(n / drain_s, 1),
+        "submit_s": round(submit_s, 2),
+        "drain_s": round(drain_s, 2),
+    }
+
+
+def probe_wait_many_refs(n: int = 10_000) -> Dict[str, Any]:
+    """10k-object ``put`` burst + one ``wait`` over all of them."""
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(i) for i in range(n)]
+    put_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(refs, num_returns=n, timeout=60)
+    wait_s = time.perf_counter() - t1
+    assert len(ready) == n, (len(ready), len(not_ready))
+    return {
+        "n": n,
+        "puts_per_s": round(n / put_s, 1),
+        "wait_all_s": round(wait_s, 3),
+    }
+
+
+def probe_actors(n: int = 256, calls_per_actor: int = 4) -> Dict[str, Any]:
+    """Create ``n`` actors across simulated nodes, call each, kill all."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_node
+
+    # spread actors over extra in-process nodes so one worker pool's cap
+    # isn't the artificial limit
+    extra_nodes = max(1, n // 64)
+    for _ in range(extra_nodes):
+        global_node().add_node(num_cpus=64)
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self, x):
+            return x + 1
+
+    t0 = time.perf_counter()
+    actors = [A.options(scheduling_strategy="SPREAD").remote()
+              for _ in range(n)]
+    # first call forces creation to complete
+    ray_tpu.get([a.ping.remote(0) for a in actors])
+    create_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    refs = [a.ping.remote(i) for _ in range(calls_per_actor)
+            for i, a in enumerate(actors)]
+    ray_tpu.get(refs)
+    call_s = time.perf_counter() - t1
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "n_actors": n,
+        "create_total_s": round(create_s, 2),
+        "create_per_s": round(n / create_s, 1),
+        "calls_per_s": round(n * calls_per_actor / call_s, 1),
+    }
+
+
+def probe_broadcast(size_mb: int = 1024, n_nodes: int = 8) -> Dict[str, Any]:
+    """1 GiB object fetched by a task on each of ``n_nodes`` sim nodes."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_node
+
+    node_ids = [global_node().add_node(num_cpus=1)
+                for _ in range(n_nodes)]
+    # add_node returns as soon as the process is spawned; wait for the
+    # node managers to register before hard-affinity dispatch
+    from ray_tpu._private.worker import global_worker
+    cp = global_worker().cp
+    deadline = time.perf_counter() + 120
+    for nid in node_ids:
+        while time.perf_counter() < deadline:
+            info = cp.get_node(nid)
+            if info is not None and info.get("state") == "ALIVE":
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("sim node failed to register")
+    big = np.random.default_rng(0).integers(
+        0, 255, size_mb * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote(num_cpus=1)
+    def touch(arr):
+        if isinstance(arr, int):
+            return arr
+        return int(arr[0]) + int(arr[-1])
+
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    # warm a worker on every node so spawn time stays out of the
+    # transfer measurement
+    ray_tpu.get([touch.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            nid.hex())).remote(0) for nid in node_ids], timeout=300)
+
+    t0 = time.perf_counter()
+    outs = []
+    for nid in node_ids:
+        outs.append(touch.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                nid.hex())).remote(ref))
+    ray_tpu.get(outs, timeout=600)
+    dt = time.perf_counter() - t0
+    return {
+        "size_mb": size_mb,
+        "n_nodes": n_nodes,
+        "total_s": round(dt, 2),
+        "aggregate_mb_per_s": round(size_mb * n_nodes / dt, 1),
+    }
+
+
+def main() -> Dict[str, Any]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu._private import ray_perf
+
+    ray_tpu.init(num_cpus=16)
+    results: Dict[str, Any] = {"host_cpus": os.cpu_count()}
+    t_all = time.perf_counter()
+    # actors last: on a 1-core host the 100+-process actor storm starves
+    # other node heartbeats, and the death watcher (correctly) reaps them
+    for name, fn in (
+        ("wait_10k_refs", probe_wait_many_refs),
+        ("broadcast_1gib_8_nodes", probe_broadcast),
+        ("queue_100k_noop_tasks", probe_queue_tasks),
+        ("actors_128", lambda: probe_actors(128)),
+    ):
+        t0 = time.perf_counter()
+        try:
+            results[name] = fn()
+            results[name]["probe_s"] = round(time.perf_counter() - t0, 2)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[scale_probe] {name}: {json.dumps(results[name])}",
+              flush=True)
+    try:
+        perf = ray_perf.main(duration=1.0)
+        results["ray_perf"] = {r["name"]: round(r["rate"], 2)
+                               for r in perf}
+    except Exception as e:  # noqa: BLE001
+        results["ray_perf"] = {"error": str(e)}
+    results["total_s"] = round(time.perf_counter() - t_all, 1)
+    ray_tpu.shutdown()
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    out = main()
+    path = sys.argv[1] if len(sys.argv) > 1 else "SCALE_r04.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)

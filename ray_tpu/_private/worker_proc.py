@@ -257,7 +257,10 @@ class WorkerProcess:
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
-            self._commit_results(spec, result)
+            if spec.is_generator and inspect.isasyncgen(result):
+                await self._commit_async_generator(spec, result)
+            else:
+                self._commit_results(spec, result)
             error = False
         except BaseException as e:  # noqa: BLE001
             self._commit_error(spec, e)
@@ -265,6 +268,26 @@ class WorkerProcess:
         self._send({"type": "done", "task_id": spec.task_id, "error": error})
         if spec.actor_method == "__ray_terminate__":
             os._exit(0)
+
+    async def _commit_async_generator(self, spec: TaskSpec, result):
+        """Streaming commit of an async generator (async-actor methods
+        yielding items, e.g. Serve streaming responses): each yielded
+        item becomes a generator slot as it is produced."""
+        count = 0
+        try:
+            async for item in result:
+                self.core.commit_generator_item(spec.task_id, count, item)
+                count += 1
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, format_remote_traceback(e),
+                            spec.task_id.hex())
+            self.core.commit_generator_item(spec.task_id, count, err,
+                                            is_error=True)
+            count += 1
+            self.core.commit_generator_done(spec.task_id, count)
+            raise
+        self.core.commit_generator_done(spec.task_id, count)
+        self.core.put_object(spec.return_object_ids()[0], count)
 
     def _lookup_method(self, spec: TaskSpec):
         instance = self.core.current_actor

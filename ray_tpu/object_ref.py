@@ -139,10 +139,19 @@ class ObjectRefGenerator:
     async def __anext__(self):
         import asyncio
         loop = asyncio.get_running_loop()
-        try:
-            return await loop.run_in_executor(None, self.__next__)
-        except StopIteration:
-            raise StopAsyncIteration from None
+
+        # StopIteration cannot cross an asyncio Future (it turns into a
+        # RuntimeError); carry end-of-stream as a flag instead.
+        def step():
+            try:
+                return (True, self.__next__())
+            except StopIteration:
+                return (False, None)
+
+        ok, ref = await loop.run_in_executor(None, step)
+        if not ok:
+            raise StopAsyncIteration
+        return ref
 
 
 StreamingObjectRefGenerator = ObjectRefGenerator

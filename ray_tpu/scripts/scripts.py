@@ -319,7 +319,12 @@ def cmd_jobs(args):
         # (and the job's supervisor) down with it
         import ray_tpu
         from ray_tpu.job import JobSubmissionClient
-        ray_tpu.init(ignore_reinit_error=True)
+        try:
+            # a standing `ray-tpu start --head` session: attach so the
+            # job runs on it and lands in the session's job table
+            ray_tpu.init(address="auto", ignore_reinit_error=True)
+        except Exception:  # noqa: BLE001 — no live session
+            ray_tpu.init(ignore_reinit_error=True)
         c = JobSubmissionClient()
         jid = c.submit_job(entrypoint=args.entrypoint)
         print(jid)

@@ -112,7 +112,8 @@ def _collect_deployments(app: Application, app_name: str,
 
 def run(app: Application, *, name: str = "default",
         route_prefix: str = "/", blocking: bool = False,
-        http_port: Optional[int] = None) -> DeploymentHandle:
+        http_port: Optional[int] = None,
+        grpc_port: Optional[int] = None) -> DeploymentHandle:
     controller = _get_or_create_controller()
     deployments: List[Dict[str, Any]] = []
     ingress = _collect_deployments(app, name, deployments)
@@ -120,6 +121,8 @@ def run(app: Application, *, name: str = "default",
         name, deployments, ingress), timeout=300)
     if http_port is not None:
         start_http_proxy(http_port)
+    if grpc_port is not None:
+        start_grpc_proxy(grpc_port)
     return DeploymentHandle(name)
 
 
@@ -154,19 +157,39 @@ def shutdown() -> None:
     proxy = ray_tpu.get(controller.get_proxy.remote(), timeout=10)
     if proxy is not None:
         ray_tpu.kill(proxy)
+    grpc_proxy = ray_tpu.get(controller.get_grpc_proxy.remote(),
+                             timeout=10)
+    if grpc_proxy is not None:
+        ray_tpu.kill(grpc_proxy)
     ray_tpu.kill(ray_tpu.get_actor(CONTROLLER_NAME))
 
 
-# ------------------------------------------------------------------ http
-def start_http_proxy(port: int = 8000):
+# ------------------------------------------------------------- ingress
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1"):
     from ray_tpu.serve._private.proxy import HTTPProxy
     controller = _get_or_create_controller()
     existing = ray_tpu.get(controller.get_proxy.remote(), timeout=10)
     if existing is not None:
         return existing
-    proxy = HTTPProxy.options(max_concurrency=64).remote(port)
+    proxy = HTTPProxy.options(max_concurrency=64).remote(port, host)
     ray_tpu.get(proxy.ready.remote(), timeout=60)
     ray_tpu.get(controller.set_proxy.remote(proxy), timeout=10)
+    return proxy
+
+
+def start_grpc_proxy(port: int = 9000, host: str = "127.0.0.1"):
+    """gRPC ingress on ``/ray_tpu.serve.GenericService/Predict`` (unary)
+    and ``PredictStreaming`` (server-streaming); app picked by the
+    ``application`` metadata key."""
+    from ray_tpu.serve._private.proxy import GRPCProxy
+    controller = _get_or_create_controller()
+    existing = ray_tpu.get(controller.get_grpc_proxy.remote(), timeout=10)
+    if existing is not None:
+        return existing
+    proxy = GRPCProxy.options(max_concurrency=64).remote(port, host)
+    bound = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    ray_tpu.get(controller.set_grpc_proxy.remote(proxy, bound),
+                timeout=10)
     return proxy
 
 

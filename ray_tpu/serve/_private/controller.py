@@ -114,14 +114,12 @@ class ServeController:
             if d.get("autoscaling_config") is not None:
                 autoscale = dict(AUTOSCALE_DEFAULTS)
                 autoscale.update(d["autoscaling_config"])
-                # scale-to-zero needs handle-side queue metrics the
-                # replicas can't provide once dead; clamp to 1 (deviation
-                # from the reference, which meters at the handle)
-                autoscale["min_replicas"] = max(
-                    1, autoscale["min_replicas"])
+                # min_replicas=0 is supported: a handle that finds the
+                # routing table empty calls request_upscale and waits
+                # for the push carrying the first replica
                 autoscale.setdefault(
                     "max_replicas",
-                    max(d["num_replicas"], autoscale["min_replicas"]))
+                    max(d["num_replicas"], autoscale["min_replicas"], 1))
             target_n = (autoscale["min_replicas"] if autoscale
                         else d["num_replicas"])
             entry = app.get(d["name"])
@@ -201,6 +199,28 @@ class ServeController:
                 return
             except Exception:  # noqa: BLE001 — keep the loop alive
                 pass
+
+    async def request_upscale(self, app_name: str, name: str) -> bool:
+        """Scale-from-zero wakeup: a handle found no replicas to route
+        to.  Spawn one immediately (the autoscaler grows it further if
+        load sustains) and push the new routing table."""
+        entry = self.apps.get(app_name, {}).get(name)
+        if entry is None:
+            return False
+        if entry["replicas"]:
+            return True
+        d = dict(entry["config"])
+        d["cls_blob"] = entry["blob"]
+        replica = self._spawn_replica(app_name, d)
+        entry["replicas"].append(replica)
+        entry["version"] += 1
+        entry["desired_since"] = None
+        self._publish(app_name, name, entry["version"])
+        try:
+            await replica.ping.remote()
+        except Exception:  # noqa: BLE001 — handle retries routing anyway
+            pass
+        return True
 
     async def _autoscale_one(self, app_name: str, name: str,
                              entry: Dict[str, Any], cfg: Dict[str, Any]):
@@ -338,6 +358,16 @@ class ServeController:
 
     def get_proxy(self):
         return self.proxy
+
+    def set_grpc_proxy(self, proxy, port: Optional[int] = None):
+        self.grpc_proxy = proxy
+        self.grpc_port = port
+
+    def get_grpc_proxy(self):
+        return getattr(self, "grpc_proxy", None)
+
+    def get_grpc_port(self):
+        return getattr(self, "grpc_port", None)
 
     def ping(self):
         return "pong"

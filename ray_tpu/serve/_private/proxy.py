@@ -1,8 +1,20 @@
-"""HTTP proxy actor (parity: reference ``serve/_private/proxy.py``).
+"""Ingress proxies (parity: reference ``serve/_private/proxy.py``).
 
-aiohttp server inside an async actor: routes ``/<app>`` (and ``/`` to the
-default app) to the app's ingress deployment handle; JSON bodies become
-the callable's argument, JSON-able returns become the response.
+Dual protocol, like the reference's GenericProxy split into HTTPProxy
+(``proxy.py:747``) and gRPCProxy (``proxy.py:533``):
+
+- :class:`HTTPProxy` — aiohttp server in an async actor.  Requests ride
+  the deployment handle asynchronously (``await ref``), one coroutine
+  per request — no thread-per-request.  A request with
+  ``?stream=1`` (or header ``X-Serve-Streaming: 1``) hits the
+  deployment's streaming path and the response body is chunked: one
+  JSON line per yielded item (SSE-flavored ``data:`` framing when the
+  client asks for ``text/event-stream``).
+- :class:`GRPCProxy` — grpc.aio server exposing a generic byte service
+  (``/ray_tpu.serve.GenericService/Predict`` unary and
+  ``/.../PredictStreaming`` server-streaming).  The application is
+  selected by the ``application`` metadata key (reference uses the same
+  key); payloads are JSON if they parse, raw bytes otherwise.
 """
 
 from __future__ import annotations
@@ -13,13 +25,37 @@ from typing import Any
 
 import ray_tpu
 
+GRPC_SERVICE = "ray_tpu.serve.GenericService"
+
+
+def _decode_body(raw: bytes) -> Any:
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        try:
+            return raw.decode()
+        except UnicodeDecodeError:
+            return raw
+
+
+def _encode_item(item: Any) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    try:
+        return json.dumps(item).encode()
+    except (TypeError, ValueError):
+        return str(item).encode()
+
 
 @ray_tpu.remote
 class HTTPProxy:
-    def __init__(self, port: int = 8000):
+    def __init__(self, port: int = 8000, host: str = "127.0.0.1"):
         # NOTE: __init__ runs before the actor's event loop starts; the
         # server is brought up lazily from the first ready() call.
         self.port = port
+        self.host = host
         self._runner = None
         self._ready = False
         self._starting = False
@@ -31,19 +67,23 @@ class HTTPProxy:
             from ray_tpu.serve.handle import DeploymentHandle
             path = request.path.strip("/")
             app_name = path.split("/")[0] if path else "default"
+            stream = (request.query.get("stream") == "1"
+                      or request.headers.get("X-Serve-Streaming") == "1")
             try:
                 body: Any = None
                 if request.can_read_body:
-                    raw = await request.read()
-                    if raw:
-                        try:
-                            body = json.loads(raw)
-                        except json.JSONDecodeError:
-                            body = raw.decode()
+                    body = _decode_body(await request.read())
                 handle = DeploymentHandle(app_name)
+                if stream:
+                    return await self._stream_response(
+                        request, handle, body)
+                # dispatch (routing fetch + pow-2 probes) does blocking
+                # RPCs -> executor; the result wait itself is async, so
+                # no thread is held while the model computes
                 loop = asyncio.get_running_loop()
-                response = await loop.run_in_executor(
-                    None, lambda: handle.remote(body).result(60.0))
+                resp_obj = await loop.run_in_executor(
+                    None, lambda: handle.remote(body))
+                response = await resp_obj.ref
                 if isinstance(response, (dict, list, int, float, bool)) \
                         or response is None:
                     return web.json_response(response)
@@ -56,9 +96,29 @@ class HTTPProxy:
         app.router.add_route("*", "/{tail:.*}", handle)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
         self._ready = True
+
+    async def _stream_response(self, request, handle, body):
+        from aiohttp import web
+        sse = "text/event-stream" in request.headers.get("Accept", "")
+        resp = web.StreamResponse(
+            headers={"Content-Type": ("text/event-stream" if sse
+                                      else "application/x-ndjson")})
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        gen = await loop.run_in_executor(
+            None, lambda: handle.options(stream=True).remote(body))
+        async for ref in gen.ref_generator:
+            item = await ref
+            payload = _encode_item(item)
+            if sse:
+                await resp.write(b"data: " + payload + b"\n\n")
+            else:
+                await resp.write(payload + b"\n")
+        await resp.write_eof()
+        return resp
 
     async def ready(self):
         if not self._starting:
@@ -69,3 +129,76 @@ class HTTPProxy:
                 return self.port
             await asyncio.sleep(0.05)
         raise RuntimeError("proxy failed to start")
+
+
+@ray_tpu.remote
+class GRPCProxy:
+    """gRPC ingress (parity: reference gRPCProxy, ``proxy.py:533``)."""
+
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._server = None
+        self._ready = False
+        self._starting = False
+
+    async def _start(self):
+        import grpc
+
+        def app_from(context) -> str:
+            for key, value in (context.invocation_metadata() or ()):
+                if key == "application":
+                    return value
+            return "default"
+
+        async def predict(request: bytes, context):
+            from ray_tpu.serve.handle import DeploymentHandle
+            handle = DeploymentHandle(app_from(context))
+            try:
+                loop = asyncio.get_running_loop()
+                resp_obj = await loop.run_in_executor(
+                    None, lambda: handle.remote(_decode_body(request)))
+                result = await resp_obj.ref
+            except Exception as e:  # noqa: BLE001
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+                return
+            return _encode_item(result)
+
+        async def predict_streaming(request: bytes, context):
+            from ray_tpu.serve.handle import DeploymentHandle
+            handle = DeploymentHandle(app_from(context))
+            try:
+                loop = asyncio.get_running_loop()
+                gen = await loop.run_in_executor(
+                    None, lambda: handle.options(stream=True).remote(
+                        _decode_body(request)))
+                async for ref in gen.ref_generator:
+                    yield _encode_item(await ref)
+            except Exception as e:  # noqa: BLE001
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        ident = lambda b: b  # noqa: E731 — raw-bytes (de)serializer
+        handlers = grpc.method_handlers_generic_handler(GRPC_SERVICE, {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict, request_deserializer=ident,
+                response_serializer=ident),
+            "PredictStreaming": grpc.unary_stream_rpc_method_handler(
+                predict_streaming, request_deserializer=ident,
+                response_serializer=ident),
+        })
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((handlers,))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+        self._ready = True
+
+    async def ready(self):
+        if not self._starting:
+            self._starting = True
+            asyncio.ensure_future(self._start())
+        for _ in range(200):
+            if self._ready:
+                return self.port
+            await asyncio.sleep(0.05)
+        raise RuntimeError("grpc proxy failed to start")

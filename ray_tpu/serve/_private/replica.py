@@ -68,6 +68,38 @@ class ServeReplica:
             mux_context.reset(token)
             self._ongoing -= 1
 
+    async def handle_request_streaming(self, method_name: str, args,
+                                       kwargs, mux_model_id: str = ""):
+        """Streaming variant: the user callable's (a)sync generator is
+        re-yielded item by item; called with ``num_returns="streaming"``
+        each item becomes an object-ref slot as produced (parity:
+        reference replica.py streaming via ObjectRefGenerator)."""
+        from ray_tpu.serve._private import mux_context
+        self._ongoing += 1
+        token = mux_context.set_model_id(mux_model_id)
+        try:
+            if callable(self.instance) and method_name == "__call__":
+                fn = self.instance
+            else:
+                fn = getattr(self.instance, method_name)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result) or (
+                    hasattr(result, "__iter__")
+                    and not isinstance(result,
+                                       (list, tuple, dict, str, bytes))):
+                for item in result:
+                    yield item
+            else:
+                yield result
+        finally:
+            mux_context.reset(token)
+            self._ongoing -= 1
+
     async def reconfigure(self, user_config):
         if hasattr(self.instance, "reconfigure"):
             out = self.instance.reconfigure(user_config)

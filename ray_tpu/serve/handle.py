@@ -33,13 +33,32 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate resolved items as the replica yields
+    them (parity: serve.handle.DeploymentResponseGenerator over
+    ObjectRefGenerator)."""
+
+    def __init__(self, ref_gen):
+        self._ref_gen = ref_gen
+
+    def __iter__(self):
+        for ref in self._ref_gen:
+            yield ray_tpu.get(ref)
+
+    @property
+    def ref_generator(self):
+        return self._ref_gen
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: Optional[str] = None,
                  method_name: str = "__call__",
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 stream: bool = False):
         self._app = app_name
         self._deployment = deployment_name
         self._method = method_name
+        self._stream = stream
         self._routing: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
@@ -49,6 +68,9 @@ class DeploymentHandle:
         # routing in the pow-2 scheduler)
         self._mux_id: Optional[str] = multiplexed_model_id
         self._mux_affinity: Dict[str, Any] = {}
+        # liveness probes of pinned replicas are TTL-cached: probing on
+        # every dispatch added an RPC round trip per request
+        self._mux_probe_ok: Dict[Any, float] = {}
 
     def _start_poller(self, deployment: str) -> None:
         """Long-poll the control-plane pubsub for routing pushes
@@ -98,22 +120,26 @@ class DeploymentHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         sub = DeploymentHandle(self._app, self._deployment, name,
-                               self._mux_id)
+                               self._mux_id, stream=self._stream)
         sub._mux_affinity = self._mux_affinity
+        sub._mux_probe_ok = self._mux_probe_ok
         sub._get_routing = self._get_routing
         self.__dict__[name] = sub
         return sub
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         sub = DeploymentHandle(
             self._app, self._deployment, method_name or self._method,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._mux_id)
+            else self._mux_id,
+            stream=self._stream if stream is None else stream)
         # per-request sub-handles delegate routing state to the parent:
         # they must not each pay a controller RPC + long-poll thread
+        # (or lose the probe TTL cache that skips per-dispatch probes)
         sub._mux_affinity = self._mux_affinity
+        sub._mux_probe_ok = self._mux_probe_ok
         sub._get_routing = self._get_routing
         return sub
 
@@ -137,8 +163,35 @@ class DeploymentHandle:
         self._start_poller(routing["deployment"])
         return routing
 
+    def _wait_for_replicas(self, timeout_s: float = 30.0):
+        """Scale-from-zero: ask the controller for capacity, then wait
+        for the routing push to carry a live replica (reference:
+        handle-side autoscaling metrics let min_replicas=0 deployments
+        wake on first request)."""
+        import time as _time
+        routing = self._get_routing()
+        deadline = _time.monotonic() + timeout_s
+        kicked = False
+        while not routing["replicas"]:
+            if not kicked:
+                try:
+                    ray_tpu.get(self._controller().request_upscale.remote(
+                        self._app, routing["deployment"]), timeout=30)
+                except Exception:  # noqa: BLE001 — retried below
+                    pass
+                kicked = True
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"deployment {routing['deployment']!r} has no "
+                    f"replicas after {timeout_s}s")
+            _time.sleep(0.1)
+            routing = self._get_routing(refresh=True)
+        return routing
+
     def _pick_replica(self):
         routing = self._get_routing()
+        if not routing["replicas"]:
+            routing = self._wait_for_replicas()
         replicas = routing["replicas"]
         if len(replicas) == 1:
             return replicas[0]
@@ -148,36 +201,59 @@ class DeploymentHandle:
             qa, qb = ray_tpu.get([a.num_ongoing.remote(),
                                   b.num_ongoing.remote()], timeout=5)
         except Exception:  # noqa: BLE001 - refresh and fall back
-            self._get_routing(refresh=True)
-            return random.choice(self._get_routing()["replicas"])
+            routing = self._get_routing(refresh=True)
+            if not routing["replicas"]:
+                # scaled to zero while we probed: wake it back up
+                routing = self._wait_for_replicas()
+            return random.choice(routing["replicas"])
         return a if qa <= qb else b
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    _MUX_PROBE_TTL_S = 5.0
+
+    def _dispatch(self, replica, args, kwargs, mux: str = ""):
+        if self._stream:
+            method = replica.handle_request_streaming.options(
+                num_returns="streaming")
+            return DeploymentResponseGenerator(
+                method.remote(self._method, args, kwargs, mux))
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            mux)
+        return DeploymentResponse(ref)
+
+    def remote(self, *args, **kwargs):
         mux = self._mux_id
         if mux:
+            import time as _time
             routing = self._get_routing()
             replica = self._mux_affinity.get(mux)
             if replica is not None and replica in routing["replicas"]:
-                try:  # cheap liveness probe, like the pow-2 path
-                    ray_tpu.get(replica.num_ongoing.remote(), timeout=5)
-                except Exception:  # noqa: BLE001 — crashed: re-pin
-                    self._get_routing(refresh=True)
-                    replica = None
+                # optimistic dispatch: probe only when the cached
+                # liveness result is stale (ADVICE: a probe per dispatch
+                # added a full RPC round trip to every request)
+                last_ok = self._mux_probe_ok.get(replica, 0.0)
+                if _time.monotonic() - last_ok > self._MUX_PROBE_TTL_S:
+                    try:
+                        ray_tpu.get(replica.num_ongoing.remote(),
+                                    timeout=5)
+                        self._mux_probe_ok[replica] = _time.monotonic()
+                    except Exception:  # noqa: BLE001 — crashed: re-pin
+                        self._get_routing(refresh=True)
+                        self._mux_probe_ok.pop(replica, None)
+                        replica = None
             else:
                 replica = None
             if replica is None:
                 replica = self._pick_replica()
                 self._mux_affinity[mux] = replica
-            ref = replica.handle_request.remote(self._method, args,
-                                                kwargs, mux)
-            return DeploymentResponse(ref)
+                self._mux_probe_ok[replica] = _time.monotonic()
+            return self._dispatch(replica, args, kwargs, mux)
         replica = self._pick_replica()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref)
+        return self._dispatch(replica, args, kwargs)
 
     def __reduce__(self):
         return (DeploymentHandle, (self._app, self._deployment,
-                                   self._method, self._mux_id))
+                                   self._method, self._mux_id,
+                                   self._stream))
 
     # identity is the target, not the instance: the controller compares
     # init_args across redeploys to decide in-place reconfigure vs

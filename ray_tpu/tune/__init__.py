@@ -4,10 +4,16 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.train.session import get_checkpoint, get_context
 from ray_tpu.train.session import report as _train_report
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search.bayesopt import GPSearcher
 from ray_tpu.tune.search.sample import (choice, grid_search, loguniform,
                                         quniform, randint, sample_from,
                                         uniform)
+from ray_tpu.tune.search.searcher import BasicVariantGenerator, Searcher
+from ray_tpu.tune.search.tpe import TPESearcher
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 
@@ -55,4 +61,7 @@ __all__ = [
     "get_checkpoint", "choice", "uniform", "loguniform", "randint",
     "quniform", "sample_from", "grid_search", "with_resources",
     "with_parameters", "run", "ASHAScheduler", "FIFOScheduler",
+    "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",
+    "TPESearcher", "GPSearcher",
 ]

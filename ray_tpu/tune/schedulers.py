@@ -57,7 +57,7 @@ class ASHAScheduler:
             return STOP
         decision = CONTINUE
         for rung_idx, milestone in enumerate(self.milestones):
-            if t == milestone and \
+            if t >= milestone and \
                     self._trial_rung.get(trial_id, -1) < rung_idx:
                 self._trial_rung[trial_id] = rung_idx
                 values = self._rungs[milestone]
@@ -75,6 +75,129 @@ class ASHAScheduler:
 
 
 AsyncHyperBandScheduler = ASHAScheduler
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average objective falls below the
+    median of the other trials' running averages at the same step
+    (parity: ``python/ray/tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 4, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial -> list of normalized metric values (one per report)
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric) if self.metric else None
+        if t is None or metric is None:
+            return CONTINUE
+        hist = self._history[trial_id]
+        hist.append(self._norm(float(metric)))
+        if t < self.grace_period:
+            return CONTINUE
+        step = len(hist)
+        other_avgs = [
+            sum(h[:step]) / min(step, len(h))
+            for tid, h in self._history.items()
+            if tid != trial_id and h]
+        if len(other_avgs) < self.min_samples:
+            return CONTINUE
+        # lower median: lenient on ties/even counts
+        median = sorted(other_avgs)[(len(other_avgs) - 1) // 2]
+        best_so_far = max(hist)
+        return STOP if best_so_far < median else CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        # completed histories stay: they keep informing the median
+        pass
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving (parity:
+    ``python/ray/tune/schedulers/hyperband.py``), adapted to this
+    controller's async report stream.
+
+    Trials are assigned round-robin to brackets; bracket ``s`` starts
+    its trials with budget ``r0 = max_t / eta^s`` and halves at rungs
+    ``r0 * eta^k``.  A rung's cutoff activates once the rung has seen
+    ``eta`` results (the async adaptation — the reference pauses trials
+    at rung boundaries instead, which needs checkpoint/pause support in
+    the executor).
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, eta: int = 3, num_brackets: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = eta
+        # brackets from most to least aggressive: bracket i halves from
+        # r0 = max_t / eta^(s_max - i) (s_max = log_eta max_t), so the
+        # first bracket starts at the smallest budget
+        s_max = max(1, int(math.log(max_t) / math.log(eta)))
+        self.brackets: List[List[int]] = []
+        for i in range(num_brackets):
+            s = max(0, s_max - i)
+            r = max(1, round(max_t / (eta ** s)))
+            rungs = []
+            while r < max_t:
+                rungs.append(r)
+                r *= eta
+            self.brackets.append(rungs)
+        self._trial_bracket: Dict[str, int] = {}
+        self._next_bracket = 0
+        # (bracket, milestone) -> recorded values
+        self._rungs: Dict[tuple, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = {}
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_trial_add(self, trial_id: str, config: Dict) -> None:
+        self._trial_bracket[trial_id] = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % len(self.brackets)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric) if self.metric else None
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        b = self._trial_bracket.setdefault(trial_id, 0)
+        rungs = self.brackets[b]
+        decision = CONTINUE
+        # >=: time_attr need not step by 1 (seconds, stride-k reports);
+        # the rung guard ensures each rung records once per trial
+        for rung_idx, milestone in enumerate(rungs):
+            if t >= milestone and \
+                    self._trial_rung.get(trial_id, -1) < rung_idx:
+                self._trial_rung[trial_id] = rung_idx
+                values = self._rungs[(b, milestone)]
+                values.append(self._norm(float(metric)))
+                if len(values) >= self.eta:
+                    keep = max(1, len(values) // self.eta)
+                    cutoff = sorted(values, reverse=True)[keep - 1]
+                    if self._norm(float(metric)) < cutoff:
+                        decision = STOP
+        return decision
+
+    def on_trial_complete(self, trial_id: str):
+        self._trial_rung.pop(trial_id, None)
+        self._trial_bracket.pop(trial_id, None)
+
 
 EXPLOIT = "EXPLOIT"
 

@@ -241,8 +241,22 @@ class Tuner:
         os.makedirs(storage, exist_ok=True)
         self._save_tuner_blob(storage)
 
+        searcher = self.tune_config.search_alg
+        total_trials = None
         if self._restored_trials is not None:
             trials = self._restored_trials
+            searcher = None   # restored experiments replay fixed configs
+        elif searcher is not None:
+            # model-based search: trials are created on demand from
+            # searcher.suggest as capacity frees up
+            searcher.set_search_properties(self.tune_config.metric,
+                                           self.tune_config.mode,
+                                           self.param_space,
+                                           self.tune_config.num_samples)
+            trials = []
+            total_trials = (searcher.total_suggestions()
+                            if hasattr(searcher, "total_suggestions")
+                            else None) or self.tune_config.num_samples
         else:
             configs = resolve(self.param_space,
                               self.tune_config.num_samples,
@@ -250,7 +264,8 @@ class Tuner:
             trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
                       for i, cfg in enumerate(configs)]
         max_concurrent = (self.tune_config.max_concurrent_trials
-                          or len(trials))
+                          or (4 if searcher is not None
+                              else len(trials)))
 
         pending = [t for t in trials if t.status == "PENDING"]
         running: List[Trial] = []
@@ -286,9 +301,25 @@ class Tuner:
             trial.status = status
             running.remove(trial)
             scheduler.on_trial_complete(trial.trial_id)
+            if searcher is not None:
+                searcher.on_trial_complete(trial.trial_id,
+                                           trial.last_result or None,
+                                           error=status == "ERROR")
             reports.pop(trial.trial_id, None)
             ray_tpu.kill(trial.actor)
             save_state()
+
+        def next_suggested() -> Optional[Trial]:
+            if searcher is None or len(trials) >= total_trials:
+                return None
+            trial_id = f"trial_{len(trials):05d}"
+            cfg = searcher.suggest(trial_id)
+            if cfg is None:
+                return None
+            trial = Trial(trial_id=trial_id, config=cfg)
+            trials.append(trial)
+            by_id[trial_id] = trial
+            return trial
 
         def actor_alive(trial: Trial) -> bool:
             # O(1) directory lookup: this runs per running trial per
@@ -299,9 +330,18 @@ class Tuner:
                 trial.actor._actor_id)
             return bool(info) and info.get("state") == "ALIVE"
 
-        while pending or running:
+        while (pending or running
+               or (searcher is not None and len(trials) < total_trials)):
             while pending and len(running) < max_concurrent:
                 launch(pending.pop(0))
+            while (searcher is not None
+                   and len(running) < max_concurrent):
+                trial = next_suggested()
+                if trial is None:
+                    break
+                launch(trial)
+            if searcher is not None and not running and not pending:
+                break   # searcher declined to suggest with nothing live
             # one outstanding report poll per running trial, drained in
             # one wait() instead of a serial get() per trial
             for trial in running:
@@ -344,6 +384,8 @@ class Tuner:
                     metrics["config"] = trial.config
                     trial.last_result = metrics
                     trial.history.append(metrics)
+                    if searcher is not None:
+                        searcher.on_trial_result(trial.trial_id, metrics)
                     if checkpoint is not None:
                         trial.checkpoint = checkpoint.persist(
                             os.path.join(storage, trial.trial_id))

@@ -1,0 +1,50 @@
+"""Parse the captured xplane.pb directly: per-HLO-op device time breakdown."""
+import glob
+import sys
+from collections import defaultdict
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+xplane = sorted(glob.glob("/tmp/jaxtrace/**/*.xplane.pb", recursive=True))[-1]
+xs = xplane_pb2.XSpace()
+xs.ParseFromString(open(xplane, "rb").read())
+
+print("planes:", [p.name for p in xs.planes])
+
+for plane in xs.planes:
+    if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+        continue
+    # event_metadata: id -> name; stats for hlo category
+    meta = plane.event_metadata
+    stat_meta = plane.stat_metadata
+    op_time = defaultdict(float)     # name -> total ps
+    cat_time = defaultdict(float)
+    n_events = 0
+    for line in plane.lines:
+        for ev in line.events:
+            m = meta.get(ev.metadata_id)
+            name = m.name if m else str(ev.metadata_id)
+            dur = ev.duration_ps
+            n_events += 1
+            op_time[name] += dur
+            # find hlo_category stat
+            cat = None
+            for st in ev.stats:
+                sm = stat_meta.get(st.metadata_id)
+                if sm and sm.name == "hlo_category":
+                    cat = st.str_value or (
+                        stat_meta.get(st.ref_value).name
+                        if st.ref_value else None)
+            if cat:
+                cat_time[cat] += dur
+    print(f"\n=== plane {plane.name}: {n_events} events, "
+          f"{len(plane.lines)} lines ===")
+    total = sum(op_time.values())
+    print(f"total device-time: {total/1e9:.2f} ms (3 steps)")
+    if cat_time:
+        print("\nby category:")
+        for k, v in sorted(cat_time.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"  {k:40s} {v/1e9:9.2f} ms  {100*v/total:5.1f}%")
+    print("\ntop ops:")
+    for k, v in sorted(op_time.items(), key=lambda kv: -kv[1])[:40]:
+        print(f"  {k[:90]:90s} {v/1e9:9.2f} ms")

@@ -1,0 +1,54 @@
+"""Per-line breakdown; sum leaf ops on the XLA op lines, grouped."""
+import glob
+import re
+from collections import defaultdict
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+xplane = sorted(glob.glob("/tmp/jaxtrace/**/*.xplane.pb", recursive=True))[-1]
+xs = xplane_pb2.XSpace()
+xs.ParseFromString(open(xplane, "rb").read())
+
+for plane in xs.planes:
+    if plane.name != "/device:TPU:0":
+        continue
+    meta = plane.event_metadata
+    for line in plane.lines:
+        tot = sum(ev.duration_ps for ev in line.events)
+        print(f"line {line.id} '{line.name}': {len(line.events)} events, "
+              f"sum {tot/1e9:.1f} ms")
+    # pick the line with most events (likely XLA ops)
+    line = max(plane.lines, key=lambda l: len(l.events))
+    print(f"\nanalyzing line '{line.name}'")
+    groups = defaultdict(float)
+    total = 0
+    for ev in line.events:
+        m = meta.get(ev.metadata_id)
+        name = m.name if m else "?"
+        dur = ev.duration_ps
+        total += dur
+        # group by op kind
+        mm = re.match(r"%?([a-zA-Z_\-\.]+?)[\.\s=]", name)
+        kind = mm.group(1) if mm else name[:30]
+        # special: categorize fusions by content
+        if "fusion" in kind or kind == "%fusion":
+            if "50304]{1,0" in name and "dot" not in name:
+                kind = "fusion(vocab-sized)"
+        groups[kind] += dur
+    print(f"leaf total {total/1e9:.1f} ms over 3 steps "
+          f"({total/3e9:.1f} ms/step)")
+    for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"  {k:35s} {v/3e9:8.2f} ms/step")
+
+    # biggest single events with full names
+    print("\nbiggest leaf events:")
+    seen = set()
+    for ev in sorted(line.events, key=lambda e: -e.duration_ps)[:80]:
+        m = meta.get(ev.metadata_id)
+        name = m.name if m else "?"
+        if name in seen:
+            continue
+        seen.add(name)
+        print(f"  {ev.duration_ps/1e9:8.2f} ms  {name[:150]}")
+        if len(seen) > 25:
+            break

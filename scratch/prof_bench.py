@@ -1,0 +1,134 @@
+"""Component-level timing of the bench recipe on the real chip."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import training
+from ray_tpu.models import gpt as gpt_mod
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.mesh import make_mesh
+
+
+def timeit(name, fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.tree.map(lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+        # force round trip (axon tunnel)
+        leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
+        if leaves:
+            float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
+    if leaves:
+        float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[0]))
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:45s} {dt*1e3:9.2f} ms")
+    return dt
+
+
+def main():
+    devices = jax.devices()
+    print("devices:", devices)
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=False,
+                         unroll_layers=True, ce_chunk=0)
+    batch, seq = 24, 1024
+    mesh = make_mesh(dp=len(devices), devices=devices)
+    fns = training.build_gpt_train(cfg, mesh)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch_data = training.synthetic_lm_batch(
+        jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+
+    # 1. full step
+    def full_step(state, b):
+        s2, m = fns["step_fn"](state, b)
+        return m["loss"]
+    # note: donation invalidates state; rebuild each call is wrong. Instead
+    # time steps in sequence like bench does.
+    for _ in range(2):
+        state, m = fns["step_fn"](state, batch_data)
+        float(m["loss"])
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = fns["step_fn"](state, batch_data)
+    float(m["loss"])
+    full = (time.perf_counter() - t0) / n
+    print(f"{'full train step':45s} {full*1e3:9.2f} ms")
+
+    params = state.params
+
+    # 2. forward+loss only (value_and_grad excluded)
+    loss_eval = fns["loss_fn"]
+    timeit("fwd loss only", loss_eval, params, batch_data)
+
+    # 3. value_and_grad without optimizer
+    import functools
+    from ray_tpu.ops.attention import make_flash_attention_fn
+    attn_fn = fns["attn_fn"]
+
+    def loss(p, b):
+        return gpt_mod.loss_fn(p, b, cfg, attn_fn=attn_fn, mesh=mesh)
+    vg = jax.jit(lambda p, b: jax.value_and_grad(loss)(p, b))
+    timeit("value_and_grad (no opt)", vg, params, batch_data)
+
+    # 4. forward hidden only (no CE head)
+    def hidden_sum(p, b):
+        x, aux = gpt_mod.forward_hidden(p, b["tokens"], cfg,
+                                        attn_fn=attn_fn, mesh=mesh)
+        return jnp.sum(x.astype(jnp.float32))
+    hs = jax.jit(hidden_sum)
+    timeit("fwd hidden only", hs, params, batch_data)
+    vg_h = jax.jit(lambda p, b: jax.value_and_grad(hidden_sum)(p, b))
+    timeit("fwd+bwd hidden only (no CE)", vg_h, params, batch_data)
+
+    # 5. CE head alone: x [B*S, d] -> loss
+    x = jax.random.normal(jax.random.PRNGKey(2), (batch * seq, cfg.d_model),
+                          jnp.bfloat16)
+    tgt = batch_data["targets"].reshape(-1)
+    for chunk in (0, 4096, 8192):
+        def ce(p, x, t, chunk=chunk):
+            s, n_ = gpt_mod._chunked_ce(x, gpt_mod.lm_head(p, cfg), t,
+                                        chunk=chunk)
+            return s / n_
+        ce_vg = jax.jit(lambda p, x, t: jax.value_and_grad(ce)(p, x, t))
+        timeit(f"CE head fwd+bwd chunk={chunk}", ce_vg, params, x, tgt)
+
+    # 6. attention alone fwd+bwd
+    B, S, H, D = batch, seq, cfg.n_heads, cfg.head_dim
+    q = jax.random.normal(jax.random.PRNGKey(3), (B, S, H, D), jnp.bfloat16)
+    from ray_tpu.ops.attention import flash_attention
+
+    for bq, bk in ((1024, 1024), (512, 512), (256, 256), (512, 1024),
+                   (256, 512)):
+        def att(q, bq=bq, bk=bk):
+            return jnp.sum(flash_attention(q, q, q, causal=True,
+                                           block_q=bq, block_k=bk)
+                           .astype(jnp.float32))
+        a_vg = jax.jit(jax.grad(att))
+        timeit(f"flash attn x12 fwd+bwd b=({bq},{bk})",
+               jax.jit(lambda q: sum(jax.tree.leaves(jax.grad(att)(q))[0].astype(jnp.float32).ravel()[:1])), q, n=5)
+
+    # 7. optimizer update alone
+    import optax
+    tx = training.default_optimizer()
+    grads = jax.tree.map(lambda p: jnp.ones_like(p), params)
+    def opt_step(g, os_, p):
+        u, os2 = tx.update(g, os_, p)
+        return optax.apply_updates(p, u), os2
+    oj = jax.jit(opt_step)
+    timeit("optimizer update alone", oj, grads, state.opt_state, params)
+
+    # 8. matmul peak check
+    m = jax.random.normal(jax.random.PRNGKey(4), (8192, 8192), jnp.bfloat16)
+    mm = jax.jit(lambda a: a @ a)
+    dt = timeit("8192^3 matmul", mm, m, n=20)
+    print(f"  -> {2*8192**3/dt/1e12:.1f} TFLOPS effective")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Find the chip's real achievable matmul throughput."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(name, fn, arg, flops, n=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(arg)
+        float(jnp.sum(out.astype(jnp.float32).ravel()[:1]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(arg)
+    float(jnp.sum(out.astype(jnp.float32).ravel()[:1]))
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:45s} {dt*1e3:9.2f} ms  {flops/dt/1e12:7.1f} TFLOPS")
+
+
+def chained(k):
+    def f(a):
+        x = a
+        for _ in range(k):
+            x = jax.lax.dot(x, a, preferred_element_type=jnp.bfloat16)
+            # renormalize cheaply to avoid inf
+            x = (x * 1e-4).astype(jnp.bfloat16)
+        return x
+    return jax.jit(f)
+
+
+for size in (4096, 8192, 16384):
+    a = jax.random.normal(jax.random.PRNGKey(0), (size, size), jnp.bfloat16)
+    for k in (1, 8):
+        bench(f"bf16 {size}^3 x{k} chained", chained(k), a,
+              2 * size**3 * k)
+
+# f32 for comparison
+a = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.float32)
+f = jax.jit(lambda a: jax.lax.dot(a, a) * 1e-4)
+bench("f32 8192^3", f, a, 2 * 8192**3)
+
+# model-shaped matmuls: [24576, 768] x [768, 50304] (the CE head)
+x = jax.random.normal(jax.random.PRNGKey(1), (24576, 768), jnp.bfloat16)
+w = jax.random.normal(jax.random.PRNGKey(2), (768, 50304), jnp.bfloat16)
+f = jax.jit(lambda x: jax.lax.dot(x, w, preferred_element_type=jnp.float32))
+bench("CE-head [24576,768]@[768,50304] f32acc", f, x,
+      2 * 24576 * 768 * 50304)
+f = jax.jit(lambda x: jax.lax.dot(x, w, preferred_element_type=jnp.bfloat16))
+bench("CE-head bf16 out", f, x, 2 * 24576 * 768 * 50304)
+
+# layer-shaped: [24576, 768] @ [768, 2048]
+w2 = jax.random.normal(jax.random.PRNGKey(3), (768, 2048), jnp.bfloat16)
+def f2(x):
+    h = x
+    for _ in range(8):
+        h = jax.lax.dot(jax.lax.dot(h, w2), w2.T)
+        h = (h * 1e-2).astype(jnp.bfloat16)
+    return h
+f2 = jax.jit(f2)
+bench("mlp-shaped [24576,768]@[768,2048] x16", f2, x,
+      2 * 24576 * 768 * 2048 * 16)

@@ -1,0 +1,37 @@
+"""Capture an xplane trace of the train step and print the op breakdown."""
+import glob
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import training
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.mesh import make_mesh
+
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                     dtype=jnp.bfloat16, remat=False,
+                     unroll_layers=True, ce_chunk=-1)
+batch, seq = 24, 1024
+mesh = make_mesh(dp=1, devices=jax.devices())
+fns = training.build_gpt_train(cfg, mesh)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for _ in range(3):
+    state, m = fns["step_fn"](state, bd)
+    float(m["loss"])
+
+logdir = "/tmp/jaxtrace"
+os.system(f"rm -rf {logdir}")
+jax.profiler.start_trace(logdir)
+for _ in range(3):
+    state, m = fns["step_fn"](state, bd)
+float(m["loss"])
+jax.profiler.stop_trace()
+
+# find the xplane file
+files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+print("xplane files:", files)

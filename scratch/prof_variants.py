@@ -1,0 +1,106 @@
+"""Accurate microbenches: repeat work inside one jit; time one big call."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt as gpt_mod
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.ops.attention import flash_attention
+
+
+def timeit(name, jfn, *args, reps=1):
+    out = jfn(*args)  # compile
+    jax.block_until_ready(out)
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
+    float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1]))
+    t0 = time.perf_counter()
+    out = jfn(*args)
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "dtype")]
+    float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1]))
+    dt = (time.perf_counter() - t0 - 0.1) / reps   # ~100ms fetch latency
+    print(f"{name:52s} {dt*1e3:9.2f} ms")
+    return dt
+
+
+B, S, H, D = 24, 1024, 12, 64
+K = 20  # inner reps
+
+q = jax.random.normal(jax.random.PRNGKey(3), (B, S, H, D), jnp.bfloat16)
+
+# attention variants: grad of flash attention, K reps chained
+for bq, bk in ((1024, 1024), (512, 512), (256, 256), (512, 256),
+               (1024, 512), (256, 128), (512, 128)):
+    def one(x, bq=bq, bk=bk):
+        o = flash_attention(x, x, x, causal=True, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32))
+    def rep(x):
+        g = x
+        for _ in range(K):
+            g = jax.grad(one)(g)
+        return g
+    jfn = jax.jit(rep)
+    dt = timeit(f"attn fwd+bwd 1 layer b=({bq},{bk})", jfn, q, reps=K)
+
+# CE variants
+x = jax.random.normal(jax.random.PRNGKey(1), (B * S, 768), jnp.bfloat16)
+head = jax.random.normal(jax.random.PRNGKey(2), (768, 50304), jnp.bfloat16)
+tgt = jax.random.randint(jax.random.PRNGKey(4), (B * S,), 0, 50304)
+
+
+def ce_remat(x, head, tgt):
+    s, n = gpt_mod._chunked_ce(x, head, tgt, chunk=0)
+    return s / n
+
+
+def ce_noremat(x, head, tgt):
+    logits = jnp.einsum("nd,dv->nv", x, head,
+                        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - true)
+
+
+KC = 6
+for name, fn in (("CE remat chunk=0", ce_remat),
+                 ("CE no-remat", ce_noremat)):
+    def rep(x, head, tgt, fn=fn):
+        tot = jnp.float32(0)
+        gx = x
+        for i in range(KC):
+            l, (gxi, gh) = jax.value_and_grad(fn, argnums=(0, 1))(
+                gx, head, tgt)
+            tot = tot + l
+            gx = (gx + 0.0 * gxi).astype(x.dtype)  # keep dependency
+        return tot
+    jfn = jax.jit(rep)
+    timeit(name, jfn, x, head, tgt, reps=KC)
+
+# qkv fused vs separate
+w = jax.random.normal(jax.random.PRNGKey(5), (768, 768), jnp.bfloat16)
+w3 = jax.random.normal(jax.random.PRNGKey(6), (768, 2304), jnp.bfloat16)
+xh = jax.random.normal(jax.random.PRNGKey(7), (B, S, 768), jnp.bfloat16)
+
+
+def sep(xh):
+    acc = xh
+    for _ in range(K):
+        a = jnp.einsum("bsd,de->bse", acc, w)
+        b = jnp.einsum("bsd,de->bse", acc, w)
+        c = jnp.einsum("bsd,de->bse", acc, w)
+        acc = (a + b + c) * 1e-2
+    return acc
+
+
+def fused(xh):
+    acc = xh
+    for _ in range(K):
+        abc = jnp.einsum("bsd,de->bse", acc, w3)
+        a, b, c = jnp.split(abc, 3, -1)
+        acc = (a + b + c) * 1e-2
+    return acc
+
+
+timeit("qkv separate x3 matmul", jax.jit(sep), xh, reps=K)
+timeit("qkv fused [768,2304]", jax.jit(fused), xh, reps=K)

@@ -82,6 +82,46 @@ def test_journal_truncated_tail(tmp_path):
     assert cp2.kv_get(b"a") == b"1" and cp2.kv_get(b"b") == b"2"
 
 
+def test_journal_reopen_truncates_torn_tail(tmp_path):
+    """Records appended *after* a torn tail must not be lost: reopening
+    the journal truncates to the last valid boundary first."""
+    from ray_tpu._private.control_plane import ControlPlane
+    from ray_tpu._private.persistence import Journal, restore_control_plane
+
+    path = str(tmp_path / "journal.bin")
+    j1 = Journal(path)
+    j1.append("kv_put", (b"a", b"1", True, "default"))
+    j1.close()
+    with open(path, "ab") as f:  # crash mid-write
+        f.write(b"\xff\xff\xff\x7f torn")
+    # next session reopens the journal and keeps writing
+    j2 = Journal(path)
+    j2.append("kv_put", (b"b", b"2", True, "default"))
+    j2.close()
+    cp = ControlPlane()
+    restore_control_plane(cp, path)
+    assert cp.kv_get(b"a") == b"1"
+    assert cp.kv_get(b"b") == b"2", "record behind torn tail was lost"
+
+
+def test_post_restore_marks_old_head_dead():
+    """After a head restart the previous head's node entry must not keep
+    advertising node:__internal_head__ as ALIVE (init(address='auto')
+    would attach to the dead head)."""
+    from ray_tpu._private.control_plane import ControlPlane
+
+    cp = ControlPlane()
+    cp.register_node(b"oldhead", {
+        "resources_total": {"CPU": 4, "node:__internal_head__": 1.0}})
+    cp.register_node(b"worker1", {"resources_total": {"CPU": 4}})
+    state = cp.dump_state()
+    cp2 = ControlPlane()
+    cp2.load_state(state)
+    cp2.post_restore()
+    assert cp2.get_node(b"oldhead")["state"] == "DEAD"
+    assert cp2.get_node(b"worker1")["state"] == "ALIVE"
+
+
 _PHASE1 = """
 import os, sys
 import ray_tpu

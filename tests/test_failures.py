@@ -204,3 +204,56 @@ def test_chaos_actor_killer_restarts(ray_start_regular):
     for prev, cur in zip(results, results[1:]):
         assert cur == prev + 1 or cur <= prev, results
     assert killer.kills, "chaos never killed the actor"
+
+
+def test_oom_policy_kills_hog_and_retries(tmp_path):
+    """Memory monitor: node usage over threshold kills the newest
+    retriable task's worker; the retry succeeds and an unrelated
+    non-retriable task is untouched (reference: memory_monitor.h +
+    worker_killing_policy.cc 'newest retriable first')."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, _system_config={
+        "memory_monitor_refresh_ms": 100,
+        "memory_monitor_limit_bytes": 300 * 1024 * 1024,
+        "memory_usage_threshold": 0.9,
+    })
+    try:
+        marker = str(tmp_path / "hog_ran")
+
+        @ray_tpu.remote(max_retries=2)
+        def hog(marker_path):
+            import os
+            import time
+            if not os.path.exists(marker_path):
+                with open(marker_path, "w") as f:
+                    f.write("x")
+                ballast = bytearray(500 * 1024 * 1024)  # noqa: F841
+                time.sleep(30)  # hold memory until the monitor kills us
+                return "never"
+            return "retried_ok"
+
+        @ray_tpu.remote(max_retries=0)
+        def friend():
+            import time
+            time.sleep(1.0)
+            return "fine"
+
+        f = friend.remote()
+        h = hog.remote(marker)
+        assert ray_tpu.get(f, timeout=60) == "fine"
+        assert ray_tpu.get(h, timeout=120) == "retried_ok"
+
+        # a non-retriable hog surfaces an OOM-attributed crash
+        @ray_tpu.remote(max_retries=0)
+        def hog2():
+            import time
+            ballast = bytearray(500 * 1024 * 1024)  # noqa: F841
+            time.sleep(30)
+            return "never"
+
+        import pytest as _pytest
+        with _pytest.raises(Exception) as excinfo:
+            ray_tpu.get(hog2.remote(), timeout=60)
+        assert "memory" in str(excinfo.value).lower()
+    finally:
+        ray_tpu.shutdown()

@@ -202,3 +202,150 @@ def test_tuner_restore_resumes_unfinished(ray_start_regular, tmp_path):
     # resuming from the checkpoint means no iteration repeats
     steps = [h["i"] for h in by_tag["crasher"].metrics_history]
     assert steps == [0, 1, 2, 3, 4, 5], steps
+
+
+# ---------------------------------------------------------------------------
+# model-based searchers (native TPE / GP) + new schedulers
+# ---------------------------------------------------------------------------
+
+def _drive_searcher(searcher, objective, space, n_trials, metric="score"):
+    """Run a searcher synchronously against a synthetic objective."""
+    searcher.set_search_properties(metric, "max", space)
+    best = float("-inf")
+    for i in range(n_trials):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert cfg is not None
+        score = objective(cfg)
+        best = max(best, score)
+        searcher.on_trial_complete(tid, {metric: score})
+    return best
+
+
+def _random_best(objective, space, n_trials, seed=1):
+    import random
+
+    from ray_tpu.tune.search.searcher import sample_config
+    rng = random.Random(seed)
+    return max(objective(sample_config(space, rng))
+               for _ in range(n_trials))
+
+
+def _quadratic_objective(cfg):
+    # peak at lr=1e-2 (log-scale), width=0.3
+    import math
+    lr_err = (math.log10(cfg["lr"]) + 2.0) ** 2
+    w_err = (cfg["width"] - 0.3) ** 2 * 10
+    return -(lr_err + w_err)
+
+
+_SEARCH_SPACE = None
+
+
+def _search_space():
+    from ray_tpu.tune.search.sample import loguniform, uniform
+    return {"lr": loguniform(1e-5, 1e1), "width": uniform(0, 1)}
+
+
+def test_tpe_beats_random_in_half_the_trials():
+    from ray_tpu.tune.search.tpe import TPESearcher
+    best_tpe = _drive_searcher(
+        TPESearcher(n_initial_points=8, seed=0), _quadratic_objective,
+        _search_space(), n_trials=30)
+    best_rand = _random_best(_quadratic_objective, _search_space(),
+                             n_trials=60)
+    assert best_tpe >= best_rand, (best_tpe, best_rand)
+
+
+def test_gp_beats_random_in_half_the_trials():
+    from ray_tpu.tune.search.bayesopt import GPSearcher
+    best_gp = _drive_searcher(
+        GPSearcher(n_initial_points=6, seed=0), _quadratic_objective,
+        _search_space(), n_trials=30)
+    best_rand = _random_best(_quadratic_objective, _search_space(),
+                             n_trials=60)
+    assert best_gp >= best_rand, (best_gp, best_rand)
+
+
+def test_searcher_categoricals_converge():
+    from ray_tpu.tune.search.sample import choice, uniform
+    from ray_tpu.tune.search.tpe import TPESearcher
+
+    def obj(cfg):
+        return (2.0 if cfg["act"] == "gelu" else 0.0) - \
+            (cfg["x"] - 0.5) ** 2
+
+    space = {"act": choice(["relu", "gelu", "silu"]), "x": uniform(0, 1)}
+    searcher = TPESearcher(n_initial_points=6, seed=3)
+    searcher.set_search_properties("score", "max", space)
+    picks = []
+    for i in range(40):
+        cfg = searcher.suggest(f"t{i}")
+        searcher.on_trial_complete(f"t{i}", {"score": obj(cfg)})
+        picks.append(cfg["act"])
+    # the model should exploit the winning category in the tail
+    assert picks[-10:].count("gelu") >= 5, picks[-10:]
+
+
+def test_median_stopping_rule():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+    rule = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                              min_samples_required=2)
+    # three strong trials establish the median
+    for tid, base in (("a", 1.0), ("b", 0.9), ("c", 0.8)):
+        for t in (1, 2, 3):
+            rule.on_result(tid, {"acc": base + t * 0.1,
+                                 "training_iteration": t})
+    # a weak trial survives the grace period, then gets cut
+    assert rule.on_result("w", {"acc": 0.1, "training_iteration": 1}) \
+        == CONTINUE
+    assert rule.on_result("w", {"acc": 0.1, "training_iteration": 2}) \
+        == STOP
+    # a strong newcomer above the median continues
+    rule2_hist = [{"acc": 2.0, "training_iteration": t}
+                  for t in (1, 2)]
+    for r in rule2_hist:
+        decision = rule.on_result("s", r)
+    assert decision == CONTINUE
+
+
+def test_hyperband_scheduler_halves_brackets():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+    hb = HyperBandScheduler(metric="acc", mode="max", max_t=9, eta=3,
+                            num_brackets=1)
+    assert hb.brackets == [[1, 3]]
+    for i, tid in enumerate(("a", "b", "c")):
+        hb.on_trial_add(tid, {})
+    # rung at t=1: after eta results the bottom of the rung is cut
+    assert hb.on_result("a", {"acc": 0.9, "training_iteration": 1}) \
+        == CONTINUE
+    assert hb.on_result("b", {"acc": 0.8, "training_iteration": 1}) \
+        == CONTINUE
+    assert hb.on_result("c", {"acc": 0.1, "training_iteration": 1}) \
+        == STOP
+    # survivors reach max_t and stop there
+    assert hb.on_result("a", {"acc": 0.95, "training_iteration": 9}) \
+        == STOP
+
+
+def test_tuner_with_search_alg(ray_start_regular, tmp_path):
+    import ray_tpu.tune as tune
+    from ray_tpu.tune.search.tpe import TPESearcher
+
+    def trainable(config):
+        tune.report({"score": -(config["x"] - 0.25) ** 2})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=10,
+            max_concurrent_trials=2,
+            search_alg=TPESearcher(n_initial_points=4, seed=0)),
+        run_config=__import__(
+            "ray_tpu.train.config", fromlist=["RunConfig"]).RunConfig(
+                storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 10
+    best = grid.get_best_result()
+    assert abs(best.metrics["config"]["x"] - 0.25) < 0.4
