@@ -2,13 +2,15 @@
 
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
                                    read_csv, read_images, read_json,
                                    read_numpy, read_parquet, read_text)
 
 __all__ = [
-    "Block", "BlockAccessor", "Dataset", "GroupedData", "range",
+    "Block", "BlockAccessor", "Dataset", "DataIterator", "GroupedData",
+    "range",
     "from_items", "from_numpy", "from_arrow", "from_pandas",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",

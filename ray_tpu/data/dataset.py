@@ -210,6 +210,56 @@ def _distributed_sort(refs_in: List[ObjectRef], key: str,
             for i in range(n)]
 
 
+def iter_fixed_batches(block_iter: Iterator[Block], *,
+                       batch_size: Optional[int], batch_format: str,
+                       drop_last: bool) -> Iterator[Any]:
+    """Fixed-size batches over a block stream: remainder rows carry
+    into the next block, so batch shapes stay constant across block
+    boundaries (jit-compiled train steps need static shapes).  Shared
+    by ``Dataset.iter_batches`` and ``DataIterator.iter_batches``."""
+    carry: Optional[Block] = None
+    for block in block_iter:
+        if carry is not None and carry.num_rows > 0:
+            block = concat_blocks([carry, block])
+            carry = None
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if batch_size is None:
+            if n:
+                yield format_batch(block, batch_format)
+            continue
+        start = 0
+        while n - start >= batch_size:
+            yield format_batch(acc.slice(start, start + batch_size),
+                               batch_format)
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+    if carry is not None and carry.num_rows > 0 and not drop_last:
+        yield format_batch(carry, batch_format)
+
+
+def iter_device_batches(batch_iter: Iterator[Any], *, sharding=None,
+                        prefetch: int = 2) -> Iterator[Any]:
+    """Async ``device_put`` pipeline: keeps ``prefetch`` device batches
+    in flight so H2D transfer overlaps the consumer's compute."""
+    import jax
+
+    def put(batch):
+        if sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+    from collections import deque
+    window: deque = deque()
+    for batch in batch_iter:
+        window.append(put(batch))
+        if len(window) > prefetch:
+            yield window.popleft()
+    while window:
+        yield window.popleft()
+
+
 class Dataset:
     def __init__(self, block_refs: List[ObjectRef],
                  ops: Optional[List[_Op]] = None):
@@ -319,6 +369,7 @@ class Dataset:
                 physical.append(MapOperator(fused, budget=window))
                 fused = []
 
+        from ray_tpu.data.streaming_executor import ShuffleOperator
         for op in self._ops:
             if isinstance(op, _MapOp):
                 fused.append((op.kind, op.fn, op.kwargs))
@@ -330,6 +381,11 @@ class Dataset:
                     fn_constructor_kwargs=op.fn_constructor_kwargs,
                     batch_size=op.batch_size,
                     batch_format=op.batch_format))
+            elif isinstance(op, _AllToAllOp) and op.kind == "shuffle":
+                flush()
+                # streaming split stage: overlaps with upstream maps
+                physical.append(ShuffleOperator(
+                    seed=op.kwargs.get("seed"), budget=window))
             else:
                 flush()
                 physical.append(AllToAllOperator(op.kind, op.kwargs))
@@ -343,6 +399,29 @@ class Dataset:
         from ray_tpu.data.streaming_executor import StreamingExecutor
         executor = StreamingExecutor(self._build_operators(window))
         yield from executor.execute(list(self._block_refs))
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """Split one streaming execution across ``n`` consumers.
+
+        Each returned :class:`DataIterator` yields a disjoint subset of
+        the stream's blocks (greedy pull by default, strict round-robin
+        with ``equal=True``) — the multi-worker Train ingest path
+        (parity: ``Dataset.streaming_split`` /
+        ``operators/output_splitter.py``).
+        """
+        import cloudpickle
+
+        from ray_tpu.data.iterator import (DataIterator,
+                                           _CoordinatorOwner,
+                                           _SplitCoordinator)
+        coord = _SplitCoordinator.remote(cloudpickle.dumps(self), n,
+                                         equal)
+        owner = _CoordinatorOwner(coord)
+        iterators = [DataIterator(coord, i) for i in range(n)]
+        for it in iterators:
+            it._owner = owner     # coordinator dies with the last one
+        return iterators
 
     def materialize(self) -> "Dataset":
         refs = list(self._execute())
@@ -418,26 +497,10 @@ class Dataset:
                      batch_format: str = "numpy",
                      drop_last: bool = False,
                      prefetch_blocks: int = 2) -> Iterator[Any]:
-        carry: Optional[Block] = None
-        for block in self._iter_blocks_prefetched(prefetch_blocks):
-            if carry is not None and carry.num_rows > 0:
-                block = concat_blocks([carry, block])
-                carry = None
-            acc = BlockAccessor.for_block(block)
-            n = acc.num_rows()
-            if batch_size is None:
-                if n:
-                    yield format_batch(block, batch_format)
-                continue
-            start = 0
-            while n - start >= batch_size:
-                yield format_batch(acc.slice(start, start + batch_size),
-                                   batch_format)
-                start += batch_size
-            if start < n:
-                carry = acc.slice(start, n)
-        if carry is not None and carry.num_rows > 0 and not drop_last:
-            yield format_batch(carry, batch_format)
+        yield from iter_fixed_batches(
+            self._iter_blocks_prefetched(prefetch_blocks),
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last)
 
     def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
                          sharding=None, drop_last: bool = True,
@@ -462,26 +525,12 @@ class Dataset:
                 "iter_jax_batches requires batch_format='numpy' "
                 "(pandas/pyarrow batches are not jax pytrees)")
 
-        def put(batch):
-            if sharding is None:
-                return jax.tree.map(jax.numpy.asarray, batch)
-            return jax.tree.map(
-                lambda a: jax.device_put(a, sharding), batch)
-
         it = self.iter_batches(batch_size=batch_size,
                                batch_format=batch_format,
                                drop_last=drop_last,
                                prefetch_blocks=prefetch)
-        # keep `prefetch` device batches in flight: device_put is async,
-        # so the queue overlaps H2D with the consumer's compute
-        from collections import deque
-        window: deque = deque()
-        for batch in it:
-            window.append(put(batch))
-            if len(window) > prefetch:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
+        yield from iter_device_batches(it, sharding=sharding,
+                                       prefetch=prefetch)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for ref in self._execute():

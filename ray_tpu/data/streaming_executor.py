@@ -86,6 +86,14 @@ class PhysicalOperator:
         return (self.input_done and not self.inqueue
                 and not self.inflight and not self._completed)
 
+    def maybe_fire(self) -> None:
+        """Hook for operators with non-per-input launches (barriers,
+        merge phases); called every scheduling round."""
+
+    def expected_outputs(self, n_inputs: int) -> int:
+        """Output-count propagation through the chain (pre-pass)."""
+        return n_inputs
+
     def shutdown(self) -> None:
         pass
 
@@ -180,6 +188,90 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._actors = []
 
 
+class ShuffleOperator(PhysicalOperator):
+    """Full random shuffle with a *streaming* split stage.
+
+    The old path materialized every upstream block at a barrier, then
+    fanned out split+merge (``dataset._all_to_all_refs``) — upstream map
+    work and shuffle work never overlapped.  Here each arriving block is
+    split into ``num_outputs`` random parts immediately (so splits run
+    concurrently with upstream maps under the normal per-op budget);
+    only the per-output merges wait for the whole input, which is the
+    data dependency a full shuffle cannot avoid.  Reference analogue:
+    push-based shuffle's pipelined map/reduce stages
+    (``data/_internal/execution/operators`` + backpressure).
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 num_outputs: Optional[int] = None,
+                 budget: int = DEFAULT_OP_BUDGET):
+        super().__init__("Shuffle", budget)
+        self.seed = seed
+        self.num_outputs = num_outputs   # filled by the executor pre-pass
+        self._parts: Dict[int, List[ObjectRef]] = {}  # input idx -> parts
+        self._n_inputs = 0
+        self._merge_mode = False
+        self._pending_merges: List[int] = []
+        # observability: splits that completed while upstream was still
+        # producing (the overlap this operator exists to create)
+        self.overlapped_splits = 0
+
+    def expected_outputs(self, n_inputs: int) -> int:
+        if self.num_outputs is None:
+            self.num_outputs = max(1, n_inputs)
+        return self.num_outputs
+
+    def _submit(self, ref: ObjectRef) -> ObjectRef:
+        from ray_tpu.data.dataset import _fan_out, _split_block
+        k = self.num_outputs or 1
+        idx = self._n_inputs
+        self._n_inputs += 1
+        seed = (self.seed + idx) if self.seed is not None else None
+        parts = _fan_out([_split_block.options(num_returns=k).remote(
+            ref, k, seed)])[0]
+        self._parts[idx] = parts
+        return parts[0]     # any part: all commit when the task ends
+
+    def on_done(self, ref: ObjectRef) -> None:
+        if self._merge_mode:
+            super().on_done(ref)
+            return
+        self.inflight.pop(ref.binary())
+        if not self.input_done:
+            self.overlapped_splits += 1
+
+    def release_ready(self) -> List[ObjectRef]:
+        if not self._merge_mode:
+            return []       # split parts are internal, not outputs
+        return super().release_ready()
+
+    def maybe_fire(self) -> None:
+        if not self._merge_mode:
+            if (not self.input_done or self.inqueue or self.inflight):
+                return
+            self._merge_mode = True
+            if self._n_inputs == 0:
+                return
+            self._pending_merges = list(range(self.num_outputs or 1))
+        # merges launch under the same budget as everything else (one
+        # burst of num_outputs tasks x num_inputs args would flood the
+        # scheduler the backpressure design exists to protect)
+        from ray_tpu.data.dataset import _merge_blocks
+        while (self._pending_merges
+               and len(self.inflight) < max(self.budget, 1)):
+            j = self._pending_merges.pop(0)
+            out = _merge_blocks.remote(
+                *[self._parts[i][j] for i in range(self._n_inputs)])
+            self.inflight[out.binary()] = (j, out)
+        if not self._pending_merges:
+            self._parts = {}
+
+    def finished(self) -> bool:
+        return (self.input_done and not self.inqueue and self._merge_mode
+                and not getattr(self, "_pending_merges", None)
+                and not self.inflight and not self._completed)
+
+
 class AllToAllOperator(PhysicalOperator):
     """Barrier operator: buffers every upstream block, then fans out the
     shuffle/repartition/sort tasks in one go."""
@@ -194,14 +286,19 @@ class AllToAllOperator(PhysicalOperator):
     def can_launch(self) -> bool:
         return False  # launches happen in maybe_fire, all at once
 
-    def maybe_fire(self) -> List[ObjectRef]:
-        """Once upstream is exhausted, run the all-to-all and return the
-        output refs (tracked as this op's in-flight work)."""
+    def expected_outputs(self, n_inputs: int) -> int:
+        if self.kind == "repartition":
+            return self.kwargs["num_blocks"]
+        return n_inputs
+
+    def maybe_fire(self) -> None:
+        """Once upstream is exhausted, run the all-to-all (output refs
+        tracked as this op's in-flight work)."""
         while self.inqueue:
             _, ref = self.inqueue.popleft()
             self._buffer.append(ref)
         if not self.input_done or self._fired:
-            return []
+            return
         self._fired = True
         from ray_tpu.data.dataset import _all_to_all_refs
         outs = _all_to_all_refs(self._buffer, self.kind, self.kwargs)
@@ -210,7 +307,6 @@ class AllToAllOperator(PhysicalOperator):
         # an all-to-all's output count differs from its input count
         for k, out in enumerate(outs):
             self.inflight[out.binary()] = (k, out)
-        return outs
 
     def finished(self) -> bool:
         return (self.input_done and self._fired
@@ -228,6 +324,11 @@ class StreamingExecutor:
         if not ops:
             yield from input_refs
             return
+        # pre-pass: propagate expected block counts (shuffle sizes its
+        # output partition count from its input count)
+        n = len(input_refs)
+        for op in ops:
+            n = op.expected_outputs(n)
         for ref in input_refs:
             ops[0].add_input(ref)
         ops[0].mark_input_done()
@@ -259,11 +360,9 @@ class StreamingExecutor:
             inflight: Dict[bytes, int] = {}
             for i in reversed(range(len(ops))):
                 op = ops[i]
-                if isinstance(op, AllToAllOperator):
-                    op.maybe_fire()
-                else:
-                    while op.can_launch():
-                        op.launch_one()
+                op.maybe_fire()
+                while op.can_launch():
+                    op.launch_one()
                 for key in op.inflight:
                     inflight[key] = i
             # release anything already complete

@@ -48,12 +48,24 @@ class DataParallelTrainer:
 
     # ------------------------------------------------------------------
     def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+        """Per-worker dataset views.
+
+        ``ray_tpu.data.Dataset`` inputs use ``streaming_split``: every
+        worker pulls a disjoint stream of one shared streaming
+        execution (no per-worker materialized copies — reference:
+        ``streaming_split`` ingest in ``train/_internal/data_config.py``).
+        Other objects pass through unchanged (one copy per worker).
+        """
         if not self.datasets:
             return None
         n = self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
-            if hasattr(ds, "split"):
+            if hasattr(ds, "streaming_split"):
+                parts = ds.streaming_split(n, equal=True)
+                for i in range(n):
+                    shards[i][name] = parts[i]
+            elif hasattr(ds, "split"):
                 parts = ds.split(n)
                 for i in range(n):
                     shards[i][name] = parts[i]
@@ -97,12 +109,16 @@ class DataParallelTrainer:
                 self.scaling_config.num_workers,
                 self.scaling_config._resources,
                 self.scaling_config.placement_strategy)
+            # held for the whole attempt: streaming-split iterators kill
+            # their shared coordinator actor when the driver-side copies
+            # are garbage collected
+            shards = self._dataset_shards()
             try:
                 executor.start()
                 executor.start_training(
                     self.train_loop_per_worker, self.train_loop_config,
                     checkpoint=start_ckpt or ckpt_mgr.latest,
-                    dataset_shards=self._dataset_shards(),
+                    dataset_shards=shards,
                     experiment_name=self.run_config.name,
                     trial_id=self.run_config.name)
                 for round_results in executor.iter_results():
@@ -127,6 +143,7 @@ class DataParallelTrainer:
                 time.sleep(0.5)
             finally:
                 executor.shutdown()
+                del shards   # release the split coordinators
 
         result = Result(
             metrics=last_metrics,

@@ -106,29 +106,46 @@ def test_tensor_columns(ray_start_regular):
 
 
 def test_dataset_in_trainer(ray_start_regular, tmp_path):
-    """Train ingest: dataset shards reach train workers."""
+    """Train ingest: every worker pulls a disjoint stream of one shared
+    execution (streaming_split); together they see each row once."""
     import ray_tpu.data as data
     import ray_tpu.train as train
     from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
 
-    ds = data.range(64)
+    ds = data.range(64, override_num_blocks=4)
+
+    out_dir = str(tmp_path)
 
     def loop(config):
+        import json
+        import os
         shard = train.get_dataset_shard("train")
-        total = 0
+        rank = train.get_context().get_world_rank()
+        ids = []
         for batch in shard.iter_batches(batch_size=8):
-            total += int(batch["id"].sum())
-        train.report({"total": total})
+            ids.extend(int(x) for x in batch["id"])
+        with open(os.path.join(config["out"], f"ids_{rank}.json"),
+                  "w") as f:
+            json.dump(ids, f)
+        train.report({"n": len(ids)})
 
     trainer = DataParallelTrainer(
-        loop, scaling_config=ScalingConfig(num_workers=2),
+        loop, train_loop_config={"out": out_dir},
+        scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
         datasets={"train": ds})
     result = trainer.fit()
     assert result.error is None
-    # both workers together processed all 64 ids exactly once
-    assert result.metrics_history[-1]["total"] + \
-        result.metrics["total"] >= 0  # rank0 only reports; just check run
+    # every id seen exactly once across the two disjoint shard streams
+    import json as _json
+    all_ids, per_worker = [], []
+    for rank in (0, 1):
+        with open(tmp_path / f"ids_{rank}.json") as f:
+            ids = _json.load(f)
+        per_worker.append(ids)
+        all_ids.extend(ids)
+    assert sorted(all_ids) == list(range(64))
+    assert all(per_worker), "a worker saw no data"
 
 
 def test_actor_pool_map_operator(ray_start_regular):
@@ -274,3 +291,114 @@ def test_distributed_sort_global_order(ray_start_regular):
     names = [f"n{i:03d}" for i in rng.permutation(60)]
     sds = data2.from_items([{"name": s} for s in names]).repartition(4)
     assert [r["name"] for r in sds.sort("name").take_all()] ==         sorted(names)
+
+
+def test_shuffle_streams_splits_while_maps_run(ray_start_regular):
+    """The shuffle's split stage overlaps with upstream map tasks (no
+    materialization barrier): some splits finish before the map stage
+    has produced its last block."""
+    import time
+
+    import ray_tpu.data as data
+    from ray_tpu.data.streaming_executor import (ShuffleOperator,
+                                                 StreamingExecutor)
+
+    def slow(batch):
+        time.sleep(0.1)
+        return batch
+
+    ds = data.range(200, override_num_blocks=8).map_batches(slow)
+    shuffled = ds.random_shuffle(seed=7)
+    ops = shuffled._build_operators(window=2)
+    shuffle_op = [op for op in ops if isinstance(op, ShuffleOperator)][0]
+    executor = StreamingExecutor(ops)
+    refs = list(executor.execute(list(shuffled._block_refs)))
+    import ray_tpu
+    blocks = ray_tpu.get(refs, timeout=300)
+    rows = sorted(v for b in blocks
+                  for v in b.column("id").to_pylist())
+    assert rows == list(range(200))
+    assert shuffle_op.overlapped_splits > 0, \
+        "no split completed while maps were still running"
+    # and the public path shuffles too
+    vals = [r["id"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(200)) and vals != sorted(vals)
+
+
+def test_streaming_split_disjoint_across_actors(ray_start_regular):
+    """streaming_split: N consumers (actors) cooperatively ingest one
+    epoch — disjoint blocks, complete union (reference:
+    output_splitter.py per-consumer streams)."""
+    import ray_tpu
+    import ray_tpu.data as data
+
+    ds = data.range(120, override_num_blocks=6)
+    it_a, it_b = ds.streaming_split(2)
+
+    @ray_tpu.remote
+    def consume(it):
+        return [row["id"] for row in it.iter_rows()]
+
+    got_a, got_b = ray_tpu.get([consume.remote(it_a),
+                                consume.remote(it_b)], timeout=120)
+    assert set(got_a).isdisjoint(got_b)
+    assert sorted(got_a + got_b) == list(range(120))
+
+
+def test_streaming_split_equal_round_robin(ray_start_regular):
+    import ray_tpu
+    import ray_tpu.data as data
+
+    ds = data.range(100, override_num_blocks=4)
+    its = ds.streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    def count_blocks(it):
+        return sum(1 for _ in it.iter_blocks())
+
+    counts = ray_tpu.get([count_blocks.remote(it) for it in its],
+                         timeout=120)
+    assert counts == [2, 2]
+
+
+def test_streaming_split_multi_epoch(ray_start_regular):
+    import ray_tpu.data as data
+
+    ds = data.range(40, override_num_blocks=4)
+    (it,) = ds.streaming_split(1)
+    epoch1 = [r["id"] for r in it.iter_rows()]
+    epoch2 = [r["id"] for r in it.iter_rows()]
+    assert sorted(epoch1) == sorted(epoch2) == list(range(40))
+
+
+def test_streaming_split_abandoned_epoch_not_wedged(ray_start_regular):
+    """A partially consumed epoch (islice-style early break) must not
+    wedge the next epoch's iteration."""
+    from itertools import islice
+
+    import ray_tpu.data as data
+
+    ds = data.range(40, override_num_blocks=4)
+    (it,) = ds.streaming_split(1)
+    first = list(islice(it.iter_rows(), 5))   # break mid-epoch
+    assert len(first) == 5
+    epoch2 = [r["id"] for r in it.iter_rows()]
+    assert sorted(epoch2) == list(range(40))
+
+
+def test_streaming_split_equal_splits_leftover_blocks(ray_start_regular):
+    """equal=True with a block count not divisible by n row-splits the
+    leftover round so consumers stay in lock step."""
+    import ray_tpu
+    import ray_tpu.data as data
+
+    ds = data.range(50, override_num_blocks=5)
+    its = ds.streaming_split(2, equal=True)
+
+    @ray_tpu.remote
+    def drain(it):
+        return [r["id"] for r in it.iter_rows()]
+
+    a, b = ray_tpu.get([drain.remote(i) for i in its], timeout=120)
+    assert sorted(a + b) == list(range(50))
+    assert abs(len(a) - len(b)) <= 1
