@@ -97,6 +97,40 @@ def main(duration: float = 2.0) -> List[Dict[str, float]]:
                      for i in range(25)])
     results.append(timeit("n:n actor calls async", nn_batch,
                           multiplier=100, duration=duration))
+
+    # --- compiled DAG (mutable channels) vs chained actor tasks ---
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    s1, s2, s3 = Stage.remote(), Stage.remote(), Stage.remote()
+    ray_tpu.get([s.step.remote(0) for s in (s1, s2, s3)])
+
+    def chained():
+        ray_tpu.get(s3.step.remote(s2.step.remote(s1.step.remote(0))))
+    results.append(timeit("3-stage actor pipeline calls (tasks)",
+                          chained, duration=duration))
+
+    a, b, c = Stage.bind(), Stage.bind(), Stage.bind()
+    with InputNode() as inp:
+        dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+    compiled = dag.experimental_compile()
+    compiled.execute(0).get()
+    state = {"futs": []}
+
+    def channel_call():
+        state["futs"].append(compiled.execute(0))
+        if len(state["futs"]) >= 3:
+            state["futs"].pop(0).get()
+    results.append(timeit(
+        "3-stage actor pipeline calls (compiled dag channels)",
+        channel_call, duration=duration))
+    for f in state["futs"]:
+        f.get()
+    compiled.teardown()
     return results
 
 

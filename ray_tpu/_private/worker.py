@@ -483,6 +483,20 @@ class CoreWorker:
             max_task_retries=opts.get("max_task_retries", 0),
             owner_id=self.worker_id.binary(),
         )
+        # Pin arg objects from the moment of submission.  A call made
+        # while the actor is still PENDING sits in the caller-side
+        # buffer where the node manager's pin (submit_actor_task)
+        # doesn't exist yet — if the caller drops its ObjectRefs in that
+        # window, GC frees the args and the task hangs resolving them.
+        # purge_holder clears the whole "task:" holder at completion, so
+        # the node manager re-pinning the same holder is harmless.
+        deps = spec.dependencies()
+        if deps:
+            try:
+                self.cp.update_refs(b"task:" + spec.task_id,
+                                    {d: 1 for d in deps})
+            except Exception:  # noqa: BLE001
+                pass
         self._route_or_buffer(spec, streaming)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
@@ -609,6 +623,11 @@ class CoreWorker:
         if streaming:
             self.commit_generator_done(spec.task_id, 1)
             self.commit_generator_item(spec.task_id, 0, err, is_error=True)
+        if spec.dependencies():
+            try:  # release the submit-time dependency pin
+                self.cp.purge_holder(b"task:" + spec.task_id)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _route_or_buffer(self, spec: TaskSpec, streaming: bool) -> None:
         """Route to the actor's node manager, or buffer until it's ALIVE.

@@ -1,10 +1,8 @@
 """DAG building (parity: ``python/ray/dag``): ``fn.bind(...)`` /
-``Cls.bind(...)`` build a lazy graph; ``.execute()`` submits it.
-
-The reference's *compiled* DAGs additionally reuse mutable plasma channels
-per invocation; here execute() submits regular tasks (the object store is
-already cheap on-node) — channel reuse is a later optimization tracked in
-ROADMAP.md.
+``Cls.bind(...)`` build a lazy graph; ``.execute()`` submits it as
+regular tasks, ``.experimental_compile()`` turns an actor DAG into a
+standing pipeline over mutable shm channels
+(``ray_tpu.dag.compiled``, parity: ``compiled_dag_node.py``).
 """
 
 from __future__ import annotations
@@ -19,6 +17,11 @@ class DAGNode:
 
     def _resolve(self, cache: Dict[int, Any], exec_args: Tuple):
         raise NotImplementedError
+
+    def experimental_compile(self, channel_capacity: int = 1 << 20):
+        """Compile an actor DAG into a standing channel pipeline."""
+        from ray_tpu.dag.compiled import CompiledDAG
+        return CompiledDAG(self, channel_capacity=channel_capacity)
 
 
 class InputNode(DAGNode):

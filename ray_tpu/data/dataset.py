@@ -417,7 +417,7 @@ class Dataset:
                                            _SplitCoordinator)
         coord = _SplitCoordinator.remote(cloudpickle.dumps(self), n,
                                          equal)
-        owner = _CoordinatorOwner(coord)
+        owner = _CoordinatorOwner(coord, dataset=self)
         iterators = [DataIterator(coord, i) for i in range(n)]
         for it in iterators:
             it._owner = owner     # coordinator dies with the last one

@@ -116,10 +116,16 @@ class _SplitCoordinator:
 
 class _CoordinatorOwner:
     """Driver-side owner: kills the coordinator actor when the last
-    driver-held iterator is GC'd (worker-side copies never own it)."""
+    driver-held iterator is GC'd (worker-side copies never own it).
 
-    def __init__(self, coordinator):
+    Also pins the source dataset: its block ObjectRefs travel to the
+    coordinator inside an opaque pickle blob that dependency pinning
+    cannot see, so this strong reference is what keeps them alive until
+    the coordinator has unpickled (and thereby re-registered) them."""
+
+    def __init__(self, coordinator, dataset=None):
         self.coordinator = coordinator
+        self.dataset = dataset
 
     def __del__(self):
         try:

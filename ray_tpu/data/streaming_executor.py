@@ -206,6 +206,12 @@ class ShuffleOperator(PhysicalOperator):
                  num_outputs: Optional[int] = None,
                  budget: int = DEFAULT_OP_BUDGET):
         super().__init__("Shuffle", budget)
+        # seed=None must still SHUFFLE (a fresh random seed per run) —
+        # _split_block treats seed=None as a contiguous, deterministic
+        # split, which is no shuffle at all
+        if seed is None:
+            import os as _os
+            seed = int.from_bytes(_os.urandom(4), "little")
         self.seed = seed
         self.num_outputs = num_outputs   # filled by the executor pre-pass
         self._parts: Dict[int, List[ObjectRef]] = {}  # input idx -> parts
@@ -226,9 +232,8 @@ class ShuffleOperator(PhysicalOperator):
         k = self.num_outputs or 1
         idx = self._n_inputs
         self._n_inputs += 1
-        seed = (self.seed + idx) if self.seed is not None else None
         parts = _fan_out([_split_block.options(num_returns=k).remote(
-            ref, k, seed)])[0]
+            ref, k, self.seed + idx)])[0]
         self._parts[idx] = parts
         return parts[0]     # any part: all commit when the task ends
 

@@ -1,0 +1,6 @@
+"""``ray_tpu.experimental`` — incubating features (parity:
+``ray.experimental``): mutable channels + compiled DAG execution."""
+
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+__all__ = ["Channel", "ChannelClosed"]

@@ -1,9 +1,11 @@
 """``ray_tpu.rllib`` — reinforcement learning (parity: ``ray.rllib``)."""
 
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.rl_module import (DiscreteMLPModule,
                                           MLPModuleConfig)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
-__all__ = ["PPO", "PPOConfig", "DiscreteMLPModule", "MLPModuleConfig",
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+           "DiscreteMLPModule", "MLPModuleConfig",
            "SingleAgentEnvRunner"]

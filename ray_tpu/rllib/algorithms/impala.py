@@ -1,0 +1,321 @@
+"""IMPALA — importance-weighted asynchronous actor-learner architecture.
+
+Parity: reference ``rllib/algorithms/impala/`` (Espeholt et al. 2018):
+env-runner actors sample *continuously* with whatever (stale) policy
+params they were last handed; the learner consumes completed rollout
+segments as they arrive and corrects for the policy lag with V-trace.
+Decoupling sampling from learning is the point — no synchronous
+sample-then-train barrier like PPO's.
+
+TPU-first: the V-trace targets and the update are one jit-compiled
+function (the time recursion is a ``lax.scan``); segments keep their
+[B, T] time structure on device.  ``num_learners > 1`` scales out via
+the DDP :class:`LearnerGroup` (host ring or the ``ici`` device world).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule, MLPModuleConfig
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+
+@dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 4
+    rollout_length: int = 64
+    # segments consumed per train() call (async: whichever finish first)
+    segments_per_iteration: int = 4
+    num_learners: int = 1
+    learner_backend: str = "host"      # "host" ring | "ici" device world
+    num_cpus_per_learner: float = 1.0
+    num_tpus_per_learner: float = 0.0
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 40.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if rollout_length:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+def vtrace_targets(behavior_logp, target_logp, rewards, terminateds,
+                   values, bootstrap_value, *, gamma: float,
+                   rho_clip: float, c_clip: float):
+    """V-trace corrected targets (all inputs [B, T]; bootstrap [B]).
+
+    Returns (vs [B, T], pg_advantages [B, T]); both stop-gradiented by
+    the caller.  The time recursion runs as a reversed ``lax.scan``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    log_rho = target_logp - behavior_logp
+    rho = jnp.minimum(jnp.exp(log_rho), rho_clip)
+    c = jnp.minimum(jnp.exp(log_rho), c_clip)
+    next_values = jnp.concatenate(
+        [values[:, 1:], bootstrap_value[:, None]], axis=1)
+    nonterminal = 1.0 - terminateds
+    deltas = rho * (rewards + gamma * next_values * nonterminal - values)
+
+    def step(acc, xs):
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * c_t * nt_t * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        step, jnp.zeros(values.shape[0]),
+        (deltas.T, c.T, nonterminal.T), reverse=True)
+    vs = values + vs_minus_v.T
+    vs_next = jnp.concatenate(
+        [vs[:, 1:], bootstrap_value[:, None]], axis=1)
+    pg_adv = rho * (rewards + gamma * vs_next * nonterminal - values)
+    return vs, pg_adv
+
+
+class IMPALALearner:
+    """Jitted V-trace update (parity: impala_learner.py + vtrace)."""
+
+    def __init__(self, module: DiscreteMLPModule, config: IMPALAConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.module = module
+        self.config = config
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.rmsprop(config.lr, decay=0.99, eps=0.1))
+        cfg = config
+
+        def loss_fn(params, batch):
+            B, T = batch["rewards"].shape
+            obs = batch["obs"].reshape((B * T,) + batch["obs"].shape[2:])
+            logits, values = module.forward(params, obs)
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"].reshape(-1)[:, None],
+                -1)[:, 0].reshape(B, T)
+            values = values.reshape(B, T)
+            vs, pg_adv = vtrace_targets(
+                batch["logp"], target_logp, batch["rewards"],
+                batch["terminateds"], values, batch["bootstrap_value"],
+                gamma=cfg.gamma, rho_clip=cfg.vtrace_rho_clip,
+                c_clip=cfg.vtrace_c_clip)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            pi_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, -1))
+            total = (pi_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": jnp.mean(jnp.exp(
+                               target_logp - batch["logp"]))}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+            params = _optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        @jax.jit
+        def grad(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def apply(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._update = update
+        self._grad = grad
+        self._apply = apply
+
+    def init_state(self, key):
+        params = self.module.init_params(key)
+        return params, self.tx.init(params)
+
+    def update(self, params, opt_state, train_batch: Dict[str, np.ndarray],
+               allreduce: Optional[Callable] = None):
+        """One V-trace SGD step over a stacked [B, T] segment batch."""
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(v) for k, v in train_batch.items()}
+        if allreduce is None:
+            params, opt_state, metrics = self._update(params, opt_state,
+                                                      batch)
+        else:
+            grads, metrics = self._grad(params, batch)
+            grads = allreduce(grads)
+            params, opt_state = self._apply(params, opt_state, grads)
+        return params, opt_state, {k: float(v)
+                                   for k, v in metrics.items()}
+
+
+def stack_segments(segments: List[Dict[str, np.ndarray]]
+                   ) -> Dict[str, np.ndarray]:
+    """[ {key: [T,...]} x B ]  ->  {key: [B, T, ...]} (+bootstrap [B])."""
+    out = {}
+    for key in segments[0]:
+        if key == "bootstrap_value":
+            out[key] = np.asarray([s[key] for s in segments], np.float32)
+        else:
+            out[key] = np.stack([s[key] for s in segments])
+    return out
+
+
+class IMPALA:
+    """Async algorithm driver.
+
+    Every env runner always has a sample in flight; ``train()`` drains
+    whichever segments complete first, resubmits those runners
+    immediately with the *current* params (so sampling never stops for
+    learning), then takes one V-trace step on the collected batch.
+    The behavior-vs-target policy lag this creates is exactly what
+    V-trace corrects.
+    """
+
+    def __init__(self, config: IMPALAConfig):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.module = DiscreteMLPModule(MLPModuleConfig(
+            obs_dim=obs_dim, num_actions=num_actions,
+            hidden=tuple(config.hidden)))
+        self.learner_group = None
+        if config.num_learners > 1:
+            from ray_tpu.rllib.core.learner_group import LearnerGroup
+            self.learner_group = LearnerGroup(
+                self.module, config, num_learners=config.num_learners,
+                num_cpus_per_learner=config.num_cpus_per_learner,
+                num_tpus_per_learner=config.num_tpus_per_learner,
+                backend=config.learner_backend,
+                learner_cls="ray_tpu.rllib.algorithms.impala."
+                            "IMPALALearner")
+            self.params = None
+            self.learner = None
+        else:
+            self.learner = IMPALALearner(self.module, config)
+            self.params, self.opt_state = self.learner.init_state(
+                jax.random.PRNGKey(config.seed))
+        blob = cloudpickle.dumps(self.module)
+        self.env_runners = [
+            SingleAgentEnvRunner.remote(
+                config.env, blob, config.rollout_length,
+                seed=config.seed + i, env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self.iteration = 0
+        self.timesteps_total = 0
+        # async pump: one standing sample per runner
+        self._inflight: Dict[bytes, Any] = {}   # ref bytes -> (idx, ref)
+        params_ref = self._params_ref()
+        for i in range(len(self.env_runners)):
+            self._submit(i, params_ref)
+
+    def _params_ref(self):
+        if self.learner_group is not None:
+            return self.learner_group.get_params_ref()
+        import jax
+        return ray_tpu.put(jax.tree.map(np.asarray, self.params))
+
+    def _submit(self, runner_idx: int, params_ref) -> None:
+        ref = self.env_runners[runner_idx].sample.remote(params_ref)
+        self._inflight[ref.binary()] = (runner_idx, ref)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        want = self.config.segments_per_iteration
+        segments: List[Dict[str, np.ndarray]] = []
+        params_ref = self._params_ref()
+        while len(segments) < want:
+            refs = [pair[1] for pair in self._inflight.values()]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=600)
+            for ref in ready:
+                idx, _ = self._inflight.pop(ref.binary())
+                segments.append(ray_tpu.get(ref, timeout=600))
+                # resubmit immediately with current (possibly stale)
+                # params: sampling never waits for learning
+                self._submit(idx, params_ref)
+                if len(segments) >= want:
+                    break
+        train_batch = stack_segments(segments)
+        if self.learner_group is not None:
+            learner_metrics = self.learner_group.update(train_batch)
+        else:
+            self.params, self.opt_state, learner_metrics = \
+                self.learner.update(self.params, self.opt_state,
+                                    train_batch)
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners],
+            timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if not np.isnan(m["episode_return_mean"])]
+        self.iteration += 1
+        self.timesteps_total += int(np.prod(
+            train_batch["rewards"].shape))
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "num_episodes": sum(m["num_episodes"]
+                                for m in runner_metrics),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": v for k, v in learner_metrics.items()},
+        }
+
+    def stop(self):
+        for runner in self.env_runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.learner_group is not None:
+            self.learner_group.stop()

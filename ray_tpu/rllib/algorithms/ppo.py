@@ -29,6 +29,7 @@ class PPOConfig:
     num_env_runners: int = 2
     rollout_length: int = 256
     num_learners: int = 1          # >1: DDP LearnerGroup fan-out
+    learner_backend: str = "host"  # "host" ring | "ici" device world
     num_cpus_per_learner: float = 1.0
     num_tpus_per_learner: float = 0.0
     lr: float = 3e-4
@@ -206,7 +207,8 @@ class PPO:
             self.learner_group = LearnerGroup(
                 self.module, config, num_learners=config.num_learners,
                 num_cpus_per_learner=config.num_cpus_per_learner,
-                num_tpus_per_learner=config.num_tpus_per_learner)
+                num_tpus_per_learner=config.num_tpus_per_learner,
+                backend=config.learner_backend)
             self.params, self.opt_state = None, None
             self.learner = None
         else:

@@ -25,17 +25,23 @@ import ray_tpu
 @ray_tpu.remote
 class _LearnerActor:
     def __init__(self, module_blob: bytes, config, rank: int, world: int,
-                 group_name: str):
+                 group_name: str, backend: str = "host",
+                 learner_cls: str = "ray_tpu.rllib.algorithms.ppo."
+                                    "PPOLearner"):
+        import importlib
+
         import cloudpickle
         import jax
 
-        from ray_tpu.rllib.algorithms.ppo import PPOLearner
+        mod_path, cls_name = learner_cls.rsplit(".", 1)
+        cls = getattr(importlib.import_module(mod_path), cls_name)
         module = cloudpickle.loads(module_blob)
-        self.learner = PPOLearner(module, config)
+        self.learner = cls(module, config)
         self.rank, self.world = rank, world
         if world > 1:
             from ray_tpu.util import collective
-            collective.init_collective_group(world, rank, backend="host",
+            collective.init_collective_group(world, rank,
+                                             backend=backend,
                                              group_name=group_name)
             self._group_name = group_name
         # identical seed everywhere: params start in sync and stay in
@@ -73,26 +79,33 @@ class LearnerGroup:
 
     def __init__(self, module, config, num_learners: int = 2,
                  num_cpus_per_learner: float = 1.0,
-                 num_tpus_per_learner: float = 0.0):
+                 num_tpus_per_learner: float = 0.0,
+                 backend: str = "host",
+                 learner_cls: str = "ray_tpu.rllib.algorithms.ppo."
+                                    "PPOLearner"):
         import cloudpickle
         blob = cloudpickle.dumps(module)
         group = f"learner_{uuid.uuid4().hex[:8]}"
         self._group = group
+        self._backend = backend
         opts: Dict[str, Any] = {"num_cpus": num_cpus_per_learner}
         if num_tpus_per_learner:
             opts["num_tpus"] = num_tpus_per_learner
         self.world = num_learners
         self.actors = [
-            _LearnerActor.options(**opts).remote(blob, config, rank,
-                                                 num_learners, group)
+            _LearnerActor.options(**opts).remote(
+                blob, config, rank, num_learners, group,
+                backend, learner_cls)
             for rank in range(num_learners)]
-        ray_tpu.get([a.ping.remote() for a in self.actors], timeout=120)
+        ray_tpu.get([a.ping.remote() for a in self.actors], timeout=300)
 
     def update(self, train_batch: Dict[str, np.ndarray]
                ) -> Dict[str, float]:
-        """Shard the batch across learners; every learner must see the
-        same number of minibatch steps (collective lockstep), so the
-        batch is trimmed to a multiple of the world size."""
+        """Shard the batch on axis 0 across learners; every learner must
+        see the same number of minibatch steps (collective lockstep), so
+        the batch is trimmed to a multiple of the world size.  Arrays
+        whose leading dim differs from the batch's (e.g. PPO's scalar
+        bootstrap_value) are dropped from the shards."""
         n = len(train_batch["obs"])
         usable = n - n % self.world
         per = usable // self.world
@@ -104,7 +117,8 @@ class LearnerGroup:
         for r in range(self.world):
             sl = slice(r * per, (r + 1) * per)
             shards.append({k: v[sl] for k, v in train_batch.items()
-                           if k != "bootstrap_value"})
+                           if getattr(v, "ndim", 0) >= 1
+                           and v.shape[0] == n})
         metrics = ray_tpu.get(
             [a.update.remote(shard)
              for a, shard in zip(self.actors, shards)], timeout=600)

@@ -548,6 +548,50 @@ class IciGroup(BaseGroup):
         return make_mesh(devices=jax.devices(), **axes)
 
     def allreduce(self, tensor, op: str = "sum"):
+        """XLA-collective allreduce over the device world.
+
+        Each process contributes its local tensor as one shard of a
+        [world, ...] global array; a jitted reduction with replicated
+        output makes XLA insert the cross-process collective (ICI/DCN
+        on TPU pods) — O(N) traffic per link, not the O(W*N) of the old
+        allgather-then-local-reduce.  Falls back to the host-gather path
+        if the device construction fails (every rank falls back together
+        since the failure is deterministic in shapes/topology).
+        """
+        try:
+            return self._allreduce_device(tensor, op)
+        except Exception:  # noqa: BLE001
+            return self._allreduce_host(tensor, op)
+
+    def _allreduce_device(self, tensor, op: str):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        # mesh + jitted reducer cached per (op): a fresh jit(lambda)
+        # per call would retrace/recompile every gradient step
+        if not hasattr(self, "_ar_mesh"):
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[i] for i in range(jax.process_count())]
+            self._ar_mesh = Mesh(np.asarray(devs), ("p",))
+            self._ar_local_dev = per_proc[jax.process_index()]
+            self._ar_fns = {}
+        if op not in self._ar_fns:
+            red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                   "product": jnp.prod}[op]
+            self._ar_fns[op] = jax.jit(
+                lambda a: red(a, axis=0),
+                out_shardings=NamedSharding(self._ar_mesh, P()))
+        mesh = self._ar_mesh
+        x = jnp.asarray(np.asarray(tensor))
+        local = jax.device_put(x[None], self._ar_local_dev)
+        arr = jax.make_array_from_single_device_arrays(
+            (mesh.size,) + x.shape, NamedSharding(mesh, P("p")), [local])
+        return np.asarray(self._ar_fns[op](arr))
+
+    def _allreduce_host(self, tensor, op: str):
         import jax.numpy as jnp
 
         from jax.experimental import multihost_utils

@@ -88,3 +88,30 @@ def test_put_objects_are_not_reconstructible(fast_gc):
     assert global_node().store.delete(ref.binary())
     with pytest.raises(ObjectLostError):
         ray.get(ref, timeout=30)
+
+
+def test_buffered_actor_call_pins_args(ray_start_regular):
+    """A call submitted while the actor is still starting must pin its
+    arg objects: with the caller's ObjectRef dropped, GC would otherwise
+    free the arg before the actor's worker resolves it (regression: the
+    caller-side actor buffer carried no dependency pin, so IMPALA-style
+    fire-and-forget submissions hung forever)."""
+    import gc
+    import time
+
+    ray = ray_start_regular
+
+    @ray.remote
+    class SlowStart:
+        def __init__(self):
+            time.sleep(4.0)   # hold the call in the caller-side buffer
+
+        def first(self, x):
+            return x["v"]
+
+    a = SlowStart.remote()
+    ref = ray.put({"v": 7})
+    out = a.first.remote(ref)       # buffered: actor still PENDING
+    del ref                         # only the task pin protects the arg
+    gc.collect()
+    assert ray.get(out, timeout=60) == 7

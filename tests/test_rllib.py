@@ -138,3 +138,101 @@ def test_bc_offline_clones_expert(ray_start_regular):
     assert metrics["action_accuracy"] > 0.85, metrics
     ev = algo.evaluate(num_episodes=5)
     assert ev["episode_return_mean"] > 100, ev
+
+
+def test_vtrace_matches_numpy_reference():
+    """V-trace recursion vs a straightforward numpy loop."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.impala import vtrace_targets
+
+    rng = np.random.default_rng(0)
+    B, T = 3, 7
+    gamma, rho_clip, c_clip = 0.9, 1.0, 1.0
+    behavior = rng.normal(size=(B, T)).astype(np.float32)
+    target = behavior + rng.normal(scale=0.3, size=(B, T)).astype(
+        np.float32)
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    dones = (rng.random((B, T)) < 0.15).astype(np.float32)
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    boot = rng.normal(size=(B,)).astype(np.float32)
+
+    vs, pg = jax.jit(lambda *a: vtrace_targets(
+        *a, gamma=gamma, rho_clip=rho_clip, c_clip=c_clip))(
+        behavior, target, rewards, dones, values, boot)
+
+    # numpy reference, per batch row
+    for b in range(B):
+        rho = np.minimum(np.exp(target[b] - behavior[b]), rho_clip)
+        c = np.minimum(np.exp(target[b] - behavior[b]), c_clip)
+        nv = np.concatenate([values[b, 1:], boot[b:b + 1]])
+        nt = 1.0 - dones[b]
+        delta = rho * (rewards[b] + gamma * nv * nt - values[b])
+        acc = 0.0
+        vmv = np.zeros(T)
+        for t in reversed(range(T)):
+            acc = delta[t] + gamma * c[t] * nt[t] * acc
+            vmv[t] = acc
+        vs_ref = values[b] + vmv
+        vs_next = np.concatenate([vs_ref[1:], boot[b:b + 1]])
+        pg_ref = rho * (rewards[b] + gamma * vs_next * nt - values[b])
+        np.testing.assert_allclose(np.asarray(vs)[b], vs_ref, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pg)[b], pg_ref, rtol=1e-4)
+
+
+def test_impala_learns_cartpole(ray_start_regular):
+    """Async actor-learner: sampling never blocks on learning; CartPole
+    return improves (parity: rllib/algorithms/impala)."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_length=128)
+            .training(lr=5e-3, segments_per_iteration=2, seed=1)
+            .build())
+    try:
+        first = None
+        best = -np.inf
+        for _ in range(25):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if not np.isnan(ret):
+                if first is None:
+                    first = ret
+                best = max(best, ret)
+        assert first is not None
+        assert best > max(first * 1.5, 40.0), (first, best)
+    finally:
+        algo.stop()
+
+
+def test_impala_multi_learner_ici(ray_start_regular):
+    """BASELINE config 4 shape: 2 learners + 4 env-runners, gradients
+    over the ici (jax.distributed device-world) collective group."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(4, rollout_length=64)
+            .training(lr=5e-3, segments_per_iteration=4,
+                      num_learners=2, learner_backend="ici", seed=2)
+            .build())
+    try:
+        returns = []
+        for _ in range(12):
+            result = algo.train()
+            if not np.isnan(result["episode_return_mean"]):
+                returns.append(result["episode_return_mean"])
+        # learners stayed in sync (identical params) through ici grads
+        p0, p1 = algo.learner_group.get_all_params()
+        import jax
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+        assert returns and returns[-1] > 15.0
+    finally:
+        algo.stop()
