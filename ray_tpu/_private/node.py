@@ -51,10 +51,12 @@ def _gc_stale_sessions(keep: Optional[str] = None) -> None:
     import glob
     import re
     for path in (glob.glob(os.path.join(_default_tmp_root(), "session_*"))
-                 + glob.glob(_shm_root("session_*"))):
+                 + glob.glob(_shm_root("session_*"))
+                 # cross-host client stores: client_<session>_<clientpid>
+                 + glob.glob(os.path.join(_default_tmp_root(), "client_*"))):
         if keep and path.endswith(keep):
             continue
-        m = re.search(r"session_\d+_\d+_(\d+)$", path)
+        m = re.search(r"_(\d+)$", path)
         if not m:
             continue
         pid = int(m.group(1))
@@ -123,15 +125,61 @@ def find_session_cp_address(tmp_root: Optional[str] = None
     return candidates[0] if candidates else None
 
 
+class _ClientStore(ShmStore):
+    """Store for a CROSS-HOST attached driver.
+
+    The session's shm arena isn't path-attachable from another machine,
+    so this driver keeps a *private* local store (reads: the existing
+    chunked pull protocol fetches remote objects into it) and mirrors
+    every put to the head node manager chunk-by-chunk — the primary copy
+    must live where cluster workers can pull it (reference shape:
+    ``python/ray/util/client/server/proxier.py`` routing object I/O
+    through a server-side worker).
+    """
+
+    def __init__(self, root: str, head_nm_client, **kwargs):
+        super().__init__(root, **kwargs)
+        self._head_nm = head_nm_client
+        self._push_chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+
+    def put_serialized(self, object_id: bytes, obj) -> int:
+        size = super().put_serialized(object_id, obj)
+        view = self.get_view(object_id)
+        if view is None:
+            raise RuntimeError(
+                f"object {object_id.hex()} vanished from the client store "
+                "before it could be pushed to the cluster")
+        total = len(view)
+        if total == 0:
+            self._head_nm.call("push_object_chunk", object_id, 0, 0, b"")
+            return size
+        off = 0
+        while off < total:
+            n = min(self._push_chunk, total - off)
+            # slice per chunk: one chunk-sized copy live at a time
+            self._head_nm.call("push_object_chunk", object_id,
+                               total, off, bytes(view[off:off + n]))
+            off += n
+        del view
+        # drop the mmap this get_view cached: a mapped object is skipped
+        # by eviction, and a put-mostly client would otherwise pin every
+        # pushed object in its private store forever
+        self.release_mapping(object_id)
+        return size
+
+
 class AttachedNode:
     """A second driver connected to an EXISTING cluster.
 
     The client-mode the reference reaches with ``ray.init(address=...)``
     (``python/ray/_private/worker.py`` connect-to-existing): this
     process gets its own CoreWorker/job but rides the running session's
-    control plane, head node manager, and shm store.  Same-host only
-    (the shm store is attached by path); cross-host clients would go
-    through a node manager on their own host.
+    control plane and head node manager.  On the same host the shm
+    store is attached by path; from another host (detected by the
+    session directory not existing locally, or forced with
+    ``RAY_TPU_REMOTE_ATTACH=1``) object I/O routes through the head
+    node manager over TCP: puts push chunks up, gets ride the standard
+    pull protocol into a private local store.
 
     ``shutdown()`` detaches — it never tears the session down.
     """
@@ -179,12 +227,35 @@ class AttachedNode:
         self.session_name = os.path.basename(self.session_dir)
         self.node_id = head["node_id"]
         nm = protocol.RpcClient(head["sock_path"])
-        # workers attach the same root the same way — per-object
-        # files + multi-process-safe arena.  spill_dir mirrors the
-        # head's default so spilled objects stay readable here.
-        store = ShmStore(_shm_root(self.session_name),
-                         spill_dir=GLOBAL_CONFIG.object_spill_dir
-                         or os.path.join(self.session_dir, "spill"))
+        remote_host = (os.environ.get("RAY_TPU_REMOTE_ATTACH") == "1"
+                       or not os.path.isdir(self.session_dir))
+        self._client_root = None
+        if remote_host:
+            # cross-host: private local store + push/pull through the
+            # head NM (requires a tcp:// session).  The client gets its
+            # OWN node id: pulls of head-resident objects must not be
+            # skipped as "local" (worker._pull_remote compares node ids).
+            self.node_id = NodeID.from_random().binary()
+            # reap private stores left by drivers that died without a
+            # clean shutdown — on a client-only host no HeadNode ever
+            # runs this GC for us
+            _gc_stale_sessions()
+            client_root = os.path.join(
+                _default_tmp_root(),
+                f"client_{self.session_name}_{os.getpid()}")
+            self._client_root = client_root
+            store = _ClientStore(
+                client_root, nm,
+                spill_dir=GLOBAL_CONFIG.object_spill_dir
+                or os.path.join(client_root, "spill"))
+        else:
+            # same host: attach the session's shm root by path —
+            # per-object files + multi-process-safe arena.  spill_dir
+            # mirrors the head's default so spilled objects stay
+            # readable here.
+            store = ShmStore(_shm_root(self.session_name),
+                             spill_dir=GLOBAL_CONFIG.object_spill_dir
+                             or os.path.join(self.session_dir, "spill"))
         self.store = store
         self.control_plane = cp
         self.job_id = JobID.from_random()
@@ -193,6 +264,10 @@ class AttachedNode:
             worker_id=WorkerID.from_random(), node_id=self.node_id,
             control_plane=cp, node_manager=nm, shm_store=store,
             session_dir=self.session_dir, namespace=namespace)
+        if remote_host:
+            # puts are mirrored to the head's store: advertise THAT as
+            # the committed location so cluster workers pull from it
+            self.worker.commit_node_id = head["node_id"]
         from ray_tpu._private.ref_tracker import install_tracker
         install_tracker(self.worker.worker_id.binary(), cp)
         self.log_monitor = None
@@ -218,6 +293,8 @@ class AttachedNode:
             pass
         if self.log_monitor is not None:
             self.log_monitor.stop()
+        if self._client_root:
+            shutil.rmtree(self._client_root, ignore_errors=True)
 
 
 class HeadNode:

@@ -442,6 +442,14 @@ class NodeManager:
             return None
         return {"size": len(view)}
 
+    def push_object_chunk(self, object_id: bytes, total: int,
+                          offset: int, data: bytes) -> bool:
+        """Receive one chunk of an object pushed by a cross-host client
+        driver (its local store isn't reachable from the cluster, so the
+        primary copy lands here; reference: object_manager Push RPCs)."""
+        return self.store.write_push_chunk(object_id, total, offset,
+                                           data)
+
     def fetch_object_chunk(self, object_id: bytes, offset: int,
                            length: int) -> Optional[bytes]:
         return self.store.read_chunk(object_id, offset, length)

@@ -67,6 +67,8 @@ class ShmStore:
 
     # objects at or below this size go to the native arena when available
     ARENA_MAX_OBJECT = 4 * 1024 * 1024
+    # in-flight pushed objects idle this long are assumed abandoned
+    PUSH_STALE_S = 300.0
 
     def __init__(self, root: str, capacity: Optional[int] = None,
                  spill_dir: Optional[str] = None):
@@ -80,6 +82,13 @@ class ShmStore:
         self._used = 0
         # Sealed mmaps cached per process so repeated gets share one mapping.
         self._mapped: Dict[bytes, _MappedObject] = {}
+        # In-flight pushed objects: id -> {offsets, total, ts}
+        # (offset-keyed so an RPC-level chunk retry can't double-count).
+        # In-flight bytes are reserved against capacity so two concurrent
+        # big pushes can't jointly overfill the tmpfs, and pushes whose
+        # client died mid-stream are purged after PUSH_STALE_S.
+        self._push_progress: Dict[bytes, Dict[str, Any]] = {}
+        self._push_reserved = 0
         # Native C++ arena fastpath (src/shmstore): one mmap shared by all
         # node processes; first process creates, the rest attach.
         self._arena = None
@@ -133,6 +142,66 @@ class ShmStore:
             self._index[object_id] = (len(data), time.monotonic())
             self._used += len(data)
         return len(data)
+
+    def write_push_chunk(self, object_id: bytes, total: int,
+                         offset: int, data: bytes) -> bool:
+        """Assemble an object PUSHED by a remote client, chunk by chunk
+        (the write side of the pull protocol — reference:
+        ``object_manager/push_manager.cc``).  Returns True once every
+        byte arrived and the object sealed."""
+        path = self._path(object_id)
+        tmp = path + ".push"
+        now = time.monotonic()
+        with self._lock:
+            # reap pushes abandoned by a crashed client
+            for oid, st in list(self._push_progress.items()):
+                if now - st["ts"] > self.PUSH_STALE_S:
+                    self._push_progress.pop(oid, None)
+                    self._push_reserved -= st["total"]
+                    try:
+                        os.unlink(self._path(oid) + ".push")
+                    except OSError:
+                        pass
+            if object_id in self._index:        # already sealed: re-push no-op
+                return True
+            st = self._push_progress.get(object_id)
+            fresh = st is None
+            if fresh:
+                st = {"offsets": set(), "total": total, "ts": now}
+                self._push_progress[object_id] = st
+            else:
+                st["ts"] = now
+        if fresh:
+            try:
+                self._ensure_capacity(total)
+                with self._lock:
+                    self._push_reserved += total
+            except Exception:
+                with self._lock:
+                    self._push_progress.pop(object_id, None)
+                raise
+        mode = "w+b" if fresh else "r+b"
+        with open(tmp, mode) as f:
+            if fresh:
+                f.truncate(total)
+            f.seek(offset)
+            f.write(data)
+        with self._lock:
+            st = self._push_progress.get(object_id)
+            if st is None:                       # concurrent sealer won
+                return object_id in self._index
+            st["offsets"].add((offset, len(data)))
+            done = sum(n for _, n in st["offsets"]) >= total
+            if done:
+                self._push_progress.pop(object_id, None)
+                self._push_reserved -= total
+        if done:
+            os.rename(tmp, path)  # seal
+            with self._lock:
+                if object_id not in self._index:
+                    self._index[object_id] = (total, time.monotonic())
+                    self._used += total
+        return done
 
     def put_stream(self, object_id: bytes, size: int, chunks) -> int:
         """Create + seal an object from an iterator of byte chunks.
@@ -248,10 +317,11 @@ class ShmStore:
                 f"object of {need} bytes exceeds store capacity "
                 f"{self.capacity}")
         with self._lock:
-            if self._used + need <= self.capacity:
+            committed = self._used + self._push_reserved
+            if committed + need <= self.capacity:
                 return
             headroom = int(self.capacity * GLOBAL_CONFIG.shm_eviction_headroom)
-            target = self._used + need - self.capacity + headroom
+            target = committed + need - self.capacity + headroom
             victims = sorted(self._index.items(), key=lambda kv: kv[1][1])
         freed = 0
         for oid, (size, _) in victims:
@@ -260,9 +330,10 @@ class ShmStore:
             if self._evict_one(oid):
                 freed += size
         with self._lock:
-            if self._used + need > self.capacity:
+            if self._used + self._push_reserved + need > self.capacity:
                 raise ObjectStoreFullError(
                     f"cannot free {need} bytes (used={self._used}, "
+                    f"in-flight pushes={self._push_reserved}, "
                     f"capacity={self.capacity})")
 
     def _evict_one(self, object_id: bytes) -> bool:
@@ -315,6 +386,11 @@ class ShmStore:
     def release_mappings(self) -> None:
         with self._lock:
             self._mapped.clear()
+
+    def release_mapping(self, object_id: bytes) -> None:
+        """Drop one cached mmap (existing views keep the map alive)."""
+        with self._lock:
+            self._mapped.pop(object_id, None)
 
     def destroy(self) -> None:
         self.release_mappings()

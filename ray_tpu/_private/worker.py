@@ -39,6 +39,10 @@ class CoreWorker:
         self.job_id = job_id
         self.worker_id = worker_id
         self.node_id = node_id
+        # Node advertised as the location of this worker's shm commits.
+        # Differs from node_id only for cross-host attached drivers,
+        # whose puts are mirrored to the head node's store.
+        self.commit_node_id = node_id
         self.cp = control_plane
         self.nm = node_manager
         self.store = shm_store
@@ -121,7 +125,8 @@ class CoreWorker:
                                owner=owner)
         else:
             self.store.put_serialized(oid, sobj)
-            self.cp.commit_shm(oid, sobj.total_bytes, node_id=self.node_id,
+            self.cp.commit_shm(oid, sobj.total_bytes,
+                               node_id=self.commit_node_id,
                                is_error=is_error, owner=owner)
 
     def _fetch_committed(self, oid: bytes, loc: Dict[str, Any]) -> Any:
@@ -351,7 +356,8 @@ class CoreWorker:
                 return Arg(inline=sobj.to_bytes())
             oid = ObjectID.from_random().binary()
             self.store.put_serialized(oid, sobj)
-            self.cp.commit_shm(oid, sobj.total_bytes, node_id=self.node_id,
+            self.cp.commit_shm(oid, sobj.total_bytes,
+                               node_id=self.commit_node_id,
                                owner=self.worker_id.binary())
             return Arg(object_id=oid)
 
