@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
@@ -152,13 +152,25 @@ class ControlPlane:
         self._channels: Dict[str, List[Tuple[int, Any]]] = defaultdict(list)
         self._channel_seq: Dict[str, int] = defaultdict(int)
         self._pub_waiters = _Waiters()
-        # reference counting: per-holder counts + aggregate; an object is
-        # freeable once its aggregate sits at zero past the grace period
-        # (reference: core_worker/reference_count.cc, centralized here)
+        # reference counting FALLBACK for ownerless refs (generator
+        # items, internal ids): per-holder counts + aggregate; an object
+        # is freeable once its aggregate sits at zero past the grace
+        # period.  Owner-governed objects (commit carries owner_addr)
+        # are counted and freed by their owner node manager — the CP
+        # keeps only the directory entry (reference split:
+        # core_worker/reference_count.cc owns counts,
+        # ownership_based_object_directory.cc serves locations).
         self._refs_by_holder: Dict[bytes, Dict[bytes, int]] = defaultdict(
             lambda: defaultdict(int))
         self._ref_totals: Dict[bytes, int] = defaultdict(int)
         self._zero_since: Dict[bytes, float] = {}
+        # holder -> node hosting it, so a whole-node death can purge
+        # every holder that died with it (their NM can't)
+        self._holder_node: Dict[bytes, bytes] = {}
+        # objects whose owner node died, freed after a grace; bounded
+        # ring so late get()s raise OwnerDiedError instead of hanging
+        self._owner_died_tombstones: "OrderedDict[bytes, bool]" = (
+            OrderedDict())
         # lineage: task_id -> TaskSpec for re-execution of lost objects
         # (reference: task_manager.cc lineage + object_recovery_manager)
         self._lineage: Dict[bytes, Any] = {}
@@ -282,31 +294,38 @@ class ControlPlane:
 
     # --------------------------------------------------------- objects ----
     def put_inline(self, object_id: bytes, data: bytes,
-                   is_error: bool = False, owner: bytes = b"") -> None:
+                   is_error: bool = False, owner: bytes = b"",
+                   owner_addr: str = "") -> None:
         with self._lock:
             self._inline_data[object_id] = data
             self._objects[object_id] = {
                 "where": "inline", "size": len(data), "error": is_error,
-                "owner": owner, "commit_time": time.time(),
+                "owner": owner, "owner_addr": owner_addr,
+                "commit_time": time.time(),
             }
-            self._j("put_inline", object_id, data, is_error, owner)
+            self._j("put_inline", object_id, data, is_error, owner,
+                    owner_addr)
         self._object_waiters.notify([object_id])
 
     def commit_shm(self, object_id: bytes, size: int,
                    node_id: bytes = b"", is_error: bool = False,
-                   owner: bytes = b"") -> None:
+                   owner: bytes = b"", owner_addr: str = "") -> None:
         with self._lock:
             self._objects[object_id] = {
                 "where": "shm", "size": size, "node": node_id,
                 "error": is_error, "owner": owner,
+                "owner_addr": owner_addr,
                 "commit_time": time.time(),
             }
-            self._j("commit_shm", object_id, size, node_id, is_error, owner)
+            self._j("commit_shm", object_id, size, node_id, is_error,
+                    owner, owner_addr)
         self._object_waiters.notify([object_id])
 
     def get_location(self, object_id: bytes) -> Optional[Dict[str, Any]]:
         with self._lock:
             loc = self._objects.get(object_id)
+            if loc is None and object_id in self._owner_died_tombstones:
+                return {"where": "tombstone", "owner_died": True}
             return dict(loc) if loc else None
 
     def get_inline(self, object_id: bytes) -> Optional[bytes]:
@@ -322,10 +341,15 @@ class ControlPlane:
     def get_locations(self, object_ids: List[bytes]
                       ) -> Dict[bytes, Optional[Dict[str, Any]]]:
         """Bulk location lookup (one RPC for a whole dependency set)."""
+        def loc(o: bytes):
+            if o in self._objects:
+                return dict(self._objects[o])
+            if o in self._owner_died_tombstones:
+                return {"where": "tombstone", "owner_died": True}
+            return None
+
         with self._lock:
-            return {bytes(o): (dict(self._objects[bytes(o)])
-                               if bytes(o) in self._objects else None)
-                    for o in object_ids}
+            return {bytes(o): loc(bytes(o)) for o in object_ids}
 
     def kick_waiters(self, key: bytes) -> None:
         """Wake a ``wait_any(..., kick=key)`` blocked on stale ids.
@@ -353,7 +377,11 @@ class ControlPlane:
         w = self._object_waiters.register(keys)
         try:
             with self._lock:
-                done = [o for o in ids if o in self._objects]
+                # tombstoned (owner-died, already freed) ids count as
+                # ready: the subsequent get() raises OwnerDiedError
+                # instead of the wait hanging forever
+                done = [o for o in ids if o in self._objects
+                        or o in self._owner_died_tombstones]
             remaining = set(ids) - set(done)
             while len(done) < num_returns and remaining:
                 wait_t = 1.0
@@ -371,7 +399,8 @@ class ControlPlane:
                     check = [o for o in fired if o in remaining]
                 if check:
                     with self._lock:
-                        newly = [o for o in check if o in self._objects]
+                        newly = [o for o in check if o in self._objects
+                                 or o in self._owner_died_tombstones]
                     done.extend(newly)
                     remaining.difference_update(newly)
                 if kick_key is not None and kick_key in fired:
@@ -393,10 +422,33 @@ class ControlPlane:
                 self._j("free_objects", [bytes(o) for o in object_ids])
         return freed
 
+    def free_owned(self, object_ids: List[bytes]) -> Dict[str, List[bytes]]:
+        """Drop directory entries for objects freed by their OWNER node
+        manager (the owner holds the refcounts; the CP is only the
+        directory).  Ids not committed yet are returned as ``pending``
+        so the owner keeps them on its zero list."""
+        freed: List[bytes] = []
+        pending: List[bytes] = []
+        with self._lock:
+            for o in object_ids:
+                o = bytes(o)
+                if o in self._objects:
+                    self._objects.pop(o, None)
+                    self._inline_data.pop(o, None)
+                    freed.append(o)
+                else:
+                    pending.append(o)
+            if freed:
+                self._j("free_objects", freed)
+        return {"freed": freed, "pending": pending}
+
     # ------------------------------------------------ refcounting / GC ----
-    def update_refs(self, holder_id: bytes, deltas: Dict[bytes, int]) -> None:
+    def update_refs(self, holder_id: bytes, deltas: Dict[bytes, int],
+                    holder_node: bytes = b"") -> None:
         now = time.time()
         with self._lock:
+            if holder_node:
+                self._holder_node[holder_id] = holder_node
             held = self._refs_by_holder[holder_id]
             for oid, d in deltas.items():
                 oid = bytes(oid)
@@ -422,12 +474,23 @@ class ControlPlane:
         """Drop every count contributed by a dead holder (worker/pin)."""
         with self._lock:
             held = self._refs_by_holder.pop(holder_id, None)
+            self._holder_node.pop(holder_id, None)
         if held:
             # re-apply as negative deltas under a synthetic holder so the
             # totals/zero bookkeeping stays in one code path
             self.update_refs(b"_purge", {o: -d for o, d in held.items()})
             with self._lock:
                 self._refs_by_holder.pop(b"_purge", None)
+
+    def purge_node_holders(self, node_id: bytes) -> None:
+        """Drop the contributions of every holder (worker/driver) that
+        lived on a dead node — its NM died with it and can never send
+        the per-worker purge itself."""
+        with self._lock:
+            victims = [h for h, n in self._holder_node.items()
+                       if n == node_id]
+        for h in victims:
+            self.purge_holder(h)
 
     def gc_sweep(self, grace_s: float = 2.0) -> List[bytes]:
         """Free committed objects unreferenced for longer than the grace.
@@ -439,12 +502,28 @@ class ControlPlane:
         """
         cutoff = time.time() - grace_s
         with self._lock:
-            victims = [oid for oid, t0 in self._zero_since.items()
-                       if t0 < cutoff and oid in self._objects]
+            victims = []
+            for oid, t0 in self._zero_since.items():
+                if t0 >= cutoff:
+                    continue
+                info = self._objects.get(oid)
+                if info is None:
+                    continue
+                # owner-governed objects are freed by their owner NM,
+                # not here — a stray CP-side zero mark (e.g. a transient
+                # bare ref) must not free an object with live owner-side
+                # refs.  Owner death turns governance back to the CP.
+                if info.get("owner_addr") and not info.get("owner_died"):
+                    continue
+                victims.append(oid)
             for oid in victims:
-                self._objects.pop(oid, None)
+                info = self._objects.pop(oid, None)
                 self._inline_data.pop(oid, None)
                 self._zero_since.pop(oid, None)
+                if info is not None and info.get("owner_died"):
+                    self._owner_died_tombstones[oid] = True
+                    while len(self._owner_died_tombstones) > 10000:
+                        self._owner_died_tombstones.popitem(last=False)
             if victims:
                 self._j("free_objects", victims)
             # forget zero-marks for ids that were never committed
@@ -581,6 +660,19 @@ class ControlPlane:
             info["state"] = "DEAD"
             info["death_reason"] = reason
             self._j("mark_node_dead", node_id, reason)
+            # Objects OWNED by the dead node lose their refcounter:
+            # mark them owner_died (get() raises OwnerDiedError or
+            # recovers via lineage) and hand lifetime back to the CP
+            # sweep, which frees them after the grace and leaves a
+            # tombstone (reference: owner fate-sharing,
+            # core_worker/reference_count.cc OwnerDied).
+            dead_addr = info.get("sock_path")
+            if dead_addr:
+                now = time.time()
+                for oid, entry in self._objects.items():
+                    if entry.get("owner_addr") == dead_addr:
+                        entry["owner_died"] = True
+                        self._zero_since.setdefault(oid, now)
         self.publish("nodes", {"event": "dead", "node_id": node_id.hex()})
 
     def list_nodes(self) -> List[Dict[str, Any]]:

@@ -263,13 +263,15 @@ class AttachedNode:
             mode="driver", job_id=self.job_id,
             worker_id=WorkerID.from_random(), node_id=self.node_id,
             control_plane=cp, node_manager=nm, shm_store=store,
-            session_dir=self.session_dir, namespace=namespace)
+            session_dir=self.session_dir, namespace=namespace,
+            nm_addr=head["sock_path"])
         if remote_host:
             # puts are mirrored to the head's store: advertise THAT as
             # the committed location so cluster workers pull from it
             self.worker.commit_node_id = head["node_id"]
         from ray_tpu._private.ref_tracker import install_tracker
-        install_tracker(self.worker.worker_id.binary(), cp)
+        install_tracker(self.worker.worker_id.binary(), cp,
+                        node_id=self.node_id)
         self.log_monitor = None
         if GLOBAL_CONFIG.log_to_driver:
             from ray_tpu._private.log_streaming import DriverLogMonitor
@@ -359,9 +361,11 @@ class HeadNode:
             worker_id=WorkerID.from_random(), node_id=self.node_id,
             control_plane=self.control_plane,
             node_manager=self.node_manager, shm_store=self.store,
-            session_dir=self.session_dir, namespace=namespace)
+            session_dir=self.session_dir, namespace=namespace,
+            nm_addr=self.node_manager.sock_path)
         from ray_tpu._private.ref_tracker import install_tracker
-        install_tracker(self.worker.worker_id.binary(), self.control_plane)
+        install_tracker(self.worker.worker_id.binary(),
+                        self.control_plane, node_id=self.node_id)
         self._extra_nodes: list = []
         self._stopped = False
         self._health_thread = threading.Thread(
@@ -471,6 +475,20 @@ class HeadNode:
         """
         cp = self.control_plane
         dead_hex = node_id.hex()
+        # 0. refcounts: the dead node's workers flushed counts to the CP
+        # and to owner NMs cluster-wide; their own NM died before it
+        # could purge them, so the head broadcasts the purge
+        cp.purge_node_holders(node_id)
+        self.node_manager.purge_owned_node_holders(node_id)
+        for info in cp.list_nodes():
+            if (info.get("state") != "ALIVE"
+                    or info["node_id"] == self.node_id):
+                continue
+            try:
+                protocol.RpcClient(info["sock_path"]).call(
+                    "purge_owned_node_holders", node_id)
+            except (OSError, ConnectionError):
+                pass
         # 1. actors hosted on the dead node: restart elsewhere or kill
         for info in cp.list_actors():
             if info.get("node_id") != node_id:

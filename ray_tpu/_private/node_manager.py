@@ -237,6 +237,22 @@ class NodeManager:
         self._worker_chips: Dict[bytes, List[int]] = {}
         # remote node manager clients (for spillback / actor routing)
         self._peers: Dict[bytes, protocol.RpcClient] = {}
+        # ---- owned-object reference counts (decentralized ownership,
+        # reference: core_worker/reference_count.cc).  This NM owns the
+        # lifetime of every object created by its workers/driver; ref
+        # holders anywhere in the cluster flush +1/-1 deltas HERE (the
+        # CP is out of the per-ref hot path) and _owner_sweep frees
+        # owned objects unreferenced past the grace period.
+        self._owner_lock = threading.Lock()
+        self._owner_by_holder: Dict[bytes, Dict[bytes, int]] = (
+            defaultdict(lambda: defaultdict(int)))
+        self._owner_totals: Dict[bytes, int] = {}
+        self._owner_zero_since: Dict[bytes, float] = {}
+        # holder -> node hosting it: a whole-node death purges every
+        # holder that died with it (its own NM can't send the purge)
+        self._owner_holder_node: Dict[bytes, bytes] = {}
+        self._owner_peers: Dict[str, protocol.RpcClient] = {}
+        self._last_owner_sweep = time.time()
 
         self.cp.register_node(node_id, {
             "ip": node_ip,
@@ -273,20 +289,131 @@ class NodeManager:
         The pin is a refcount held under a per-task holder id, purged when
         the task reaches a terminal state (reference: the submitting
         worker's reference_count.cc holds deps until the task completes).
+        Pins route to each dependency's OWNER node manager
+        (``spec.ref_owners``); ownerless deps pin at the control plane.
         """
         deps = spec.dependencies()
-        if deps:
-            try:
-                self.cp.update_refs(b"task:" + spec.task_id,
-                                    {d: 1 for d in deps})
-            except Exception:  # noqa: BLE001
-                pass
+        if not deps:
+            return
+        from ray_tpu._private import owner_routing
+        owner_routing.route_updates(
+            self.cp, self._owner_peer, b"task:" + spec.task_id,
+            owner_routing.bucket_by_owner({d: 1 for d in deps},
+                                          spec.ref_owners.get),
+            holder_node=self.node_id,
+            local_addr=self.sock_path, local=self.update_owned_refs)
 
     def _unpin_dependencies(self, spec: TaskSpec) -> None:
-        if spec.dependencies():
+        deps = spec.dependencies()
+        if not deps:
+            return
+        from ray_tpu._private import owner_routing
+        owner_routing.route_purge(
+            self.cp, self._owner_peer, b"task:" + spec.task_id,
+            {spec.ref_owners.get(d) for d in deps},
+            local_addr=self.sock_path, local=self.purge_owned_holder)
+
+    # ------------------------------------------------------------------
+    # Owned-object refcounting (this NM = owner).  RPC surface used by
+    # ref trackers, pinning NMs, and caller-side pre-pins cluster-wide.
+    # ------------------------------------------------------------------
+    def _owner_peer(self, addr: str) -> protocol.RpcClient:
+        client = self._owner_peers.get(addr)
+        if client is None:
+            client = protocol.RpcClient(addr)
+            self._owner_peers[addr] = client
+        return client
+
+    def update_owned_refs(self, holder_id: bytes,
+                          deltas: Dict[bytes, int],
+                          holder_node: bytes = b"") -> None:
+        now = time.time()
+        with self._owner_lock:
+            if holder_node:
+                self._owner_holder_node[holder_id] = holder_node
+            held = self._owner_by_holder[holder_id]
+            for oid, d in deltas.items():
+                oid = bytes(oid)
+                held[oid] += d
+                if held[oid] == 0:
+                    held.pop(oid)
+                total = self._owner_totals.get(oid, 0) + d
+                if total > 0:
+                    self._owner_totals[oid] = total
+                    self._owner_zero_since.pop(oid, None)
+                else:
+                    # net<=0: born-and-dropped within one flush window,
+                    # or a drop against untracked state — either way the
+                    # object is now unreferenced
+                    self._owner_totals.pop(oid, None)
+                    self._owner_zero_since.setdefault(oid, now)
+            if not held:
+                self._owner_by_holder.pop(holder_id, None)
+
+    def purge_owned_holder(self, holder_id: bytes) -> None:
+        """Drop every count a (finished task / dead process) holder
+        contributed to objects owned here."""
+        with self._owner_lock:
+            held = self._owner_by_holder.pop(holder_id, None)
+            self._owner_holder_node.pop(holder_id, None)
+        if held:
+            self.update_owned_refs(b"_purge",
+                                   {o: -d for o, d in held.items()})
+            with self._owner_lock:
+                self._owner_by_holder.pop(b"_purge", None)
+
+    def purge_owned_node_holders(self, node_id: bytes) -> None:
+        """A whole node died: drop every contribution flushed here by
+        holders that lived on it (their NM died with them; the head
+        broadcasts this from its node-death handler)."""
+        with self._owner_lock:
+            victims = [h for h, n in self._owner_holder_node.items()
+                       if n == node_id]
+        for h in victims:
+            self.purge_owned_holder(h)
+
+    def owned_refs_summary(self) -> Dict[str, int]:
+        with self._owner_lock:
+            return {"tracked_objects": len(self._owner_totals),
+                    "holders": len(self._owner_by_holder),
+                    "zero_pending": len(self._owner_zero_since)}
+
+    def _owner_sweep(self) -> None:
+        """Free owned objects unreferenced past the grace period: drop
+        their directory entries at the CP in one batch, then fan the shm
+        deletion out to every node (the owner drives GC; the CP is
+        touched once per object lifetime, not per ref event)."""
+        grace = GLOBAL_CONFIG.object_gc_grace_s
+        now = time.time()
+        cutoff = now - grace
+        with self._owner_lock:
+            victims = [o for o, t0 in self._owner_zero_since.items()
+                       if t0 < cutoff]
+        if not victims:
+            return
+        res = self.cp.free_owned(victims)
+        freed = res["freed"]
+        with self._owner_lock:
+            for o in freed:
+                self._owner_zero_since.pop(o, None)
+                self._owner_totals.pop(o, None)
+            # ids never committed: keep briefly (commit may be in
+            # flight), forget zero-marks that stayed uncommitted long
+            # past the grace
+            for o in res["pending"]:
+                if self._owner_zero_since.get(o, now) < cutoff - 60.0:
+                    self._owner_zero_since.pop(o, None)
+        if not freed:
+            return
+        self.delete_objects(freed)
+        for info in self.cp.list_nodes():
+            if (info.get("state") != "ALIVE"
+                    or info["node_id"] == self.node_id):
+                continue
             try:
-                self.cp.purge_holder(b"task:" + spec.task_id)
-            except Exception:  # noqa: BLE001
+                self._owner_peer(info["sock_path"]).call(
+                    "delete_objects", freed)
+            except (OSError, ConnectionError):
                 pass
 
     def submit_task(self, spec: TaskSpec) -> None:
@@ -536,8 +663,9 @@ class NodeManager:
                         def commit_error(spec=spec,
                                          payload=msg["error_payload"]):
                             for oid in spec.return_object_ids():
-                                self.cp.put_inline(oid, payload,
-                                                   is_error=True)
+                                self.cp.put_inline(
+                                    oid, payload, is_error=True,
+                                    owner_addr=spec.owner_addr)
                             self._fail_generator_stream(spec, payload)
                         self._cp_effect_or_defer(commit_error)
                 with self._lock:
@@ -962,8 +1090,20 @@ class NodeManager:
             worker.current_task = None
             actor_id = worker.actor_id
         try:
-            # drop the dead process's refcount contributions wholesale
+            # drop the dead process's refcount contributions wholesale —
+            # at the CP (ownerless refs) and at every owner NM the dead
+            # worker may have flushed deltas to
             self.cp.purge_holder(worker.worker_id)
+            self.purge_owned_holder(worker.worker_id)
+            for info in self.cp.list_nodes():
+                if (info.get("state") != "ALIVE"
+                        or info["node_id"] == self.node_id):
+                    continue
+                try:
+                    self._owner_peer(info["sock_path"]).call(
+                        "purge_owned_holder", worker.worker_id)
+                except (OSError, ConnectionError):
+                    pass
         except Exception:  # noqa: BLE001
             pass
         if prev_state == "starting":
@@ -1065,7 +1205,8 @@ class NodeManager:
         data = serialization.dumps(err)
         for oid in spec.return_object_ids():
             if self.cp.get_location(oid) is None:
-                self.cp.put_inline(oid, data, is_error=True)
+                self.cp.put_inline(oid, data, is_error=True,
+                                   owner_addr=spec.owner_addr)
         self._fail_generator_stream(spec, data)
         self.cp.add_task_event({"task_id": spec.task_id.hex(),
                                 "state": "FAILED",
@@ -1233,6 +1374,13 @@ class NodeManager:
             except Exception:  # noqa: BLE001
                 pass
             self._drain_deferred_cp()
+            if (time.time() - self._last_owner_sweep
+                    >= GLOBAL_CONFIG.object_gc_period_s):
+                self._last_owner_sweep = time.time()
+                try:
+                    self._owner_sweep()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def stop(self):
         if self._stopped.is_set():

@@ -1,0 +1,57 @@
+"""Shared owner-routing for refcount traffic.
+
+One implementation of the route-by-owner rule used by the ref tracker,
+the node manager's dependency pins, and the core worker's caller-side
+pre-pins: deltas for an object go to its OWNER node manager
+(``update_owned_refs``); ownerless objects fall back to the control
+plane (``update_refs``).  Failures are swallowed — a dead owner's
+objects are freed by the owner-death path, so the lost delta is moot.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+
+def bucket_by_owner(deltas: Dict[bytes, int],
+                    owner_of: Callable[[bytes], Optional[str]]
+                    ) -> Dict[Optional[str], Dict[bytes, int]]:
+    out: Dict[Optional[str], Dict[bytes, int]] = {}
+    for oid, d in deltas.items():
+        out.setdefault(owner_of(oid), {})[oid] = d
+    return out
+
+
+def route_updates(cp, peer: Callable[[str], object], holder: bytes,
+                  by_owner: Dict[Optional[str], Dict[bytes, int]],
+                  holder_node: bytes = b"",
+                  local_addr: str = "", local=None) -> None:
+    """Send each owner bucket to its counter.  ``local_addr``/``local``
+    short-circuit the bucket addressed to the caller itself (a node
+    manager routing pins to objects it owns)."""
+    for addr, deltas in by_owner.items():
+        try:
+            if addr is None:
+                cp.update_refs(holder, deltas, holder_node)
+            elif local is not None and addr == local_addr:
+                local(holder, deltas, holder_node)
+            else:
+                peer(addr).call("update_owned_refs", holder, deltas,
+                                holder_node)
+        except Exception:  # noqa: BLE001 - dead owner: freed by
+            pass           # the owner-death path anyway
+
+
+def route_purge(cp, peer: Callable[[str], object], holder: bytes,
+                addrs: Iterable[Optional[str]],
+                local_addr: str = "", local=None) -> None:
+    for addr in set(addrs):
+        try:
+            if addr is None:
+                cp.purge_holder(holder)
+            elif local is not None and addr == local_addr:
+                local(holder)
+            else:
+                peer(addr).call("purge_owned_holder", holder)
+        except Exception:  # noqa: BLE001
+            pass

@@ -2,24 +2,26 @@
 
 TPU-native counterpart of the owner-side reference counter in the
 reference core worker (``src/ray/core_worker/reference_count.cc``,
-1.6k LoC).  Design difference, on purpose: ownership bookkeeping is
-centralized in the control plane (which already holds the object
-directory), so each process only aggregates +1/-1 deltas from
-``ObjectRef.__init__``/``__del__`` and flushes them in batches.  The
-control plane frees objects whose aggregate count sits at zero past a
-grace period (``control_plane.gc_sweep``); the grace covers the handoff
-window where a ref is serialized into a task spec before the node
-manager's dependency pin lands.
+1.6k LoC).  Ownership is decentralized at NODE granularity: the object's
+owner is the node manager of the process that created the ref (put /
+task submission), its address rides every pickled ref, and each process
+aggregates +1/-1 deltas from ``ObjectRef.__init__``/``__del__`` and
+flushes them in batches DIRECTLY to the owner node manager — the
+control plane is out of the per-ref hot path and keeps only the object
+directory.  The owner frees objects whose aggregate count sits at zero
+past a grace period (``node_manager.NodeManager._owner_sweep``); refs
+with no owner address (internal ids, e.g. generator items) fall back to
+the control plane's centralized counter (``control_plane.gc_sweep``),
+which also covers pre-ownership sessions.
 
-Per-process deltas are keyed by this process's holder id so the control
-plane can drop a crashed process's contributions wholesale
-(``purge_holder``) instead of leaking positive counts forever.
+Per-process deltas are keyed by this process's holder id so an owner
+can drop a crashed process's contributions wholesale (``purge_holder``
+/ ``purge_owned_holder``) instead of leaking positive counts forever.
 """
 
 from __future__ import annotations
 
 import atexit
-import os
 import threading
 from collections import defaultdict
 from typing import Dict, Optional
@@ -27,11 +29,19 @@ from typing import Dict, Optional
 
 class RefTracker:
     def __init__(self, holder_id: bytes, control_plane,
-                 flush_interval: float = 0.2):
+                 node_id: bytes = b"", flush_interval: float = 0.2):
         self.holder_id = holder_id
         self.cp = control_plane
+        self.node_id = node_id
         self._lock = threading.Lock()
         self._deltas: Dict[bytes, int] = defaultdict(int)
+        # object id -> owner NM address (first binding wins so +1/-1 for
+        # one object always route to the same counter); None = CP
+        self._owner_of: Dict[bytes, Optional[str]] = {}
+        # cumulative live count per object in THIS process: lets us
+        # forget the owner binding once the last local ref is flushed
+        self._live: Dict[bytes, int] = defaultdict(int)
+        self._owner_clients: Dict[str, object] = {}
         self._dirty = threading.Event()
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._flush_loop,
@@ -40,25 +50,44 @@ class RefTracker:
         self._flush_interval = flush_interval
         atexit.register(self.flush)
 
-    def add(self, object_id: bytes, delta: int) -> None:
+    def add(self, object_id: bytes, delta: int,
+            owner: Optional[str] = None) -> None:
         with self._lock:
             self._deltas[object_id] += delta
+            self._owner_of.setdefault(object_id, owner)
+            self._live[object_id] += delta
+            if self._live[object_id] == 0:
+                self._live.pop(object_id)
         self._dirty.set()
+
+    def _owner_client(self, addr: str):
+        client = self._owner_clients.get(addr)
+        if client is None:
+            from ray_tpu._private.protocol import RpcClient
+            client = RpcClient(addr)
+            self._owner_clients[addr] = client
+        return client
 
     def flush(self) -> None:
         with self._lock:
             if not self._deltas:
                 return
             # Zero-net entries are KEPT: a ref created and dropped within
-            # one flush window nets to 0, but the control plane must still
+            # one flush window nets to 0, but the counter must still
             # learn the object was tracked and is now unreferenced
             # (otherwise it never becomes eligible for GC).
             batch = dict(self._deltas)
             self._deltas.clear()
-        try:
-            self.cp.update_refs(self.holder_id, batch)
-        except Exception:  # noqa: BLE001 - cp may be shutting down
-            pass
+            owners = {oid: self._owner_of.get(oid) for oid in batch}
+            # forget bindings whose last local ref is in this batch
+            for oid in batch:
+                if oid not in self._live:
+                    self._owner_of.pop(oid, None)
+        from ray_tpu._private import owner_routing
+        owner_routing.route_updates(
+            self.cp, self._owner_client, self.holder_id,
+            owner_routing.bucket_by_owner(batch, owners.get),
+            holder_node=self.node_id)
 
     def _flush_loop(self) -> None:
         while not self._stopped.is_set():
@@ -72,18 +101,31 @@ class RefTracker:
         self._stopped.set()
         self._dirty.set()
         self.flush()
+        # clean detach: release every count this process still holds —
+        # at the CP and at every owner NM it ever flushed to (nothing
+        # else purges a cleanly-exiting driver's holder id)
+        from ray_tpu._private import owner_routing
+        owner_routing.route_purge(
+            self.cp, self._owner_client, self.holder_id,
+            list(self._owner_clients.keys()) + [None])
+        for client in self._owner_clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 _tracker: Optional[RefTracker] = None
 _tracker_lock = threading.Lock()
 
 
-def install_tracker(holder_id: bytes, control_plane) -> RefTracker:
+def install_tracker(holder_id: bytes, control_plane,
+                    node_id: bytes = b"") -> RefTracker:
     global _tracker
     with _tracker_lock:
         if _tracker is not None:
             _tracker.stop()
-        _tracker = RefTracker(holder_id, control_plane)
+        _tracker = RefTracker(holder_id, control_plane, node_id)
         return _tracker
 
 
@@ -95,12 +137,12 @@ def uninstall_tracker() -> None:
             _tracker = None
 
 
-def track_ref(object_id: bytes) -> bool:
+def track_ref(object_id: bytes, owner: Optional[str] = None) -> bool:
     """+1 for a newly constructed ObjectRef. Returns whether counted."""
     t = _tracker
     if t is None:
         return False
-    t.add(object_id, +1)
+    t.add(object_id, +1, owner)
     return True
 
 
@@ -108,3 +150,12 @@ def untrack_ref(object_id: bytes) -> None:
     t = _tracker
     if t is not None:
         t.add(object_id, -1)
+
+
+def rebind_ref(object_id: bytes, owner: Optional[str]) -> None:
+    """Re-route future deltas for an object to a NEW owner (ownership
+    adoption after owner-death recovery)."""
+    t = _tracker
+    if t is not None:
+        with t._lock:
+            t._owner_of[object_id] = owner

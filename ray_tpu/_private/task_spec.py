@@ -63,6 +63,13 @@ class TaskSpec:
     is_generator: bool = False
     # Owner (submitting worker) for lineage/debugging
     owner_id: bytes = b""
+    # RPC address of the submitting worker's node manager: return
+    # objects are refcounted there (node-granularity ownership;
+    # reference: caller-owned returns in reference_count.cc)
+    owner_addr: str = ""
+    # Owner address per ObjectRef argument, so dependency pins route to
+    # each dep's owner instead of the control plane
+    ref_owners: Dict[bytes, str] = field(default_factory=dict)
     # Runtime env / accelerator visibility
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     # Depth for hybrid-policy tie-breaking; parent task id for lineage

@@ -33,12 +33,16 @@ class CoreWorker:
     def __init__(self, mode: str, job_id: JobID, worker_id: WorkerID,
                  node_id: bytes, control_plane, node_manager, shm_store,
                  session_dir: str, namespace: str = "default",
-                 nm_notify=None):
+                 nm_notify=None, nm_addr: str = ""):
         assert mode in ("driver", "worker")
         self.mode = mode
         self.job_id = job_id
         self.worker_id = worker_id
         self.node_id = node_id
+        # RPC address of this worker's node manager: the OWNER of every
+        # object this worker creates (node-granularity ownership;
+        # reference: reference_count.cc owner = creating worker)
+        self.nm_addr = nm_addr
         # Node advertised as the location of this worker's shm commits.
         # Differs from node_id only for cross-host attached drivers,
         # whose puts are mirrored to the head node's store.
@@ -113,21 +117,27 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random().binary()
-        self.put_object(oid, value)
-        return ObjectRef(oid)
+        self.put_object(oid, value, owner_addr=self.nm_addr)
+        return ObjectRef(oid, self.nm_addr or None)
 
     def put_object(self, oid: bytes, value: Any,
-                   is_error: bool = False) -> None:
+                   is_error: bool = False,
+                   owner_addr: Optional[str] = None) -> None:
+        """Commit a value under ``oid``.  ``owner_addr`` is the node
+        manager owning the object's lifetime (the caller's NM for task
+        returns, ours for puts); empty/None commits a CP-governed object
+        (centralized refcounting fallback)."""
         sobj = serialization.serialize(value)
         owner = self.worker_id.binary()
         if sobj.total_bytes <= GLOBAL_CONFIG.inline_object_max_bytes:
             self.cp.put_inline(oid, sobj.to_bytes(), is_error=is_error,
-                               owner=owner)
+                               owner=owner, owner_addr=owner_addr or "")
         else:
             self.store.put_serialized(oid, sobj)
             self.cp.commit_shm(oid, sobj.total_bytes,
                                node_id=self.commit_node_id,
-                               is_error=is_error, owner=owner)
+                               is_error=is_error, owner=owner,
+                               owner_addr=owner_addr or "")
 
     def _fetch_committed(self, oid: bytes, loc: Dict[str, Any]) -> Any:
         if loc["where"] == "inline":
@@ -197,8 +207,8 @@ class CoreWorker:
     # re-executing the deterministic task that created it; return ids are
     # derived from the task id, so the re-execution commits the same ids.
     # ------------------------------------------------------------------
-    def _recover_object(self, oid: bytes,
-                        attempts: int = 3) -> Dict[str, Any]:
+    def _recover_object(self, oid: bytes, attempts: int = 3,
+                        adopt: bool = False) -> Dict[str, Any]:
         from ray_tpu.exceptions import ObjectLostError
         task_id = oid[: TaskID.SIZE]
         for _ in range(attempts):
@@ -208,6 +218,15 @@ class CoreWorker:
                     oid.hex(), "no lineage to reconstruct (ray.put "
                     "objects and actor-task returns are not "
                     "reconstructible)")
+            if adopt:
+                # owner-death recovery: recommitting under the dead
+                # owner address would leak the recomputed copy, so this
+                # worker's NM adopts ownership and we register OUR ref
+                # there (rebinding the local tracker so the eventual -1
+                # routes the same way).  Other borrowers still pointing
+                # at the dead owner can re-trigger recovery — at-least-
+                # once, never a leak.
+                spec.owner_addr = self.nm_addr
             # invalidate the stale location so waiters block on the
             # re-execution's commit instead of re-reading the dead copy
             self.cp.free_objects([oid])
@@ -217,8 +236,32 @@ class CoreWorker:
                 self.nm.submit_task(spec)
             loc = self.cp.wait_object(oid, 300.0)
             if loc is not None:
+                if adopt and self.nm_addr:
+                    try:
+                        self._nm_peer(self.nm_addr).call(
+                            "update_owned_refs", self.worker_id.binary(),
+                            {oid: 1}, self.node_id)
+                        from ray_tpu._private.ref_tracker import rebind_ref
+                        rebind_ref(oid, self.nm_addr)
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
                 return loc
         raise ObjectLostError(oid.hex(), "reconstruction failed")
+
+    def _handle_owner_died(self, oid: bytes) -> Dict[str, Any]:
+        """The node owning ``oid``'s refcount died.  Task returns are
+        recomputed through lineage (and adopted by this worker's owner);
+        ``put`` objects fate-share with their owner (reference:
+        OwnerDiedError semantics in ``python/ray/exceptions.py``)."""
+        from ray_tpu.exceptions import ObjectLostError, OwnerDiedError
+        try:
+            return self._recover_object(oid, adopt=True)
+        except OwnerDiedError:
+            raise
+        except ObjectLostError:
+            raise OwnerDiedError(
+                oid.hex(), "the node owning this object died and it "
+                "has no lineage to reconstruct") from None
 
     def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]],
             timeout: Optional[float] = None) -> Any:
@@ -249,6 +292,8 @@ class CoreWorker:
             loc = self.cp.get_location(o)
             if loc is None:
                 raise GetTimeoutError(f"object {o.hex()} not available")
+            if loc.get("owner_died"):
+                loc = self._handle_owner_died(o)
             try:
                 value = self._fetch_committed(o, loc)
             except KeyError:
@@ -344,9 +389,14 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def _serialize_args(self, args: Sequence[Any],
                         kwargs: Dict[str, Any]) -> Tuple[List[Arg],
-                                                         Dict[str, Arg]]:
+                                                         Dict[str, Arg],
+                                                         Dict[bytes, str]]:
+        ref_owners: Dict[bytes, str] = {}
+
         def one(value: Any) -> Arg:
             if isinstance(value, ObjectRef):
+                if value.owner_addr():
+                    ref_owners[value.binary()] = value.owner_addr()
                 return Arg(object_id=value.binary())
             if isinstance(value, ObjectRefGenerator):
                 raise TypeError(
@@ -358,10 +408,15 @@ class CoreWorker:
             self.store.put_serialized(oid, sobj)
             self.cp.commit_shm(oid, sobj.total_bytes,
                                node_id=self.commit_node_id,
-                               owner=self.worker_id.binary())
+                               owner=self.worker_id.binary(),
+                               owner_addr=self.nm_addr)
+            if self.nm_addr:
+                ref_owners[oid] = self.nm_addr
             return Arg(object_id=oid)
 
-        return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
+        ser_args = [one(a) for a in args]
+        ser_kwargs = {k: one(v) for k, v in kwargs.items()}
+        return ser_args, ser_kwargs, ref_owners
 
     def submit_task(self, fn, args: Sequence[Any], kwargs: Dict[str, Any],
                     opts: Dict[str, Any]) -> Union[ObjectRef,
@@ -371,7 +426,8 @@ class CoreWorker:
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         task_id = TaskID.for_normal_task(self.job_id)
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        ser_args, ser_kwargs, ref_owners = self._serialize_args(
+            args, kwargs)
         spec = TaskSpec(
             task_id=task_id.binary(), job_id=self.job_id.binary(),
             name=opts.get("name") or getattr(fn, "__qualname__", "task"),
@@ -385,13 +441,15 @@ class CoreWorker:
                 "scheduling_strategy") or SchedulingStrategy(),
             is_generator=streaming,
             owner_id=self.worker_id.binary(),
+            owner_addr=self.nm_addr, ref_owners=ref_owners,
             runtime_env=opts.get("runtime_env") or {},
             parent_task_id=self.current_task_id,
         )
         self.nm.submit_task(spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
-        refs = [ObjectRef(o) for o in spec.return_object_ids()]
+        refs = [ObjectRef(o, self.nm_addr or None)
+                for o in spec.return_object_ids()]
         return refs[0] if num_returns == 1 else refs
 
     # ------------------------------------------------------------------
@@ -402,7 +460,8 @@ class CoreWorker:
         cls_key = self.register_function(cls, prefix=b"cls:")
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        ser_args, ser_kwargs, ref_owners = self._serialize_args(
+            args, kwargs)
         name = opts.get("name")
         spec = TaskSpec(
             task_id=task_id.binary(), job_id=self.job_id.binary(),
@@ -416,6 +475,7 @@ class CoreWorker:
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             owner_id=self.worker_id.binary(),
+            owner_addr=self.nm_addr, ref_owners=ref_owners,
             runtime_env=opts.get("runtime_env") or {},
         )
         self.cp.register_actor(actor_id.binary(), {
@@ -475,7 +535,8 @@ class CoreWorker:
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         task_id = TaskID.for_actor_task(ActorID(actor_id))
-        ser_args, ser_kwargs = self._serialize_args(args, kwargs)
+        ser_args, ser_kwargs, ref_owners = self._serialize_args(
+            args, kwargs)
         with self._seq_lock:
             seq = self._actor_seq.get(actor_id, 0)
             self._actor_seq[actor_id] = seq + 1
@@ -488,26 +549,36 @@ class CoreWorker:
             seq_no=seq, is_generator=streaming,
             max_task_retries=opts.get("max_task_retries", 0),
             owner_id=self.worker_id.binary(),
+            owner_addr=self.nm_addr, ref_owners=ref_owners,
         )
         # Pin arg objects from the moment of submission.  A call made
         # while the actor is still PENDING sits in the caller-side
         # buffer where the node manager's pin (submit_actor_task)
         # doesn't exist yet — if the caller drops its ObjectRefs in that
         # window, GC frees the args and the task hangs resolving them.
-        # purge_holder clears the whole "task:" holder at completion, so
-        # the node manager re-pinning the same holder is harmless.
+        # purge clears the whole "task:" holder at completion, so the
+        # node manager re-pinning the same holder is harmless.  Pins
+        # route to each dep's owner, like the ref tracker's deltas.
         deps = spec.dependencies()
         if deps:
-            try:
-                self.cp.update_refs(b"task:" + spec.task_id,
-                                    {d: 1 for d in deps})
-            except Exception:  # noqa: BLE001
-                pass
+            self._update_pins(b"task:" + spec.task_id,
+                              {d: 1 for d in deps}, spec.ref_owners)
         self._route_or_buffer(spec, streaming)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
-        refs = [ObjectRef(o) for o in spec.return_object_ids()]
+        refs = [ObjectRef(o, self.nm_addr or None)
+                for o in spec.return_object_ids()]
         return refs[0] if num_returns == 1 else refs
+
+    def _update_pins(self, holder: bytes, deltas: Dict[bytes, int],
+                     ref_owners: Dict[bytes, str]) -> None:
+        """Apply pin refcount deltas at each object's owner (CP for
+        ownerless objects)."""
+        from ray_tpu._private import owner_routing
+        owner_routing.route_updates(
+            self.cp, self._nm_peer, holder,
+            owner_routing.bucket_by_owner(deltas, ref_owners.get),
+            holder_node=self.node_id)
 
     def _route_now(self, spec: TaskSpec, streaming: bool = False,
                    restarts_seen: Optional[int] = None) -> None:
@@ -629,11 +700,13 @@ class CoreWorker:
         if streaming:
             self.commit_generator_done(spec.task_id, 1)
             self.commit_generator_item(spec.task_id, 0, err, is_error=True)
-        if spec.dependencies():
-            try:  # release the submit-time dependency pin
-                self.cp.purge_holder(b"task:" + spec.task_id)
-            except Exception:  # noqa: BLE001
-                pass
+        deps = spec.dependencies()
+        if deps:
+            # release the submit-time dependency pin at each dep's owner
+            from ray_tpu._private import owner_routing
+            owner_routing.route_purge(
+                self.cp, self._nm_peer, b"task:" + spec.task_id,
+                {spec.ref_owners.get(d) for d in deps})
 
     def _route_or_buffer(self, spec: TaskSpec, streaming: bool) -> None:
         """Route to the actor's node manager, or buffer until it's ALIVE.

@@ -46,10 +46,12 @@ class WorkerProcess:
             mode="worker", job_id=JobID.nil(), worker_id=self.worker_id,
             node_id=self.node_id, control_plane=self.cp,
             node_manager=self.nm_client, shm_store=self.store,
-            session_dir=self.session_dir, nm_notify=self._send)
+            session_dir=self.session_dir, nm_notify=self._send,
+            nm_addr=self.nm_sock)
         set_global_worker(self.core)
         from ray_tpu._private.ref_tracker import install_tracker
-        install_tracker(self.worker_id.binary(), self.cp)
+        install_tracker(self.worker_id.binary(), self.cp,
+                        node_id=self.node_id)
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") == "1":
             from ray_tpu._private.log_streaming import install_worker_tee
             install_worker_tee(self.cp, self.worker_id.binary())
@@ -90,7 +92,9 @@ class WorkerProcess:
         def one(arg: Arg):
             if arg.inline is not None:
                 return serialization.loads(arg.inline)
-            return self.core.get(ObjectRef(arg.object_id))
+            return self.core.get(
+                ObjectRef(arg.object_id,
+                          spec.ref_owners.get(arg.object_id)))
         args = [one(a) for a in spec.args]
         kwargs = {k: one(v) for k, v in spec.kwargs.items()}
         return args, kwargs
@@ -133,7 +137,8 @@ class WorkerProcess:
             return
         oids = spec.return_object_ids()
         if spec.num_returns == 1:
-            self.core.put_object(oids[0], result)
+            self.core.put_object(oids[0], result,
+                                 owner_addr=spec.owner_addr)
         else:
             values = list(result)
             if len(values) != spec.num_returns:
@@ -141,14 +146,15 @@ class WorkerProcess:
                     f"task {spec.name} declared num_returns="
                     f"{spec.num_returns} but returned {len(values)} values")
             for oid, v in zip(oids, values):
-                self.core.put_object(oid, v)
+                self.core.put_object(oid, v, owner_addr=spec.owner_addr)
 
     def _commit_error(self, spec: TaskSpec, exc: BaseException):
         err = TaskError(exc, format_remote_traceback(exc),
                         spec.task_id.hex())
         try:
             for oid in spec.return_object_ids():
-                self.core.put_object(oid, err, is_error=True)
+                self.core.put_object(oid, err, is_error=True,
+                                     owner_addr=spec.owner_addr)
             if spec.is_generator:
                 self.core.commit_generator_item(spec.task_id, 0, err,
                                                 is_error=True)

@@ -1,0 +1,9 @@
+"""AIR-shared plumbing (parity: ``python/ray/air``): run/checkpoint
+configs live in ``ray_tpu.train.config``; tracker integrations in
+``ray_tpu.air.integrations``."""
+
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                  RunConfig, ScalingConfig)
+
+__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig",
+           "ScalingConfig"]

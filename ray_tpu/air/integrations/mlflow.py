@@ -1,0 +1,53 @@
+"""MLflow tracking (parity: ``python/ray/air/integrations/mlflow.py``
+MLflowLoggerCallback).
+
+One MLflow run per trial; reports become metrics, trial config becomes
+params.  The ``mlflow`` client is not part of the TPU image —
+construction raises a clear ImportError when absent."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.callbacks import LoggerCallback
+
+
+class MLflowLoggerCallback(LoggerCallback):
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None,
+                 tags: Optional[Dict[str, str]] = None):
+        try:
+            import mlflow
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError(
+                "MLflowLoggerCallback requires the `mlflow` package in "
+                "the image (TPU pods run without runtime pip installs)"
+            ) from e
+        self._mlflow = mlflow
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        if experiment_name:
+            mlflow.set_experiment(experiment_name)
+        self.tags = tags or {}
+        self._runs: Dict[str, Any] = {}
+
+    def log_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        tid = trial.trial_id
+        if tid not in self._runs:
+            run = self._mlflow.start_run(run_name=tid, nested=True,
+                                         tags=self.tags)
+            self._runs[tid] = run
+            for k, v in (getattr(trial, "config", {}) or {}).items():
+                try:
+                    self._mlflow.log_param(k, v)
+                except Exception:  # noqa: BLE001 - non-loggable param
+                    pass
+        step = int(result.get("training_iteration", 0))
+        self._mlflow.log_metrics(
+            {k: float(v) for k, v in result.items()
+             if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            step=step)
+
+    def log_trial_end(self, trial, failed: bool) -> None:
+        if self._runs.pop(trial.trial_id, None) is not None:
+            self._mlflow.end_run("FAILED" if failed else "FINISHED")

@@ -1,0 +1,49 @@
+"""Weights & Biases tracking (parity:
+``python/ray/air/integrations/wandb.py`` WandbLoggerCallback).
+
+One W&B run per trial; every ``tune.report`` becomes a ``wandb.log``.
+The ``wandb`` client is not part of the TPU image — construction raises
+a clear ImportError when absent (reference behavior)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.callbacks import LoggerCallback
+
+
+class WandbLoggerCallback(LoggerCallback):
+    def __init__(self, project: Optional[str] = None,
+                 group: Optional[str] = None,
+                 api_key: Optional[str] = None, **wandb_init_kwargs):
+        try:
+            import wandb
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError(
+                "WandbLoggerCallback requires the `wandb` package in "
+                "the image (TPU pods run without runtime pip installs)"
+            ) from e
+        self._wandb = wandb
+        if api_key:
+            wandb.login(key=api_key)
+        self.project = project
+        self.group = group
+        self.kwargs = wandb_init_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def log_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        tid = trial.trial_id
+        run = self._runs.get(tid)
+        if run is None:
+            run = self._wandb.init(
+                project=self.project, group=self.group, name=tid,
+                config=dict(getattr(trial, "config", {}) or {}),
+                reinit=True, **self.kwargs)
+            self._runs[tid] = run
+        run.log({k: v for k, v in result.items()
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)})
+
+    def log_trial_end(self, trial, failed: bool) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish(exit_code=1 if failed else 0)

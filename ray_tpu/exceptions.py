@@ -111,6 +111,18 @@ class ObjectLostError(RayError):
             f"Object {object_id} is lost: {reason or 'all copies failed'}")
 
 
+class OwnerDiedError(ObjectLostError):
+    """The worker/node owning an object's refcount + lifetime died.
+
+    Parity: reference ``OwnerDiedError`` (``python/ray/exceptions.py``) —
+    an object whose owner is gone is unrecoverable unless lineage can
+    recompute it (task returns); ``ray.put`` objects fate-share with
+    their owner."""
+
+    def __init__(self, object_id: str = "", reason: str = ""):
+        super().__init__(object_id, reason or "the object's owner died")
+
+
 class ObjectStoreFullError(RayError):
     pass
 

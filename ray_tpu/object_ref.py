@@ -7,9 +7,14 @@ ObjectID; they pickle freely (into task args, other objects, etc.).
 Lifetime: every live ObjectRef counts toward its object's reference count
 (owner-side refcounting; reference ``core_worker/reference_count.cc``).
 Construction registers +1 with the process-local ref tracker, __del__
-registers -1; the control plane frees objects whose aggregate count stays
-zero past a grace period.  Pickling into a task arg transfers liveness to
-the task spec (the node manager pins dependencies until the task ends).
+registers -1; deltas flush in batches to the object's OWNER — the node
+manager of the process that created the ref (put / task submission) —
+which frees the object once its aggregate count stays zero past a grace
+period.  The owner address rides the pickled ref, so borrowers anywhere
+in the cluster report to the same owner; refs with no owner (internal
+ids, e.g. generator items) fall back to control-plane refcounting.
+Pickling into a task arg transfers liveness to the task spec (the node
+manager pins dependencies, also owner-routed, until the task ends).
 """
 
 from __future__ import annotations
@@ -20,17 +25,18 @@ from ray_tpu._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_tracked")
+    __slots__ = ("_id", "_tracked", "_owner")
 
-    def __init__(self, object_id: bytes):
+    def __init__(self, object_id: bytes, owner_addr: Optional[str] = None):
         if isinstance(object_id, ObjectID):
             object_id = object_id.binary()
         if not isinstance(object_id, bytes) or len(object_id) != ObjectID.SIZE:
             raise ValueError(f"bad object id: {object_id!r}")
         self._id = object_id
+        self._owner = owner_addr
         self._tracked = False
         from ray_tpu._private.ref_tracker import track_ref
-        self._tracked = track_ref(object_id)
+        self._tracked = track_ref(object_id, owner_addr)
 
     def __del__(self):
         if getattr(self, "_tracked", False):
@@ -56,11 +62,15 @@ class ObjectRef:
     def __eq__(self, other):
         return isinstance(other, ObjectRef) and other._id == self._id
 
+    def owner_addr(self) -> Optional[str]:
+        """RPC address of the node manager owning this object's count."""
+        return self._owner
+
     def __repr__(self):
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        return (ObjectRef, (self._id,))
+        return (ObjectRef, (self._id, self._owner))
 
     def future(self):
         """A concurrent.futures.Future resolving to the object's value."""

@@ -115,3 +115,205 @@ def test_buffered_actor_call_pins_args(ray_start_regular):
     del ref                         # only the task pin protects the arg
     gc.collect()
     assert ray.get(out, timeout=60) == 7
+
+
+# ---------------------------------------------------------------------------
+# Owner-based (decentralized) reference counting.  Reference semantics:
+# core_worker/reference_count.cc (owner holds counts) +
+# ownership_based_object_directory.cc (directory separate from counts) +
+# OwnerDiedError fate-sharing (python/ray/exceptions.py).
+# ---------------------------------------------------------------------------
+
+def test_owner_nm_holds_counts_not_cp(fast_gc):
+    """Ref deltas route to the owner node manager; the control plane
+    keeps only the directory (out of the per-ref hot path)."""
+    ray = fast_gc
+    from ray_tpu._private.worker import global_node
+    node = global_node()
+
+    ref = ray.put(np.ones(300_000))          # > inline threshold -> shm
+    time.sleep(0.6)                          # a couple of flush windows
+    assert _cp().refs_summary()["tracked_objects"] == 0
+    assert node.node_manager.owned_refs_summary()["tracked_objects"] >= 1
+    base = _cp().objects_summary()["count"]
+    del ref
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if _cp().objects_summary()["count"] < base:
+            break
+        time.sleep(0.2)
+    assert _cp().objects_summary()["count"] < base
+
+
+def test_borrower_keeps_owned_object_alive(fast_gc):
+    """A borrower's +1 (ref nested in actor state, NOT a pinned task
+    arg) lands at the owner and keeps the object alive after the
+    creator drops its own ref."""
+    ray = fast_gc
+
+    @ray.remote
+    class Holder:
+        def __init__(self, refs):
+            self.refs = refs          # list containing an ObjectRef
+
+        def ready(self):
+            return True
+
+        def fetch(self):
+            import ray_tpu
+            return float(ray_tpu.get(self.refs[0]).sum())
+
+    ref = ray.put(np.ones(300_000))
+    h = Holder.remote([ref])
+    assert ray.get(h.ready.remote(), timeout=30)   # borrower registered
+    del ref                                        # owner's only local ref
+    time.sleep(1.5)                                # several sweeps past grace
+    assert ray.get(h.fetch.remote(), timeout=30) == 300_000.0
+
+
+def _dead_node_fixture_cluster():
+    import ray_tpu
+    ray_tpu.init(num_cpus=1, _system_config={
+        "health_check_period_s": 0.2, "health_check_timeout_s": 2.0,
+        "object_gc_grace_s": 1.0, "object_gc_period_s": 0.2})
+    from ray_tpu._private.worker import global_node
+    return ray_tpu, global_node()
+
+
+@pytest.fixture
+def owner_death_cluster():
+    ray, node = _dead_node_fixture_cluster()
+    yield ray, node
+    ray.shutdown()
+
+
+def _kill_node(node, node_id):
+    import os
+    import signal
+    for nid, proc in node._extra_nodes:
+        if nid == node_id:
+            os.kill(proc.pid, signal.SIGKILL)
+            return
+    raise KeyError(node_id.hex())
+
+
+def _wait_dead(node, node_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        info = node.control_plane.get_node(node_id)
+        if info and info["state"] == "DEAD":
+            return
+        time.sleep(0.2)
+    raise TimeoutError("node not marked dead")
+
+
+def test_owner_death_put_object_raises(owner_death_cluster):
+    """ray.put objects fate-share with their owner: when the owning
+    node dies, borrowers get OwnerDiedError (no lineage to recover)."""
+    ray, node = owner_death_cluster
+    from ray_tpu.exceptions import OwnerDiedError
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    node_b = node.add_node(num_cpus=2)
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.hex(), soft=False))
+    def make_ref():
+        import ray_tpu
+        return [ray_tpu.put(np.ones(300_000))]   # owner = node_b's NM
+
+    (inner,) = ray.get(make_ref.remote(), timeout=60)
+    _kill_node(node, node_b)
+    _wait_dead(node, node_b)
+    with pytest.raises(OwnerDiedError):
+        ray.get(inner, timeout=30)
+
+
+def test_owner_death_task_return_recovers_via_lineage(owner_death_cluster):
+    """A task-return object whose owner died is recomputed from lineage
+    — and the recovering worker adopts ownership."""
+    ray, node = owner_death_cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    node_b = node.add_node(num_cpus=2)
+
+    @ray.remote
+    def produce():
+        return np.arange(200_000, dtype=np.int64)
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.hex(), soft=False))
+    def submit_inner():
+        # submitted FROM node_b: the return ref is owned by node_b's NM
+        return [produce.remote()]
+
+    (inner,) = ray.get(submit_inner.remote(), timeout=60)
+    _kill_node(node, node_b)
+    _wait_dead(node, node_b)
+    out = ray.get(inner, timeout=120)          # lineage reconstruction
+    assert out.shape == (200_000,)
+    assert int(out[7]) == 7
+
+
+def test_wait_unblocks_on_owner_died_tombstone(owner_death_cluster):
+    """ray.wait on an owner-died object reports it ready (the get then
+    raises OwnerDiedError) instead of hanging past the tombstone."""
+    ray, node = owner_death_cluster
+    from ray_tpu.exceptions import OwnerDiedError
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    node_b = node.add_node(num_cpus=2)
+
+    @ray.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.hex(), soft=False))
+    def make_ref():
+        import ray_tpu
+        return [ray_tpu.put(np.ones(300_000))]
+
+    (inner,) = ray.get(make_ref.remote(), timeout=60)
+    _kill_node(node, node_b)
+    _wait_dead(node, node_b)
+    time.sleep(2.5)           # past the 1s grace: entry swept, tombstoned
+    ready, not_ready = ray.wait([inner], timeout=30)
+    assert ready == [inner], (ready, not_ready)
+    with pytest.raises(OwnerDiedError):
+        ray.get(inner, timeout=30)
+
+
+def test_node_death_purges_borrower_counts(owner_death_cluster):
+    """Counts flushed by a dead node's workers to a surviving owner are
+    purged by the head's node-death broadcast, so borrowed objects
+    don't leak when the borrowing node dies."""
+    ray, node = owner_death_cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    node_b = node.add_node(num_cpus=2)
+
+    @ray.remote(max_restarts=0, scheduling_strategy=
+                NodeAffinitySchedulingStrategy(node_id=node_b.hex(),
+                                               soft=False))
+    class Borrower:
+        def __init__(self, refs):
+            self.refs = refs       # borrowed ref inside actor state
+
+        def ready(self):
+            return True
+
+    ref = ray.put(np.ones(300_000))          # owner = head NM
+    b = Borrower.remote([ref])
+    assert ray.get(b.ready.remote(), timeout=60)
+    time.sleep(0.6)                          # borrower's +1 flushed
+    del b
+    base = _cp().objects_summary()["count"]
+    del ref                                  # owner's own ref gone;
+    time.sleep(2.5)                          # borrower still pins it
+    summary = node.node_manager.owned_refs_summary()
+    assert summary["tracked_objects"] >= 1, summary
+    _kill_node(node, node_b)
+    _wait_dead(node, node_b)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if node.node_manager.owned_refs_summary()["tracked_objects"] == 0:
+            break
+        time.sleep(0.3)
+    assert node.node_manager.owned_refs_summary()["tracked_objects"] == 0
