@@ -168,6 +168,15 @@ class _PendingQueues:
     def shapes(self) -> List[Any]:
         return [k for k, q in self._queues.items() if q]
 
+    def shape_counts(self) -> Dict[Any, int]:
+        """Pending count per resource shape — O(#shapes), for the
+        heartbeat demand vector (key[0] is the sorted resources tuple)."""
+        out: Dict[Any, int] = {}
+        for key, q in self._queues.items():
+            if q:
+                out[key[0]] = out.get(key[0], 0) + len(q)
+        return out
+
     def remove(self, task_id: bytes) -> Optional[TaskSpec]:
         for q in self._queues.values():
             for i, spec in enumerate(q):
@@ -906,10 +915,40 @@ class NodeManager:
             return None
         if not any(fits(n.get("resources_total", {}), spec.resources)
                    for n in nodes):
+            # an active autoscaler may be able to PROVISION a fitting
+            # node type: keep the task queued (its shape rides the
+            # heartbeat demand vector) instead of failing it — the
+            # reference keeps infeasible tasks pending with warnings
+            if self._provisionable(spec.resources):
+                return None
             raise InfeasibleTaskError(
                 f"task {spec.name!r} requests {spec.resources}, which no "
                 f"node in the cluster can ever satisfy")
         return None  # a node could fit it later; keep requeueing
+
+    def _provisionable(self, resources: Dict[str, float]) -> bool:
+        """True if an autoscaler has registered a node type whose shape
+        could satisfy these resources.  The registry blob is TTL-cached:
+        this runs on every dispatch retry of an infeasible-shaped task,
+        and an identical CP read ~5x/s per shape adds up."""
+        now = time.time()
+        cached = getattr(self, "_node_types_cache", None)
+        if cached is None or now - cached[0] > 5.0:
+            types = None
+            try:
+                blob = self.cp.kv_get(b"node_types",
+                                      namespace="_autoscaler")
+                if blob:
+                    import json
+                    types = json.loads(blob)
+            except Exception:  # noqa: BLE001
+                types = None
+            cached = (now, types)
+            self._node_types_cache = cached
+        types = cached[1]
+        if not types:
+            return False
+        return any(fits(shape, resources) for shape in types.values())
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
         from ray_tpu.exceptions import InfeasibleTaskError
@@ -1368,8 +1407,26 @@ class NodeManager:
                 with self._res_lock:
                     avail = dict(self.resources_available)
                 with self._lock:
-                    load = {"num_pending": len(self._pending)
-                            + len(self._waiting)}
+                    # per-shape demand so the autoscaler can launch
+                    # nodes that actually FIT the queue (reference:
+                    # resource_demand_scheduler.py demand vector).
+                    # _PendingQueues already buckets by shape, so this
+                    # is O(#shapes), not O(backlog); dep-waiting tasks
+                    # are folded in too (their resources are demand the
+                    # moment the deps land)
+                    shapes = dict(self._pending.shape_counts())
+                    for spec in self._waiting.values():
+                        key = tuple(sorted(spec.resources.items()))
+                        shapes[key] = shapes.get(key, 0) + 1
+                    load = {
+                        "num_pending": len(self._pending)
+                        + len(self._waiting),
+                        "pending_shapes": [
+                            {"resources": dict(k), "count": c}
+                            for k, c in sorted(
+                                shapes.items(), key=lambda kv: -kv[1]
+                            )[:8]],
+                    }
                 self.cp.heartbeat_node(self.node_id, avail, load)
             except Exception:  # noqa: BLE001
                 pass

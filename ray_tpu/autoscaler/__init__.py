@@ -24,9 +24,14 @@ import ray_tpu
 
 
 class NodeProvider:
-    """Minimal provider surface (reference: node_provider.py)."""
+    """Minimal provider surface (reference: node_provider.py +
+    ``available_node_types`` from the cluster config)."""
 
-    def create_node(self) -> bytes:
+    def node_types(self) -> Dict[str, Dict[str, float]]:
+        """Launchable node shapes: type name -> resource dict."""
+        return {"default": {"CPU": 1.0}}
+
+    def create_node(self, node_type: str = "default") -> bytes:
         raise NotImplementedError
 
     def terminate_node(self, node_id: bytes) -> None:
@@ -39,14 +44,19 @@ class NodeProvider:
 class LocalNodeProvider(NodeProvider):
     """Real extra node processes on this host (cluster_utils parity)."""
 
-    def __init__(self, worker_resources: Optional[Dict[str, float]] = None):
+    def __init__(self, worker_resources: Optional[Dict[str, float]] = None,
+                 node_types: Optional[Dict[str, Dict[str, float]]] = None):
         from ray_tpu._private.worker import global_node
         self._node = global_node()
         self.worker_resources = worker_resources or {"CPU": 2.0}
+        self._types = node_types or {"default": dict(self.worker_resources)}
         self._nodes: List[bytes] = []
 
-    def create_node(self) -> bytes:
-        res = dict(self.worker_resources)
+    def node_types(self) -> Dict[str, Dict[str, float]]:
+        return {k: dict(v) for k, v in self._types.items()}
+
+    def create_node(self, node_type: str = "default") -> bytes:
+        res = dict(self._types.get(node_type, self.worker_resources))
         cpus = res.pop("CPU", 1.0)
         tpus = res.pop("TPU", 0.0)
         node_id = self._node.add_node(num_cpus=cpus, num_tpus=tpus,
@@ -95,6 +105,19 @@ class StandardAutoscaler:
         return global_worker().cp.list_nodes()
 
     def start(self) -> None:
+        # advertise launchable shapes so node managers keep queueing
+        # tasks this autoscaler could satisfy (instead of failing them
+        # as infeasible) and their demand reaches the heartbeats
+        import json
+
+        from ray_tpu._private.worker import global_worker
+        try:
+            global_worker().cp.kv_put(
+                b"node_types",
+                json.dumps(self.provider.node_types()).encode(),
+                namespace="_autoscaler", overwrite=True)
+        except Exception:  # noqa: BLE001 - registry is best-effort
+            pass
         for _ in range(self.config.min_workers):
             self.provider.create_node()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -120,25 +143,87 @@ class StandardAutoscaler:
         provisioned = self.provider.non_terminated_nodes()
         managed = [nid for nid in provisioned if nid in nodes]
 
-        # ---- scale up: sustained unservable demand
+        # ---- scale up: sustained unservable demand, matched by SHAPE
+        # (reference: resource_demand_scheduler.py — bin-pack pending
+        # bundles against launchable node types, not raw queue depth)
         if pending > 0:
             if self._pending_since is None:
                 self._pending_since = now
             elif (now - self._pending_since >=
                   self.config.upscale_delay_s
                   and len(provisioned) < self.config.max_workers):
-                # record the decision before the (blocking) launch —
-                # node startup can take seconds and observability should
-                # reflect when scaling was *chosen*
-                self.events.append(f"up: +node (pending={pending})")
-                self._pending_since = None
-                node_id = self.provider.create_node()
-                self.events.append(
-                    f"up: node {node_id.hex()[:8]} ready")
+                node_type = self._pick_node_type(nodes.values())
+                if node_type is not None:
+                    # record the decision before the (blocking) launch —
+                    # node startup can take seconds and observability
+                    # should reflect when scaling was *chosen*
+                    self.events.append(
+                        f"up: +{node_type} (pending={pending})")
+                    self._pending_since = None
+                    node_id = self.provider.create_node(node_type)
+                    self.events.append(
+                        f"up: node {node_id.hex()[:8]} ready")
         else:
             self._pending_since = None
 
         # ---- scale down: provider nodes idle past the timeout
+        self._scale_down(nodes, managed, now)
+
+    def _pick_node_type(self, node_infos) -> Optional[str]:
+        """Bin-pack the heartbeat demand vector against existing
+        capacity; pick the node type satisfying the most unfulfilled
+        bundles (ties: fewest resources).  Returns None when nothing
+        pending fits any launchable type (those bundles are logged as
+        infeasible)."""
+        demand: List[Dict[str, float]] = []
+        for info in node_infos:
+            for s in (info.get("load") or {}).get("pending_shapes", []):
+                demand.extend([s["resources"]] * min(int(s["count"]), 64))
+        types = self.provider.node_types()
+        if not demand:
+            # num_pending counted dep-waiting or just-drained work but
+            # the shape vector is empty: launching an arbitrary type
+            # would be a blind guess — wait for real shape demand
+            return None
+        # virtually pack demand onto existing nodes' available resources
+        virtual = [dict(info.get("resources_available") or {})
+                   for info in node_infos]
+        unfulfilled: List[Dict[str, float]] = []
+        for bundle in demand:
+            for avail in virtual:
+                if all(avail.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    break
+            else:
+                unfulfilled.append(bundle)
+        if not unfulfilled:
+            return None
+        best, best_score = None, (0, 0.0)
+        for name, shape in types.items():
+            cap = dict(shape)
+            served = 0
+            for bundle in unfulfilled:
+                if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        cap[k] -= v
+                    served += 1
+            score = (served, -sum(shape.values()))
+            if served > 0 and score > best_score:
+                best, best_score = name, score
+        if best is None:
+            infeasible = [b for b in unfulfilled
+                          if not any(
+                              all(shape.get(k, 0) >= v
+                                  for k, v in b.items())
+                              for shape in types.values())]
+            if infeasible:
+                msg = f"infeasible: {infeasible[0]} fits no node type"
+                if not self.events or self.events[-1] != msg:
+                    self.events.append(msg)
+        return best
+
+    def _scale_down(self, nodes, managed, now) -> None:
         alive_count = len(managed)
         for nid in list(managed):
             info = nodes[nid]
@@ -162,6 +247,14 @@ class StandardAutoscaler:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # withdraw the shape registry: with no autoscaler to provision
+        # them, unservable shapes must fail fast again
+        from ray_tpu._private.worker import global_worker
+        try:
+            global_worker().cp.kv_del(b"node_types",
+                                      namespace="_autoscaler")
+        except Exception:  # noqa: BLE001 - session may be gone
+            pass
 
 
 def request_resources(num_cpus: float = 0,

@@ -10,9 +10,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 from typing import Optional
 
+_STATIC_INDEX = os.path.join(os.path.dirname(__file__), "static",
+                             "index.html")
+
+# fallback when the bundled SPA is missing (e.g. a trimmed install)
 _INDEX_HTML = """<!doctype html>
 <title>ray_tpu dashboard</title>
 <h1>ray_tpu dashboard</h1>
@@ -63,8 +68,15 @@ class Dashboard:
             return web.json_response(data)
 
         async def index(request):
-            return web.Response(text=_INDEX_HTML,
-                                content_type="text/html")
+            # the SPA frontend (dashboard/static/index.html, parity:
+            # reference dashboard/client React app)
+            try:
+                with open(_STATIC_INDEX) as f:
+                    return web.Response(text=f.read(),
+                                        content_type="text/html")
+            except OSError:
+                return web.Response(text=_INDEX_HTML,
+                                    content_type="text/html")
 
         async def nodes(request):
             return json_response(state_api.list_nodes())

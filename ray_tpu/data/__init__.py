@@ -1,6 +1,8 @@
 """``ray_tpu.data`` — distributed datasets (parity: ``ray.data``)."""
 
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.connectors import (from_huggingface, from_torch,
+                                     read_sql, read_webdataset)
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
@@ -12,6 +14,8 @@ __all__ = [
     "Block", "BlockAccessor", "Dataset", "DataIterator", "GroupedData",
     "range",
     "from_items", "from_numpy", "from_arrow", "from_pandas",
+    "from_torch", "from_huggingface",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
+    "read_webdataset", "read_sql",
 ]

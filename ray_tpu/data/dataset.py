@@ -642,6 +642,18 @@ class Dataset:
             block = ray_tpu.get(ref, timeout=600)
             pacsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
 
+    def write_json(self, path: str) -> None:
+        from ray_tpu.data.connectors import write_json
+        write_json(self, path)
+
+    def write_numpy(self, path: str, column: str) -> None:
+        from ray_tpu.data.connectors import write_numpy
+        write_numpy(self, path, column)
+
+    def write_webdataset(self, path: str) -> None:
+        from ray_tpu.data.connectors import write_webdataset
+        write_webdataset(self, path)
+
     def __repr__(self):
         return (f"Dataset(num_blocks={len(self._block_refs)}, "
                 f"ops={len(self._ops)})")

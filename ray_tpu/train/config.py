@@ -59,6 +59,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
+    # experiment callbacks (ray_tpu.tune.callbacks.Callback): invoked by
+    # the Tuner controller at trial lifecycle points
+    callbacks: Optional[list] = None
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:

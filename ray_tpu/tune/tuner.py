@@ -240,6 +240,9 @@ class Tuner:
         storage = self.run_config.resolved_storage_path()
         os.makedirs(storage, exist_ok=True)
         self._save_tuner_blob(storage)
+        from ray_tpu.tune import callbacks as cb_mod
+        callbacks = list(self.run_config.callbacks or [])
+        cb_mod.invoke(callbacks, "setup", storage)
 
         searcher = self.tune_config.search_alg
         total_trials = None
@@ -296,10 +299,16 @@ class Tuner:
             running.append(trial)
             if hasattr(scheduler, "on_trial_add"):
                 scheduler.on_trial_add(trial.trial_id, trial.config)
+            cb_mod.invoke(callbacks, "on_trial_start", trial)
 
         def retire(trial: Trial, status: str):
             trial.status = status
             running.remove(trial)
+            if status == "ERROR":
+                cb_mod.invoke(callbacks, "on_trial_error", trial,
+                              RuntimeError(trial.error or "trial failed"))
+            else:
+                cb_mod.invoke(callbacks, "on_trial_complete", trial)
             scheduler.on_trial_complete(trial.trial_id)
             if searcher is not None:
                 searcher.on_trial_complete(trial.trial_id,
@@ -384,6 +393,8 @@ class Tuner:
                     metrics["config"] = trial.config
                     trial.last_result = metrics
                     trial.history.append(metrics)
+                    cb_mod.invoke(callbacks, "on_trial_result", trial,
+                                  metrics)
                     if searcher is not None:
                         searcher.on_trial_result(trial.trial_id, metrics)
                     if checkpoint is not None:
@@ -422,8 +433,10 @@ class Tuner:
                 path=os.path.join(storage, trial.trial_id),
                 error=err,
                 metrics_history=trial.history))
-        return ResultGrid(results, self.tune_config.metric,
+        grid = ResultGrid(results, self.tune_config.metric,
                           self.tune_config.mode)
+        cb_mod.invoke(callbacks, "on_experiment_end", grid)
+        return grid
 
 
 def _json_safe(obj):

@@ -22,6 +22,8 @@ def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
             "resources_total": info.get("resources_total", {}),
             "resources_available": info.get("resources_available", {}),
             "labels": info.get("labels", {}),
+            "load": info.get("load", {}),
+            "death_reason": info.get("death_reason", ""),
         })
     return out
 

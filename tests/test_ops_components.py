@@ -266,3 +266,62 @@ def test_cli_start_stop_standalone_cluster(tmp_path):
         assert "R: [0, 3, 6, 9, 12, 15]" in p.stdout
     finally:
         cli("stop")
+
+
+def test_autoscaler_shape_matching(ray_start_cluster):
+    """Demand is matched by resource SHAPE: a queue of accel-shaped
+    tasks launches the accel node type, not the cpu type — and the
+    task waits (not fails) because the shape is provisionable
+    (resource_demand_scheduler.py parity)."""
+    import ray_tpu
+    from ray_tpu.autoscaler import (AutoscalerConfig, LocalNodeProvider,
+                                    StandardAutoscaler)
+
+    sc = StandardAutoscaler(
+        LocalNodeProvider(node_types={
+            "cpu": {"CPU": 2.0},
+            "accel": {"CPU": 1.0, "accel": 4.0},
+        }),
+        AutoscalerConfig(max_workers=1, upscale_delay_s=0.3,
+                         idle_timeout_s=60.0, tick_s=0.2))
+    sc.start()
+    try:
+        # infeasible on the current cluster (no 'accel' resource
+        # anywhere) but provisionable by the autoscaler
+        @ray_tpu.remote(resources={"accel": 2.0})
+        def on_accel():
+            return "ran"
+
+        assert ray_tpu.get(on_accel.remote(), timeout=120) == "ran"
+        assert any(e.startswith("up: +accel") for e in sc.events), \
+            sc.events
+        assert not any(e.startswith("up: +cpu") for e in sc.events)
+    finally:
+        sc.stop()
+
+
+def test_autoscaler_unprovisionable_shape_fails_fast(ray_start_cluster):
+    """A shape that fits no launchable node type still fails fast with
+    InfeasibleTaskError (the provisionable-shape relaxation only keeps
+    tasks queued that a registered type could satisfy) and launches
+    nothing."""
+    import ray_tpu
+    from ray_tpu.autoscaler import (AutoscalerConfig, LocalNodeProvider,
+                                    StandardAutoscaler)
+    from ray_tpu.exceptions import InfeasibleTaskError
+
+    sc = StandardAutoscaler(
+        LocalNodeProvider(node_types={"cpu": {"CPU": 2.0}}),
+        AutoscalerConfig(max_workers=1, upscale_delay_s=0.2,
+                         idle_timeout_s=60.0, tick_s=0.2))
+    sc.start()
+    try:
+        @ray_tpu.remote(resources={"accel": 8.0})
+        def impossible():
+            return 1
+
+        with pytest.raises(InfeasibleTaskError):
+            ray_tpu.get(impossible.remote(), timeout=60)
+        assert not sc.provider.non_terminated_nodes()
+    finally:
+        sc.stop()
