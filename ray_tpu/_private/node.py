@@ -84,6 +84,7 @@ def default_resources(num_cpus: Optional[float],
         head_res = TPUAcceleratorManager.get_pod_head_resource_name()
         if head_res:
             out[head_res] = 1.0
+        out.update(TPUAcceleratorManager.get_pod_slice_resources())
     out.update({k: float(v) for k, v in (resources or {}).items()})
     out.setdefault("node:__internal_head__", 1.0)
     return out

@@ -381,6 +381,27 @@ class NodeManager:
         for h in victims:
             self.purge_owned_holder(h)
 
+    def signal_stack_dump(self) -> List[int]:
+        """``ray stack`` equivalent (reference: py-spy-based
+        ``python/ray/scripts/scripts.py stack``): SIGUSR1 every live
+        worker — their registered faulthandler writes all-thread python
+        tracebacks to their log files — and dump this NM process's own
+        threads to stderr.  Returns the signalled pids."""
+        import faulthandler
+        import signal as _signal
+        pids: List[int] = []
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.proc is not None and w.state != "dead":
+                try:
+                    os.kill(w.proc.pid, _signal.SIGUSR1)
+                    pids.append(w.proc.pid)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        faulthandler.dump_traceback(all_threads=True)
+        return pids
+
     def owned_refs_summary(self) -> Dict[str, int]:
         with self._owner_lock:
             return {"tracked_objects": len(self._owner_totals),

@@ -213,6 +213,9 @@ class WorkerProcess:
                 t = threading.Thread(target=self.actor_loop.run_forever,
                                      daemon=True, name="actor-loop")
                 t.start()
+                asyncio.run_coroutine_threadsafe(
+                    self._event_loop_lag_monitor(spec.actor_id),
+                    self.actor_loop)
             elif spec.max_concurrency > 1:
                 self.actor_pool = ThreadPoolExecutor(
                     max_workers=spec.max_concurrency,
@@ -226,6 +229,53 @@ class WorkerProcess:
                         "actor_id": spec.actor_id})
             self._send({"type": "done", "task_id": spec.task_id,
                         "error": True})
+
+    async def _event_loop_lag_monitor(self, actor_id: bytes,
+                                      period: float = 0.5,
+                                      warn_ms: float = 200.0):
+        """Async-actor responsiveness watchdog (SURVEY §5.2 — the
+        asyncio analogue of a blocked-event-loop sanitizer: the
+        reference leans on py-spy; here the loop measures its own
+        scheduling lag).  A coroutine that blocks the loop shows up as
+        lag: exported as the ``async_actor_event_loop_lag_ms`` gauge
+        and warned to the worker log (streamed to the driver) when it
+        exceeds ``warn_ms``."""
+        import time as _time
+
+        from ray_tpu.util.metrics import Gauge
+        gauge = None
+        warned_at = 0.0
+        last_published = -1.0
+        ticks = 0
+        while True:
+            t0 = _time.monotonic()
+            await asyncio.sleep(period)
+            lag_ms = max(0.0, (_time.monotonic() - t0 - period) * 1e3)
+            ticks += 1
+            # gauge.set is a synchronous CP RPC: keep it OFF the loop
+            # (the watchdog must never become the blocker it detects)
+            # and publish only on material change or every ~30 ticks
+            if (last_published < 0 or abs(lag_ms - last_published) > 10.0
+                    or ticks % 30 == 0):
+                last_published = lag_ms
+                try:
+                    if gauge is None:
+                        gauge = Gauge(
+                            "async_actor_event_loop_lag_ms",
+                            "Scheduling delay of the async actor "
+                            "event loop",
+                            tag_keys=("actor_id",))
+                    g, tag = gauge, {"actor_id": actor_id.hex()[:12]}
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: g.set(lag_ms, tags=tag))
+                except Exception:  # noqa: BLE001 - best-effort metric
+                    pass
+            if lag_ms > warn_ms and _time.monotonic() - warned_at > 10.0:
+                warned_at = _time.monotonic()
+                print(f"WARNING: async actor {actor_id.hex()[:12]} "
+                      f"event loop lagged {lag_ms:.0f} ms — a handler "
+                      "is blocking the loop (use asyncio.to_thread for "
+                      "CPU/blocking work)", flush=True)
 
     def _dispatch_actor_task(self, spec: TaskSpec):
         if self.is_async_actor and self.actor_loop is not None:

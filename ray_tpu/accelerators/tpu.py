@@ -28,6 +28,37 @@ def _jax_backend_initialized() -> bool:
         return False
 
 
+_GCE_METADATA_URL = ("http://metadata.google.internal/computeMetadata"
+                     "/v1/instance/attributes/")
+
+
+_gce_cache: dict = {}
+
+
+def _gce_metadata(attr: str, timeout: float = 0.5) -> Optional[str]:
+    """Probe the GCE metadata server for a TPU-VM attribute
+    (``accelerator-type``, ``agent-worker-number``, ``instance-id`` …).
+    Reference: ``python/ray/_private/accelerators/tpu.py`` queries the
+    same endpoints.  Short timeout + total failure tolerance: most
+    deployments (tests, GKE with env injection, bare metal) have no
+    metadata server."""
+    if os.environ.get("RAY_TPU_DISABLE_GCE_METADATA") == "1":
+        return None
+    if attr in _gce_cache:          # negatives cached too: a host with
+        return _gce_cache[attr]     # no metadata server never re-probes
+    _gce_cache[attr] = None
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            _GCE_METADATA_URL + attr,
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            _gce_cache[attr] = resp.read().decode().strip()
+    except Exception:  # noqa: BLE001 - no metadata server here
+        pass
+    return _gce_cache[attr]
+
+
 class TPUAcceleratorManager:
     @staticmethod
     def get_resource_name() -> str:
@@ -78,7 +109,41 @@ class TPUAcceleratorManager:
                     return getattr(devs[0], "device_kind", "TPU")
             except Exception:  # noqa: BLE001
                 pass
-        return None
+        return _gce_metadata("accelerator-type")
+
+    @staticmethod
+    def get_current_pod_name() -> Optional[str]:
+        """Name of the TPU pod slice this host belongs to (env first,
+        then GCE metadata).  Surfaced as a ``TPU-{pod_name}`` node
+        resource so gang tasks can target one slice."""
+        name = (os.environ.get("TPU_NAME")
+                or os.environ.get("TPU_POD_NAME"))
+        return name or _gce_metadata("instance-id")
+
+    @staticmethod
+    def get_pod_worker_id() -> int:
+        value = os.environ.get("TPU_WORKER_ID")
+        if value:
+            try:
+                return int(value)
+            except ValueError:
+                pass
+        meta = _gce_metadata("agent-worker-number")
+        try:
+            return int(meta) if meta else 0
+        except ValueError:
+            return 0
+
+    @staticmethod
+    def get_pod_slice_resources() -> dict:
+        """Extra node resources advertising pod membership:
+        ``TPU-{pod_name}`` on every slice host (reference:
+        ``ray.util.accelerators.tpu`` pod resources)."""
+        out = {}
+        pod = TPUAcceleratorManager.get_current_pod_name()
+        if pod:
+            out[f"TPU-{pod}"] = 1.0
+        return out
 
     @staticmethod
     def set_visible_accelerator_ids(ids: List[int]) -> None:

@@ -141,6 +141,55 @@ def cmd_memory(args):
               f"{o.get('where')}")
 
 
+def cmd_stack(args):
+    """Dump python stacks of every worker on every node (reference:
+    ``ray stack`` via py-spy; here workers' registered faulthandlers
+    write to their session log files on SIGUSR1)."""
+    import glob
+    import os
+    import time
+
+    from ray_tpu._private import protocol
+    cp = _connect_cp()
+    total = []
+    session_dirs = set()
+    for info in cp.call("list_nodes"):
+        if info.get("state") != "ALIVE":
+            continue
+        session_dirs.add(info.get("session_dir", ""))
+        try:
+            pids = protocol.RpcClient(info["sock_path"]).call(
+                "signal_stack_dump")
+            total.extend(pids)
+            print(f"node {info['node_id'].hex()[:12]}: signalled "
+                  f"{len(pids)} workers")
+        except (OSError, ConnectionError) as e:
+            print(f"node {info['node_id'].hex()[:12]}: unreachable ({e})")
+    time.sleep(0.7)          # give faulthandler time to write
+    shown = 0
+    for sdir in session_dirs:
+        for log in sorted(glob.glob(os.path.join(sdir, "logs",
+                                                 "worker-*.log"))):
+            try:
+                with open(log) as f:
+                    tail = f.readlines()[-120:]
+            except OSError:
+                continue
+            # show from the LAST dump onward (one "Current thread"
+            # header per faulthandler dump; older dumps are stale)
+            start = None
+            for i, line in enumerate(tail):
+                if "Current thread" in line:
+                    start = i
+                elif start is None and "Thread 0x" in line:
+                    start = i
+            if start is not None:
+                print(f"\n===== {os.path.basename(log)} =====")
+                print("".join(tail[start:]).rstrip())
+                shown += 1
+    print(f"\n{len(total)} workers signalled, {shown} stack dumps shown")
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
     from ray_tpu._private import ray_perf
@@ -359,6 +408,7 @@ def main(argv=None):
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default=None)
     sub.add_parser("memory")
+    sub.add_parser("stack")
     p_mb = sub.add_parser("microbenchmark")
     p_mb.add_argument("--duration", type=float, default=2.0)
     p_db = sub.add_parser("dashboard")
@@ -387,6 +437,7 @@ def main(argv=None):
     args = parser.parse_args(argv)
     {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
      "timeline": cmd_timeline, "memory": cmd_memory,
+     "stack": cmd_stack,
      "microbenchmark": cmd_microbenchmark,
      "dashboard": cmd_dashboard, "jobs": cmd_jobs,
      "start": cmd_start, "stop": cmd_stop}[args.command](args)

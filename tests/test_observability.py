@@ -141,3 +141,63 @@ def test_device_profiling_helpers(ray_start_regular, tmp_path):
     assert traces, f"no xplane trace under {logdir}"
     stats = device_memory_stats()
     assert len(stats) >= 1
+
+
+def test_stack_dump_signal(ray_start_regular):
+    """``ray-tpu stack`` plumbing: the NM SIGUSR1s live workers, whose
+    faulthandler writes all-thread tracebacks to their log files
+    (reference: ``ray stack``)."""
+    import glob
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_node
+
+    @ray_tpu.remote
+    class Sleeper:
+        def ready(self):
+            return True
+
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.remote()
+    assert ray_tpu.get(s.ready.remote(), timeout=60)
+    ref = s.nap.remote(3.0)       # worker mid-call when signalled
+    node = global_node()
+    pids = node.node_manager.signal_stack_dump()
+    assert pids, "no workers signalled"
+    time.sleep(0.8)
+    logs = glob.glob(os.path.join(node.session_dir, "logs",
+                                  "worker-*.log"))
+    dumped = any("Thread 0x" in open(p).read() or
+                 "Current thread" in open(p).read() for p in logs)
+    assert dumped, f"no faulthandler output in {logs}"
+    assert ray_tpu.get(ref, timeout=30) == 3.0   # worker survived USR1
+
+
+def test_async_actor_event_loop_lag_metric(ray_start_regular):
+    """A blocking handler inside an async actor surfaces as the
+    event-loop lag gauge (SURVEY 5.2 responsiveness sanitizer)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Async:
+        async def block(self, t):
+            time.sleep(t)         # deliberately BLOCKS the loop
+            return t
+
+        async def ping(self):
+            return "pong"
+
+    a = Async.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.get(a.block.remote(1.5), timeout=60)
+    time.sleep(1.2)               # monitor tick publishes the gauge
+    from ray_tpu.util.metrics import prometheus_text
+    text = prometheus_text()
+    assert "async_actor_event_loop_lag_ms" in text, text[:2000]

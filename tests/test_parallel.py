@@ -175,3 +175,40 @@ def test_ulysses_attention_matches_local():
     fn1 = make_ulysses_attention_fn(make_mesh(dp=2), causal=True)
     np.testing.assert_allclose(np.asarray(fn1(q, k, v)),
                                np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_ring_attention_matches_local():
+    """Causal load-balanced (zigzag) layout: each sp-rank holds chunks
+    (i, 2n-1-i), fully-masked blocks are skipped, and the result —
+    after undoing the host-side permutation — is exact."""
+    from ray_tpu.parallel.ring_attention import zigzag_permutation
+
+    mesh = make_mesh(dp=2, sp=4)
+    B, S, H, D = 4, 32, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D)) for kk in ks)
+
+    perm, inv = zigzag_permutation(S, 4)
+    fn = jax.jit(make_ring_attention_fn(mesh, causal=True,
+                                        layout="zigzag"))
+    out = fn(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_zigzag_ring_attention_grads():
+    from ray_tpu.parallel.ring_attention import zigzag_permutation
+
+    mesh = make_mesh(sp=4)
+    B, S, H, D = 2, 16, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D)) for kk in ks)
+    perm, inv = zigzag_permutation(S, 4)
+    fn = make_ring_attention_fn(mesh, causal=True, layout="zigzag")
+
+    g = jax.jit(jax.grad(
+        lambda q: (fn(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+                   ** 2).sum()))(q)
+    g_ref = jax.grad(
+        lambda q: (local_attention(q, k, v, causal=True) ** 2).sum())(q)
+    np.testing.assert_allclose(g, g_ref, atol=5e-5)

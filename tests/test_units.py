@@ -154,3 +154,19 @@ def test_arena_attach_waits_for_creator(tmp_path):
     t.join(timeout=5)
     assert not t.is_alive() and not errs
     creator.detach()
+
+
+def test_tpu_pod_slice_resources(monkeypatch):
+    """Pod metadata from env (GCE metadata server is the fallback):
+    slice name resource + head resource on worker 0."""
+    from ray_tpu.accelerators.tpu import TPUAcceleratorManager as M
+    monkeypatch.setenv("RAY_TPU_DISABLE_GCE_METADATA", "1")
+    monkeypatch.setenv("TPU_NAME", "slice-a")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert M.get_pod_slice_resources() == {"TPU-slice-a": 1.0}
+    assert M.get_pod_head_resource_name() == "TPU-v5e-16-head"
+    assert M.get_pod_worker_id() == 0
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    assert M.get_pod_head_resource_name() is None
+    assert M.get_pod_worker_id() == 3
