@@ -18,6 +18,7 @@ Design departures from the reference, on purpose:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict, defaultdict
@@ -121,6 +122,18 @@ class _Waiters:
                 w.take_fired()
         finally:
             self.unregister(keys, w)
+
+
+
+def _free_trace(kind, oids, cp=None):
+    if os.environ.get("RAY_TPU_DEBUG_FREE") != "1":
+        return
+    import time as _t
+    import traceback as _tb
+    with open("/tmp/free_trace.log", "a") as f:
+        f.write(f"--- {_t.monotonic():.3f} {os.getpid()} cp={id(cp)} "
+                f"{kind} {[o.hex() for o in oids]}\n")
+        f.write("".join(_tb.format_stack(limit=6)) + "\n")
 
 
 class ControlPlane:
@@ -296,6 +309,7 @@ class ControlPlane:
     def put_inline(self, object_id: bytes, data: bytes,
                    is_error: bool = False, owner: bytes = b"",
                    owner_addr: str = "") -> None:
+        _free_trace(f"put_inline err={is_error}", [object_id], self)
         with self._lock:
             self._inline_data[object_id] = data
             self._objects[object_id] = {
@@ -335,8 +349,17 @@ class ControlPlane:
     def wait_object(self, object_id: bytes,
                     timeout: Optional[float]) -> Optional[Dict[str, Any]]:
         """Block until the object is committed; returns its location."""
-        return self._object_waiters.wait_for(
+        out = self._object_waiters.wait_for(
             lambda: self.get_location(object_id), timeout, [object_id])
+        if out is None and os.environ.get("RAY_TPU_DEBUG_FREE") == "1":
+            with self._lock:
+                present = object_id in self._objects
+                n = len(self._objects)
+            with open("/tmp/waitdbg.log", "a") as f:
+                f.write(f"wait_object TIMEOUT oid={object_id.hex()} "
+                        f"present={present} cp_id={id(self)} "
+                        f"n_objects={n} type={type(object_id)}\n")
+        return out
 
     def get_locations(self, object_ids: List[bytes]
                       ) -> Dict[bytes, Optional[Dict[str, Any]]]:
@@ -420,6 +443,8 @@ class ControlPlane:
                     freed += 1
             if freed:
                 self._j("free_objects", [bytes(o) for o in object_ids])
+        if freed:
+            _free_trace("free_objects", [bytes(o) for o in object_ids])
         return freed
 
     def free_owned(self, object_ids: List[bytes]) -> Dict[str, List[bytes]]:
@@ -440,6 +465,8 @@ class ControlPlane:
                     pending.append(o)
             if freed:
                 self._j("free_objects", freed)
+        if freed:
+            _free_trace("free_owned", freed)
         return {"freed": freed, "pending": pending}
 
     # ------------------------------------------------ refcounting / GC ----
@@ -526,6 +553,7 @@ class ControlPlane:
                         self._owner_died_tombstones.popitem(last=False)
             if victims:
                 self._j("free_objects", victims)
+                _free_trace("gc_sweep", victims)
             # forget zero-marks for ids that were never committed
             stale = [oid for oid, t0 in self._zero_since.items()
                      if t0 < cutoff - 60.0]

@@ -381,6 +381,28 @@ class NodeManager:
         for h in victims:
             self.purge_owned_holder(h)
 
+    def debug_state(self) -> Dict[str, Any]:
+        """Introspection snapshot for ``ray-tpu stack``-style debugging:
+        queue depths, worker states, per-actor queue lengths."""
+        with self._lock:
+            return {
+                "pending": len(self._pending),
+                "waiting": len(self._waiting),
+                "workers": {w.worker_id.hex()[:12]:
+                            {"state": w.state,
+                             "task": (w.current_task.name
+                                      if w.current_task else None),
+                             "inflight_actor_tasks":
+                             len(w.inflight_actor_tasks)}
+                            for w in self._workers.values()},
+                "actors": {aid.hex()[:12]:
+                           {"state": st.state,
+                            "queued": len(st.queued),
+                            "worker": (st.worker.worker_id.hex()[:12]
+                                       if st.worker else None)}
+                           for aid, st in self._actors.items()},
+            }
+
     def signal_stack_dump(self) -> List[int]:
         """``ray stack`` equivalent (reference: py-spy-based
         ``python/ray/scripts/scripts.py stack``): SIGUSR1 every live
@@ -465,12 +487,20 @@ class NodeManager:
             self._pending.append(spec)
         self._wake.set()
 
+    def _satrace(self, *parts) -> None:
+        if os.environ.get("RAY_TPU_DEBUG_FREE") != "1":
+            return
+        with open("/tmp/sat_trace.log", "a") as f:
+            f.write(f"{time.monotonic():.3f} {os.getpid()} "
+                    + " ".join(str(p) for p in parts) + "\n")
+
     def submit_actor_task(self, spec: TaskSpec) -> None:
         """Queue a method call on an actor hosted by this node."""
         self._pin_dependencies(spec)
         with self._lock:
             astate = self._actors.get(spec.actor_id)
             if astate is None or astate.state == "DEAD":
+                self._satrace("DROP dead", spec.name, spec.task_id.hex()[:20])
                 self._fail_task(spec, ActorDiedError(
                     spec.actor_id.hex() if spec.actor_id else "",
                     "actor not found or dead"))
@@ -481,14 +511,21 @@ class NodeManager:
             if any(t.task_id == spec.task_id for t in astate.queued) or (
                     astate.worker is not None and spec.task_id in
                     astate.worker.inflight_actor_tasks):
+                self._satrace("DROP dup-queued", spec.name,
+                              spec.task_id.hex()[:20])
                 return
             ret_ids = spec.return_object_ids()
             if ret_ids:
                 try:
                     if self.cp.get_location(ret_ids[0]) is not None:
+                        self._satrace("DROP committed", spec.name,
+                                      spec.task_id.hex()[:20])
                         return  # the retried copy already finished
                 except Exception:  # noqa: BLE001
                     pass
+            self._satrace("QUEUE", spec.name, spec.task_id.hex()[:20],
+                          "astate", astate.state,
+                          "worker", bool(astate.worker))
             astate.queued.append(spec)
             self._flush_actor_queue_locked(astate)
         self._wake.set()

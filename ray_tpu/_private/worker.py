@@ -708,6 +708,15 @@ class CoreWorker:
                 self.cp, self._nm_peer, b"task:" + spec.task_id,
                 {spec.ref_owners.get(d) for d in deps})
 
+    def _abtrace(self, *parts) -> None:
+        import os
+        if os.environ.get("RAY_TPU_DEBUG_ACTOR_BUFFER") != "1":
+            return
+        import time as _t
+        with open("/tmp/ab_trace.log", "a") as f:
+            f.write(f"{_t.monotonic():.3f} {os.getpid()} "
+                    + " ".join(str(p) for p in parts) + "\n")
+
     def _route_or_buffer(self, spec: TaskSpec, streaming: bool) -> None:
         """Route to the actor's node manager, or buffer until it's ALIVE.
 
@@ -717,6 +726,8 @@ class CoreWorker:
         actor_id = spec.actor_id
         info = self.cp.get_actor_info(actor_id)
         state = info.get("state") if info else None
+        self._abtrace("route_or_buffer", spec.name,
+                      actor_id.hex()[:8], "state", state)
         with self._actor_buffer_lock:
             buffer = self._actor_buffers.get(actor_id)
             if state == "ALIVE" and buffer is None:
@@ -738,7 +749,9 @@ class CoreWorker:
                 return
         try:
             self._route_now(spec, streaming)
+            self._abtrace("routed_direct", spec.name)
         except ActorDiedError as e:
+            self._abtrace("fail_direct", spec.name, str(e)[:60])
             self._fail_actor_call(spec, streaming, e)
         except (OSError, ConnectionError):
             # The actor's node manager is unreachable (its node just
@@ -759,6 +772,8 @@ class CoreWorker:
         deadline = time.monotonic() + 600.0
         info = self.cp.wait_actor_state(actor_id, ("ALIVE", "DEAD"),
                                         timeout=600.0)
+        self._abtrace("flusher_woke", actor_id.hex()[:8],
+                      (info or {}).get("state"))
         while True:
             with self._actor_buffer_lock:
                 buffered = self._actor_buffers.get(actor_id, [])
@@ -779,7 +794,10 @@ class CoreWorker:
                 else:
                     try:
                         self._route_now(spec, streaming)
+                        self._abtrace("flushed", spec.name)
                     except ActorDiedError as e:
+                        self._abtrace("fail_flush", spec.name,
+                                      str(e)[:60])
                         self._fail_actor_call(spec, streaming, e)
                     except (OSError, ConnectionError):
                         retry.append((spec, streaming))

@@ -21,7 +21,20 @@ _METADATA_FILE = ".metadata.json"
 
 class Checkpoint:
     def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+        from ray_tpu.train.storage import is_remote_uri
+        self._remote = is_remote_uri(path)
+        self.path = path if self._remote else os.path.abspath(path)
+
+    def _local(self) -> str:
+        """A local directory with this checkpoint's contents (downloads
+        remote checkpoints into a cached temp dir once per process)."""
+        if not self._remote:
+            return self.path
+        if getattr(self, "_local_cache", None) is None:
+            from ray_tpu.train.storage import download_dir
+            self._local_cache = download_dir(
+                self.path, tempfile.mkdtemp(prefix="rtpu_ckpt_dl_"))
+        return self._local_cache
 
     # ------------------------------------------------------------ builders
     @classmethod
@@ -39,7 +52,7 @@ class Checkpoint:
 
     def to_dict(self) -> Dict[str, Any]:
         import cloudpickle
-        with open(os.path.join(self.path, "dict_checkpoint.pkl"),
+        with open(os.path.join(self._local(), "dict_checkpoint.pkl"),
                   "rb") as f:
             return cloudpickle.load(f)
 
@@ -54,7 +67,7 @@ class Checkpoint:
         os.replace(tmp, target)
 
     def get_metadata(self) -> Dict[str, Any]:
-        p = os.path.join(self.path, _METADATA_FILE)
+        p = os.path.join(self._local(), _METADATA_FILE)
         if not os.path.exists(p):
             return {}
         with open(p) as f:
@@ -64,8 +77,9 @@ class Checkpoint:
     def to_directory(self, dest: Optional[str] = None) -> str:
         dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
         os.makedirs(dest, exist_ok=True)
-        for name in os.listdir(self.path):
-            src = os.path.join(self.path, name)
+        local = self._local()
+        for name in os.listdir(local):
+            src = os.path.join(local, name)
             dst = os.path.join(dest, name)
             if os.path.isdir(src):
                 shutil.copytree(src, dst, dirs_exist_ok=True)
@@ -75,12 +89,19 @@ class Checkpoint:
 
     @contextmanager
     def as_directory(self):
-        yield self.path
+        yield self._local()
 
     def persist(self, storage_dir: str, name: Optional[str] = None) -> \
             "Checkpoint":
-        """Copy into durable storage; returns the persisted checkpoint."""
+        """Copy into durable storage — a local path or any fsspec URI
+        (``gs://`` / ``s3://`` / ``memory://`` …); returns the
+        persisted checkpoint."""
+        from ray_tpu.train.storage import is_remote_uri, upload_dir
         name = name or f"checkpoint_{uuid.uuid4().hex[:8]}"
+        if is_remote_uri(storage_dir):
+            dest = f"{storage_dir.rstrip('/')}/{name}"
+            upload_dir(self._local(), dest)
+            return Checkpoint(dest)
         dest = os.path.join(storage_dir, name)
         os.makedirs(storage_dir, exist_ok=True)
         if os.path.abspath(self.path) == os.path.abspath(dest):

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import CheckpointConfig
+from ray_tpu.train.storage import delete_uri, is_remote_uri, list_uri
 
 
 class CheckpointManager:
@@ -36,10 +37,17 @@ class CheckpointManager:
         import glob
         import re
         found = []
-        for path in glob.glob(os.path.join(self.storage_dir,
-                                           "checkpoint_*")):
-            m = re.search(r"checkpoint_(\d+)", os.path.basename(path))
-            if m and os.path.isdir(path):
+        if is_remote_uri(self.storage_dir):
+            base = self.storage_dir.rstrip("/")
+            entries = [(name, f"{base}/{name}")
+                       for name in list_uri(self.storage_dir)]
+        else:
+            entries = [(os.path.basename(p), p) for p in glob.glob(
+                os.path.join(self.storage_dir, "checkpoint_*"))
+                if os.path.isdir(p)]
+        for name, path in entries:
+            m = re.search(r"checkpoint_(\d+)", name)
+            if m:
                 found.append((int(m.group(1)), path))
         for idx, path in sorted(found):
             ckpt = Checkpoint(path)
@@ -61,15 +69,17 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:
-        persisted = checkpoint.persist(
-            self.storage_dir, f"checkpoint_{self._index:06d}")
         try:
-            meta = persisted.get_metadata()
+            # stamp metrics BEFORE persisting: a remote checkpoint's
+            # set_metadata would have to re-upload
+            meta = checkpoint.get_metadata()
             meta["metrics"] = {k: v for k, v in metrics.items()
                                if isinstance(v, (int, float, str, bool))}
-            persisted.set_metadata(meta)
+            checkpoint.set_metadata(meta)
         except Exception:  # noqa: BLE001 — metadata is best-effort
             pass
+        persisted = checkpoint.persist(
+            self.storage_dir, f"checkpoint_{self._index:06d}")
         self._index += 1
         self.latest = persisted
         attr = self.config.checkpoint_score_attribute
@@ -87,19 +97,27 @@ class CheckpointManager:
                 if self.latest is not None and \
                         ckpt.path == self.latest.path:
                     continue
-                shutil.rmtree(ckpt.path, ignore_errors=True)
+                if is_remote_uri(ckpt.path):
+                    delete_uri(ckpt.path)
+                else:
+                    shutil.rmtree(ckpt.path, ignore_errors=True)
             self.best = self.best[:keep] + [
                 b for b in self.best[keep:]
                 if self.latest is not None and b[2].path ==
                 self.latest.path]
         return persisted
 
+    @staticmethod
+    def _exists(ckpt: Checkpoint) -> bool:
+        if is_remote_uri(ckpt.path):
+            return bool(list_uri(ckpt.path))
+        return os.path.exists(ckpt.path)
+
     def best_checkpoint(self) -> Optional[Checkpoint]:
         for _, _, ckpt, _ in self.best:
-            if os.path.exists(ckpt.path):
+            if self._exists(ckpt):
                 return ckpt
         return self.latest
 
     def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict]]:
-        return [(c, m) for _, _, c, m in self.best
-                if os.path.exists(c.path)]
+        return [(c, m) for _, _, c, m in self.best if self._exists(c)]

@@ -90,11 +90,29 @@ class DataParallelTrainer:
             }, f)
 
     def fit(self) -> Result:
+        from ray_tpu.train.storage import is_remote_uri, upload_dir
         storage = self.run_config.resolved_storage_path()
-        os.makedirs(storage, exist_ok=True)
-        self._save_trainer_blob(storage)
+        if is_remote_uri(storage):
+            # cloud storage_path (gs:// / s3:// / any fsspec URI):
+            # checkpoints persist straight to the remote; the small
+            # trainer blob is written locally then mirrored up so
+            # restore(uri) works from any host
+            ckpt_dir = storage.rstrip("/") + "/checkpoints"
+            local = os.path.join(
+                os.path.expanduser("~/ray_tpu_results"),
+                "_remote_mirror", self.run_config.name or "experiment")
+            os.makedirs(local, exist_ok=True)
+            self._save_trainer_blob(local)
+            try:
+                upload_dir(local, storage)
+            except Exception:  # noqa: BLE001 - blob mirror best-effort
+                pass
+        else:
+            os.makedirs(storage, exist_ok=True)
+            self._save_trainer_blob(storage)
+            ckpt_dir = os.path.join(storage, "checkpoints")
         ckpt_mgr = CheckpointManager(
-            os.path.join(storage, "checkpoints"),
+            ckpt_dir,
             self.run_config.checkpoint_config, resume=self._restored)
         max_failures = self.run_config.failure_config.max_failures
         attempts = (max_failures + 1) if max_failures >= 0 else 10**6
@@ -171,6 +189,19 @@ class DataParallelTrainer:
         (``python/ray/train/base_trainer.py``).
         """
         import cloudpickle
+
+        from ray_tpu.train.storage import is_remote_uri
+        if is_remote_uri(path):
+            # fetch ONLY the small trainer blob — the checkpoints under
+            # the same URI can be huge and rehydrate lazily on demand
+            import tempfile
+
+            import fsspec
+            local = tempfile.mkdtemp(prefix="rtpu_restore_")
+            fs, _, paths = fsspec.get_fs_token_paths(path.rstrip("/"))
+            fs.get_file(paths[0] + "/trainer.pkl",
+                        os.path.join(local, "trainer.pkl"))
+            path = local
         with open(os.path.join(path, "trainer.pkl"), "rb") as f:
             blob = cloudpickle.load(f)
         trainer_cls = blob.pop("cls", cls)

@@ -1,0 +1,31 @@
+import time, functools
+import jax, jax.numpy as jnp
+from ray_tpu.ops.attention import flash_attention
+
+B, H, S, D = 24, 12, 1024, 64
+q = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D), jnp.bfloat16)
+
+def bench(name, f):
+    g = jax.jit(jax.grad(lambda q, k, v: f(q, k, v).astype(jnp.float32).sum(),
+                         argnums=(0, 1, 2)))
+    o = g(q, k, v); float(o[0][0,0,0,0])
+    def run(reps):
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = g(q, k, v)
+        float(out[0][0,0,0,0])
+        return time.perf_counter() - t0
+    run(3)
+    net = run(23) - run(3)
+    print(f"{name}: {net/20*1000:.2f} ms/layer fwd+bwd", flush=True)
+
+for bq, bk in [(1024,1024), (512,512), (512,1024), (1024,512), (256,1024)]:
+    try:
+        bench(f"bq={bq},bk={bk}",
+              functools.partial(flash_attention, causal=True,
+                                block_q=bq, block_k=bk))
+    except Exception as e:
+        print(f"bq={bq},bk={bk}: {type(e).__name__} {str(e)[:80]}", flush=True)

@@ -1,0 +1,19 @@
+import time
+import jax, jax.numpy as jnp
+n = 8192
+for name, maker, f in [
+    ("ones-plain", lambda: jnp.ones((n, n), jnp.bfloat16), lambda a, b: a @ b),
+    ("small-scaled", lambda: jnp.full((n, n), 1.0 / n, jnp.bfloat16), lambda a, b: (a @ b) * 2.0),
+]:
+    m = maker()
+    mm = jax.jit(f)
+    c = mm(m, m); float(c[0, 0])
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            c = mm(c, m)
+        float(c[0, 0])
+        best = max(best, 10 * 2 * n**3 / (time.perf_counter() - t0) / 1e12)
+    print(f"{name}: {best:.1f} TFLOPS", flush=True)
+    del c, m

@@ -1,0 +1,17 @@
+import time
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+print("device:", dev.device_kind)
+n = 8192
+a = jnp.ones((n, n), jnp.bfloat16)
+b = jnp.ones((n, n), jnp.bfloat16)
+f = jax.jit(lambda a, b: a @ b)
+c = f(a, b); float(c[0, 0])
+reps = 20
+t0 = time.perf_counter()
+for _ in range(reps):
+    c = f(c, b)
+float(c[0, 0])
+dt = time.perf_counter() - t0
+tflops = reps * 2 * n**3 / dt / 1e12
+print(f"matmul {n}^3: {tflops:.1f} TFLOPS effective")

@@ -1,0 +1,26 @@
+import time, jax, jax.numpy as jnp
+from ray_tpu.models import training
+from ray_tpu.models.gpt import GPTConfig, num_params
+from ray_tpu.parallel.mesh import make_mesh
+devices = jax.devices()
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+for batch in (32, 48):
+    mesh = make_mesh(dp=len(devices), devices=devices)
+    fns = training.build_gpt_train(cfg, mesh)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, 1024,
+                                     cfg.vocab_size)
+    try:
+        for _ in range(2):
+            state, m = fns["step_fn"](state, bd); float(m["loss"])
+        steps = 20
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = fns["step_fn"](state, bd)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        print(f"batch={batch}: {steps*batch*1024/dt:,.0f} tok/s", flush=True)
+    except Exception as e:
+        print(f"batch={batch}: failed {type(e).__name__}: {str(e)[:120]}", flush=True)
+    del state

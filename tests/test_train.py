@@ -240,3 +240,76 @@ def test_ulysses_sp_trains(ray_start_regular):
         st = fns["init_fn"](jax.random.PRNGKey(0))
         losses[impl] = float(fns["loss_fn"](st.params, batch))
     assert abs(losses["ring"] - losses["ulysses"]) < 1e-4
+
+
+def test_checkpoint_cloud_storage_roundtrip(tmp_path):
+    """Checkpoints persist to any fsspec URI (gs://, s3://, ...) —
+    exercised via the in-memory filesystem (reference:
+    train/_internal/storage.py StorageContext)."""
+    from ray_tpu.train import Checkpoint
+    from ray_tpu.train.storage import delete_uri, list_uri
+
+    delete_uri("memory://ckpts")
+    ckpt = Checkpoint.from_dict({"step": 7, "w": [1.0, 2.0]})
+    ckpt.set_metadata({"metrics": {"loss": 0.5}})
+    remote = ckpt.persist("memory://ckpts", "checkpoint_000001")
+    assert remote.path.startswith("memory://")
+    assert "checkpoint_000001" in list_uri("memory://ckpts")
+
+    # a fresh Checkpoint handle (as if unpickled elsewhere) downloads
+    back = Checkpoint(remote.path)
+    assert back.to_dict()["step"] == 7
+    assert back.get_metadata()["metrics"]["loss"] == 0.5
+    with back.as_directory() as d:
+        assert os.path.exists(os.path.join(d, "dict_checkpoint.pkl"))
+
+
+def test_trainer_cloud_storage_and_restore(ray_start_regular):
+    """DataParallelTrainer with a remote storage_path: checkpoints land
+    on the remote URI, keep-top-k rotates there, restore(uri) resumes
+    from the latest remote checkpoint."""
+    import ray_tpu.train as train
+    from ray_tpu.train import (Checkpoint, CheckpointConfig,
+                               DataParallelTrainer, RunConfig,
+                               ScalingConfig)
+    from ray_tpu.train.storage import delete_uri, list_uri
+
+    uri = "memory://exp-cloud"
+    delete_uri(uri)
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 3):
+            c = (Checkpoint.from_dict({"step": step})
+                 if ctx.get_world_rank() == 0 else None)
+            train.report({"step": step}, checkpoint=c)
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="cloud", storage_path=uri,
+            checkpoint_config=CheckpointConfig(num_to_keep=2)))
+    result = trainer.fit()
+    assert result.metrics["step"] == 2
+    exp_uri = uri + "/cloud"          # resolved_storage_path appends name
+    names = list_uri(exp_uri + "/checkpoints")
+    assert names and len(names) <= 2, names
+    assert result.checkpoint.path.startswith("memory://")
+    assert result.checkpoint.to_dict()["step"] == 2
+
+    # restore(uri): trainer blob fetched from the remote, and the
+    # checkpoint manager rehydrates the remote checkpoint listing.
+    # (memory:// is per-process, so actually RUNNING the resumed loop
+    # would need a cluster-visible filesystem like gs:// — the remote
+    # rehydration itself is what's under test here.)
+    restored = DataParallelTrainer.restore(exp_uri)
+    assert restored._restored
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+    mgr = CheckpointManager(exp_uri + "/checkpoints",
+                            CheckpointConfig(num_to_keep=2), resume=True)
+    assert mgr.latest is not None
+    assert mgr.latest.to_dict()["step"] == 2
