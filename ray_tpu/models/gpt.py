@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -42,6 +43,9 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     tie_embeddings: bool = True
+    # biases on every projection + norm (GPT-2 exact-architecture mode,
+    # used by the HF weight-porting path in ``train.huggingface``)
+    use_bias: bool = False
     # unroll the layer loop instead of lax.scan: scan's per-iteration
     # residual stashing (dynamic-update-slice into [L, ...] buffers)
     # costs ~20% of a training step on TPU; unrolling trades compile
@@ -124,8 +128,22 @@ def init_params(cfg: GPTConfig, key) -> Dict[str, Any]:
             layer["w3"] = norm_init(next(keys), (L, d, f), d ** -0.5)
         layer["w2"] = norm_init(next(keys), (L, f, d),
                                 f ** -0.5 / (2 * L) ** 0.5)
+    if cfg.use_bias:
+        layer["ln1_b"] = jnp.zeros((L, d), dt)
+        layer["ln2_b"] = jnp.zeros((L, d), dt)
+        layer["bq"] = jnp.zeros((L, H, hd), dt)
+        layer["bk"] = jnp.zeros((L, H, hd), dt)
+        layer["bv"] = jnp.zeros((L, H, hd), dt)
+        layer["bo"] = jnp.zeros((L, d), dt)
+        if cfg.n_experts == 0:
+            layer["b1"] = jnp.zeros((L, f), dt)
+            if cfg.act == "swiglu":
+                layer["b3"] = jnp.zeros((L, f), dt)
+            layer["b2"] = jnp.zeros((L, d), dt)
     params["layers"] = layer
     params["ln_f"] = jnp.ones((d,), dt)
+    if cfg.use_bias:
+        params["ln_f_b"] = jnp.zeros((d,), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm_init(next(keys), (d, cfg.vocab_size), 0.02)
     return params
@@ -157,22 +175,39 @@ def param_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
         if cfg.act == "swiglu":
             layer["w3"] = (None, "embed_fsdp", "mlp")
         layer["w2"] = (None, "mlp", "embed_fsdp")
+    if cfg.use_bias:
+        layer["ln1_b"] = (None, None)
+        layer["ln2_b"] = (None, None)
+        layer["bq"] = (None, "heads", None)
+        layer["bk"] = (None, "heads", None)
+        layer["bv"] = (None, "heads", None)
+        layer["bo"] = (None, None)
+        if cfg.n_experts == 0:
+            layer["b1"] = (None, "mlp")
+            if cfg.act == "swiglu":
+                layer["b3"] = (None, "mlp")
+            layer["b2"] = (None, None)
     axes["layers"] = layer
     axes["ln_f"] = (None,)
+    if cfg.use_bias:
+        axes["ln_f_b"] = (None,)
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed_fsdp", "vocab")
     return axes
 
 
-def _norm(x, scale, kind: str):
+def _norm(x, scale, kind: str, bias=None, eps: float = 1e-6):
     x32 = x.astype(jnp.float32)
     if kind == "rmsnorm":
-        x32 = x32 * lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)
+        x32 = x32 * lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
     else:
         mu = jnp.mean(x32, -1, keepdims=True)
         var = jnp.mean((x32 - mu) ** 2, -1, keepdims=True)
-        x32 = (x32 - mu) * lax.rsqrt(var + 1e-6)
-    return (x32 * scale.astype(jnp.float32)).astype(x.dtype)
+        x32 = (x32 - mu) * lax.rsqrt(var + eps)
+    x32 = x32 * scale.astype(jnp.float32)
+    if bias is not None:
+        x32 = x32 + bias.astype(jnp.float32)
+    return x32.astype(x.dtype)
 
 
 def _rope(x, positions, theta: float):
@@ -190,12 +225,20 @@ def _rope(x, positions, theta: float):
 
 def _dense_ffn(lp, x, cfg: GPTConfig):
     h = jnp.einsum("bsd,df->bsf", x, lp["w1"])
+    if "b1" in lp:
+        h = h + lp["b1"]
     if cfg.act == "swiglu":
-        h = jax.nn.silu(h) * jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        g = jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        if "b3" in lp:
+            g = g + lp["b3"]
+        h = jax.nn.silu(h) * g
     else:
         h = jax.nn.gelu(h)
     h = shd.constrain(h, ("batch", "seq", "mlp"))
-    return jnp.einsum("bsf,fd->bsd", h, lp["w2"])
+    out = jnp.einsum("bsf,fd->bsd", h, lp["w2"])
+    if "b2" in lp:
+        out = out + lp["b2"]
+    return out
 
 
 def _moe_ffn(lp, x, cfg: GPTConfig):
@@ -227,10 +270,15 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     and the per-stage scan in the pipeline-parallel trainer
     (``models/training.py`` build_gpt_train_pp)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
-    h = _norm(x, lp["ln1"], cfg.norm)
+    eps = 1e-5 if cfg.use_bias else 1e-6  # HF GPT-2 uses eps=1e-5
+    h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
     fused_rope = (cfg.pos == "rope"
                   and getattr(attn_fn, "fused_rope", False))
     if cfg.pos == "rope" and not fused_rope:
@@ -244,8 +292,11 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     else:
         attn = attn_fn(q, k, v)
     attn = constrain(attn, ("batch", "seq", "heads", None))
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-    h2 = _norm(x, lp["ln2"], cfg.norm)
+    proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    if "bo" in lp:
+        proj = proj + lp["bo"]
+    x = x + proj
+    h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"), eps=eps)
     if cfg.n_experts > 0:
         ffn_out, aux = _moe_ffn(lp, h2, cfg)
     else:
@@ -311,11 +362,14 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
             lp = jax.tree.map(lambda a: a[i], params["layers"])
             x, aux = layer_body(x, lp)
             aux_total = aux_total + aux
-        x = _norm(x, params["ln_f"], cfg.norm)
+        x = _norm(x, params["ln_f"], cfg.norm,
+                  bias=params.get("ln_f_b"),
+                  eps=1e-5 if cfg.use_bias else 1e-6)
         return x, aux_total
     x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
                         params["layers"])
-    x = _norm(x, params["ln_f"], cfg.norm)
+    x = _norm(x, params["ln_f"], cfg.norm, bias=params.get("ln_f_b"),
+              eps=1e-5 if cfg.use_bias else 1e-6)
     return x, jnp.sum(auxes)
 
 
@@ -341,6 +395,8 @@ def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
 # from (x, head) — one extra matmul per chunk for O(chunk * V) transient
 # memory instead of O(B * S * V) resident.
 _CE_CHUNK = 4096
+# bf16 logit residuals for the no-remat CE (env-gated for perf A/B)
+_CE_BF16_RESID = os.environ.get("RAY_TPU_CE_BF16_RESID", "0") == "1"
 
 
 def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
@@ -358,6 +414,13 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
     def chunk_loss(xc, tc):
         logits = jnp.einsum("nd,dv->nv", xc, head,
                             preferred_element_type=jnp.float32)
+        if not remat and _CE_BF16_RESID:
+            # no-remat: the [N, V] logits live between fwd and bwd.
+            # Storing them bf16 halves that residual's HBM traffic
+            # (~2.4 GB at the bench shape); lse/loss still accumulate
+            # in f32 from the rounded values, and the bwd softmax from
+            # bf16 logits is well within grad noise.
+            logits = logits.astype(jnp.bfloat16).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         true = jnp.take_along_axis(
             logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]

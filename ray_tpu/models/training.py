@@ -210,7 +210,9 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                                   mesh=mesh, num_microbatches=M,
                                   params_spec=stage_spec)
         h = out.reshape(B, S, d)
-        h = gpt_mod._norm(h, params["ln_f"], cfg.norm)
+        h = gpt_mod._norm(h, params["ln_f"], cfg.norm,
+                          bias=params.get("ln_f_b"),
+                          eps=1e-5 if cfg.use_bias else 1e-6)
         return gpt_mod.loss_from_hidden(params, h, targets, cfg)
 
     st_sh = _state_shardings(init, param_sh, mesh)

@@ -28,6 +28,18 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 STATS_LANES = 8   # lse/delta stored [B, H, num_q, bq, 8] for tiling
 
+# default causal-backward blocking (profiled on v5e at GPT-2 shapes;
+# env-overridable for per-shape A/B on new hardware)
+import os as _os  # noqa: E402
+_BWD_BQ = int(_os.environ.get("RAY_TPU_ATTN_BWD_BQ", "512"))
+_BWD_BK = int(_os.environ.get("RAY_TPU_ATTN_BWD_BK", "512"))
+# base-2 softmax: exp2 is the VPU-native transcendental; scores carry a
+# log2(e) factor so p = exp2(s2 - m2) == exp(s - m) exactly, one fewer
+# per-element multiply inside the hottest loop.  lse is stored in
+# base-2 units (fwd and bwd agree; nothing outside the kernels reads it)
+_EXP2 = _os.environ.get("RAY_TPU_ATTN_EXP2", "0") == "1"
+_LOG2E = 1.4426950408889634
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -92,9 +104,20 @@ def _rot_t(g, cos2, sinm, D: int):
     return out.astype(g.dtype)
 
 
+def _exp(x):
+    return jnp.exp2(x) if _EXP2 else jnp.exp(x)
+
+
+def _log(x):
+    return jnp.log2(x) if _EXP2 else jnp.log(x)
+
+
 def _masked_scores(q, k, i, j, *, scale: float, causal: bool,
                    block_q: int, block_k: int):
-    """f32 scaled q@k^T for blocks (i, j) with the causal mask applied."""
+    """f32 scaled q@k^T for blocks (i, j) with the causal mask applied
+    (scores in base-2 units when _EXP2: scale carries the log2e)."""
+    if _EXP2:
+        scale = scale * _LOG2E
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale       # [bq, bk]
@@ -123,7 +146,7 @@ def _grad_blocks(q, k, v, do, lse, delta, i, j, *, scale: float,
     caller (which differ per kernel in what they accumulate)."""
     s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
                        block_q=block_q, block_k=block_k)
-    p = jnp.exp(s - lse)
+    p = _exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)              # [bq, bk]
@@ -166,8 +189,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         m_prev = m_sc[:]                      # [bq, 128] (col-bcast)
         m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])                      # [bq, bk]
+        alpha = _exp(m_prev - m_new)
+        p = _exp(s - m_new[:, :1])                         # [bq, bk]
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, 1, keepdims=True)
         acc_sc[:] = (acc_sc[:] * alpha[:, :1]
                      + jax.lax.dot_general(
@@ -180,7 +203,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         l = l_sc[:, :1]
         o_ref[0, 0] = (acc_sc[:]
                        / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse = m_sc[:, :1] + jnp.log(jnp.maximum(l, 1e-30))   # [bq, 1]
+        lse = m_sc[:, :1] + _log(jnp.maximum(l, 1e-30))   # [bq, 1]
         lse_ref[0, 0, 0] = jnp.broadcast_to(lse, lse_ref.shape[3:])
 
 
@@ -278,19 +301,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, scale: float, causal: bool, block_q: int,
-                      block_k: int, num_q: int, has_rope: bool):
-    """Single-kv-block backward: dq, dk, dv in one pass over (b, h, i).
+                      block_k: int, num_q: int, num_kv: int,
+                      has_rope: bool):
+    """Strip-mined fused backward: dq, dk, dv in one pass over (b, h, i).
 
     The two-kernel backward (`_bwd_dq_kernel` + `_bwd_dkv_kernel`)
-    recomputes the score block and dp in each kernel — 2 extra K=head_dim
-    matmuls per block pair, the expensive kind on the MXU (contraction
-    = 64 runs the systolic array at half rate).  When the whole kv
-    sequence fits one block (num_kv == 1: the S<=block_k case, e.g.
-    GPT-2 @ 1024 with 1024 blocks) s/p/dp can be computed once and feed
-    all three gradients: dq is written exactly once per q block, dk/dv
-    accumulate in VMEM scratch across the sequential i sweep.  Longer
-    sequences take the two-kernel path (`_bwd`), whose per-block
-    accumulations don't need cross-step output revisiting.
+    recomputes the score block and dp in each kernel — 2 extra
+    K=head_dim matmuls per block pair, the expensive kind on the MXU
+    (contraction = 64 runs the systolic array at half rate).  Here the
+    whole kv sequence rides along as one [Sk, D] block and the kernel
+    walks it in ``block_k`` strips: s/p/dp are computed once per strip
+    and feed all three gradients.  Causal masking goes from "compute
+    the full square then mask" to *skipping dead strips outright*
+    (``_block_live``) — at bq=bk=256 over S=1024 that's 37.5% of the
+    score matmuls and, just as importantly on TPU, of the VPU
+    exp/mask work that otherwise rivals the MXU time at head_dim 64.
+    dq accumulates in VMEM scratch per q block; dk/dv accumulate in
+    [Sk, D] scratch across the sequential i sweep (VMEM-bounded: the
+    `_bwd` dispatcher falls back to the two-kernel path for long Sk).
 
     With ``has_rope``, q/k are rotated in-kernel for the score
     recompute; score-gradients land on the *rotated* q/k, so dq takes
@@ -300,36 +328,76 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """
     if has_rope:
         (cq_ref, sq_ref, ck_ref, sk_ref,
-         dq_ref, dk_ref, dv_ref, dk_sc, dv_sc) = rest
+         dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc, krot_sc) = rest
     else:
-        dq_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc = rest
     i = pl.program_id(2)                        # q block index
 
     @pl.when(i == 0)
     def _init_kv():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
+        if has_rope and num_kv > 1:
+            # rotate k ONCE per (b, h): every q block's strips reuse the
+            # cached rotation instead of re-rotating per (i, strip)
+            krot_sc[:] = _rot(k_ref[0, 0], ck_ref[...], sk_ref[...],
+                              k_ref.shape[-1])
 
     q = q_ref[0, 0]
-    k = k_ref[0, 0]
     do = do_ref[0, 0]
     D = q.shape[-1]
     if has_rope:
         q = _rot(q, cq_ref[...], sq_ref[...], D)
-        k = _rot(k, ck_ref[...], sk_ref[...], D)
-    p, ds = _grad_blocks(
-        q, k, v_ref[0, 0], do, lse_ref[0, 0, 0][:, 0:1],
-        delta_ref[0, 0, 0][:, 0:1], i, 0,
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k)
-    dv_sc[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)          # [bk, D]
-    dk_sc[:] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)          # [bk, D]
-    dq = jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    lse = lse_ref[0, 0, 0][:, 0:1]
+    delta = delta_ref[0, 0, 0][:, 0:1]
+
+    if num_kv == 1:
+        # single strip: every block pair is live under causal masking,
+        # so no liveness guard — and dq/k go straight through values
+        # instead of VMEM scratch round-trips (this is the exact hot
+        # path of the S<=block_k case, keep it lean)
+        k = k_ref[0, 0]
+        if has_rope:
+            k = _rot(k, ck_ref[...], sk_ref[...], D)
+        p, ds = _grad_blocks(
+            q, k, v_ref[0, 0], do, lse, delta, i, 0,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k)
+        dv_sc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        dk_sc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        dq = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+        for j in range(num_kv):
+            lo, hi = j * block_k, (j + 1) * block_k
+
+            @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                                 block_k=block_k))
+            def _strip(j=j, lo=lo, hi=hi):
+                if has_rope:
+                    k = krot_sc[lo:hi, :]
+                else:
+                    k = k_ref[0, 0, lo:hi, :]
+                p, ds = _grad_blocks(
+                    q, k, v_ref[0, 0, lo:hi, :], do, lse, delta, i, j,
+                    scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k)
+                dv_sc[lo:hi, :] += jax.lax.dot_general(
+                    p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bk, D]
+                dk_sc[lo:hi, :] += jax.lax.dot_general(
+                    ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bk, D]
+                dq_sc[:] += jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        dq = dq_sc[:]
     if has_rope:
         dq = _rot_t(dq, cq_ref[...], sq_ref[...], D)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
@@ -382,14 +450,22 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
     Sk = k.shape[2]
     bq, bk = min(block_q, S), min(block_k, Sk)
     num_q, num_kv = S // bq, Sk // bk
+    if lse.shape[3] != bq:
+        # fwd ran with a different q block; the stats are [.., S, LANES]
+        # rows underneath — regroup to this pass's blocking
+        lse = lse.reshape(B, H, num_q, bq, STATS_LANES)
     delta = jnp.broadcast_to(
         jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                 axis=-1).reshape(B, H, num_q, bq, 1),
         (B, H, num_q, bq, STATS_LANES))
 
-    if num_kv == 1:
+    # strip-mined fused path: the whole kv sequence rides as one block
+    # and the kernel walks it in bk strips (skipping causally-dead
+    # ones).  [Sk, D] f32 scratch x2 bounds it to moderate Sk; longer
+    # sequences take the two-kernel path below.
+    if Sk * D * 4 * 2 <= 8 * 1024 * 1024:
         qs = pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0))
-        ks = pl.BlockSpec((1, 1, bk, D), lambda b, h, i: (b, h, 0, 0))
+        ks = pl.BlockSpec((1, 1, Sk, D), lambda b, h, i: (b, h, 0, 0))
         rs = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
                           lambda b, h, i: (b, h, i, 0, 0))
         rope_args, rope_specs = (), []
@@ -399,13 +475,14 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
             rope_specs = [
                 pl.BlockSpec((bq, D), lambda b, h, i: (i, 0)),
                 pl.BlockSpec((bq, D), lambda b, h, i: (i, 0)),
-                pl.BlockSpec((bk, D), lambda b, h, i: (0, 0)),
-                pl.BlockSpec((bk, D), lambda b, h, i: (0, 0)),
+                pl.BlockSpec((Sk, D), lambda b, h, i: (0, 0)),
+                pl.BlockSpec((Sk, D), lambda b, h, i: (0, 0)),
             ]
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, scale=scale,
                               causal=causal, block_q=bq, block_k=bk,
-                              num_q=num_q, has_rope=rope is not None),
+                              num_q=num_q, num_kv=num_kv,
+                              has_rope=rope is not None),
             grid=(B, H, num_q),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel",
@@ -415,12 +492,17 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
             out_shape=[jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
                        jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
                        jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype)],
-            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                            pltpu.VMEM((bk, D), jnp.float32)],
+            scratch_shapes=(
+                [pltpu.VMEM((bq, D), jnp.float32),
+                 pltpu.VMEM((Sk, D), jnp.float32),
+                 pltpu.VMEM((Sk, D), jnp.float32)]
+                + ([pltpu.VMEM((Sk, D), q.dtype)]
+                   if rope is not None else [])),
             interpret=_use_interpret(),
         )(q, k, v, do, lse, delta, *rope_args)
         return dq, dk, dv
-    assert rope is None, "fused rope requires a single kv block"
+    assert rope is None, \
+        "fused rope requires the strip-mined backward (moderate Sk)"
 
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
@@ -468,48 +550,52 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k,
+                bwd_block_q, bwd_block_k):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                 block_k=block_k)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k,
+                    bwd_block_q, bwd_block_k):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, bwd_block_q,
+                    bwd_block_k, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k)
+                      block_q=bwd_block_q, block_k=bwd_block_k)
     return dq, dk, dv
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_bhsd_rope(q, k, v, cos2, sinm, scale, causal, block_q,
-                     block_k):
+                     block_k, bwd_block_q, bwd_block_k):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                 block_k=block_k, rope=(cos2, sinm))
     return o
 
 
 def _flash_bhsd_rope_fwd(q, k, v, cos2, sinm, scale, causal, block_q,
-                         block_k):
+                         block_k, bwd_block_q, bwd_block_k):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, rope=(cos2, sinm))
     return o, (q, k, v, cos2, sinm, o, lse)
 
 
-def _flash_bhsd_rope_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_bhsd_rope_bwd(scale, causal, block_q, block_k, bwd_block_q,
+                         bwd_block_k, res, do):
     q, k, v, cos2, sinm, o, lse = res
     dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k,
+                      block_q=bwd_block_q, block_k=bwd_block_k,
                       rope=(cos2, sinm))
     return dq, dk, dv, None, None
 
@@ -527,12 +613,22 @@ def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 1024,
-                    block_k: int = 1024, positions=None,
+                    block_k: int = 1024,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None,
+                    positions=None,
                     rope_theta: float = 10000.0):
     """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
 
     Drop-in for ``ray_tpu.parallel.ring_attention.local_attention``;
     falls back to the einsum path for shapes the grid cannot tile.
+
+    ``block_q``/``block_k`` tile the forward grid; ``bwd_block_q``/
+    ``bwd_block_k`` (default: profiled per-shape choice) tile the
+    strip-mined backward independently — the fwd likes one big block
+    (per-grid-step overhead dominates any causal-skip win there) while
+    the bwd walks kv strips inside the kernel and genuinely skips the
+    causally-dead ones.
 
     ``positions`` [S] enables fused RoPE: q/k are rotated inside the
     kernels (zero extra HBM passes) when the kv sequence fits one
@@ -543,10 +639,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
     Sk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
-    kernel_ok = supports(S, Sk, D, block_q=block_q, block_k=block_k)
-    # in-kernel rope needs the fused single-kv-block backward
+    if bwd_block_q is None:
+        bwd_block_q = _BWD_BQ if causal else block_q
+        bwd_block_q = min(block_q, bwd_block_q)
+    if bwd_block_k is None:
+        bwd_block_k = _BWD_BK if causal else block_k
+        bwd_block_k = min(block_k, bwd_block_k)
+    kernel_ok = (supports(S, Sk, D, block_q=block_q, block_k=block_k)
+                 and supports(S, Sk, D, block_q=bwd_block_q,
+                              block_k=bwd_block_k))
+    # in-kernel rope needs the strip-mined fused backward (kv rides as
+    # one block; bound matches _bwd's VMEM-scratch budget)
     fuse_rope = (positions is not None and kernel_ok
-                 and S == Sk and Sk <= block_k)
+                 and S == Sk and Sk * D * 8 <= 8 * 1024 * 1024)
     if positions is not None and S != Sk:
         raise ValueError(f"rope needs q and kv positions to match: "
                          f"S={S} vs Sk={Sk}")
@@ -562,9 +667,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if fuse_rope:
         cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
         o = _flash_bhsd_rope(qt, kt, vt, cos2, sinm, scale, causal,
-                             block_q, block_k)
+                             block_q, block_k, bwd_block_q, bwd_block_k)
     else:
-        o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k)
+        o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k,
+                        bwd_block_q, bwd_block_k)
     return jnp.swapaxes(o, 1, 2)
 
 

@@ -1,0 +1,232 @@
+"""Round-5: attention implementation shootout + CE/embed variants."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, H, S, D = 24, 12, 1024, 64
+
+
+def net_time(run, reps):
+    run(2)
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    leaves = [t for t in jax.tree.leaves(x) if hasattr(t, "dtype")]
+    float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1]))
+
+
+q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+
+
+def bench_attn(name, f, layout="bshd"):
+    """f takes (q,k,v) in given layout, returns out same layout."""
+    x0 = q if layout == "bshd" else jnp.moveaxis(q, 2, 1)
+
+    def loss(x):
+        return jnp.sum(f(x, x, x).astype(jnp.float32))
+
+    g1 = jax.grad(loss)
+
+    def chain(x):
+        for _ in range(6):
+            x = g1(x).astype(jnp.bfloat16) * 1e-3 + x0
+        return x
+
+    try:
+        jfn = jax.jit(chain)
+
+        def run(reps):
+            y = x0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = jfn(y)
+            fetch(y)
+            return time.perf_counter() - t0
+
+        dt = net_time(run, 4)
+        print(f"{name:40s} {dt*1e3/6:6.2f} ms/layer "
+              f"-> {dt*1e3*2:6.1f} ms/step(12)", flush=True)
+    except Exception as e:
+        print(f"{name:40s} FAIL {type(e).__name__}: {str(e)[:100]}",
+              flush=True)
+
+
+from ray_tpu.ops.attention import flash_attention  # noqa: E402
+
+for bq, bk in ((1024, 1024), (512, 512)):
+    bench_attn(f"ours bq={bq} bk={bk}",
+               functools.partial(flash_attention, causal=True,
+                                 block_q=bq, block_k=bk))
+
+# XLA plain
+def xla_attn(q, k, v):
+    qh = jnp.moveaxis(q, 2, 1)
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * (D ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return jnp.moveaxis(o, 1, 2)
+
+
+bench_attn("xla plain (f32 softmax)", xla_attn)
+
+# jax library flash attention (layout b h s d)
+try:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jflash, BlockSizes)
+
+    def jax_flash(q, k, v):
+        qh = jnp.moveaxis(q, 2, 1)
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        o = jflash(qh, kh, vh, causal=True)
+        return jnp.moveaxis(o, 1, 2)
+
+    bench_attn("jax pallas flash (default blocks)", jax_flash)
+except Exception as e:
+    print("jax flash import fail:", e, flush=True)
+
+# splash attention
+try:
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm)
+
+    mask = sm.CausalMask((S, S))
+    mqs = sk.MultiHeadMask([mask] * H)
+    kernel = sk.make_splash_mha(
+        mask=mqs, head_shards=1, q_seq_shards=1)
+
+    def splash(q, k, v):
+        qh = jnp.moveaxis(q, 2, 1)
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        o = jax.vmap(kernel)(qh * (D ** -0.5), kh, vh)
+        return jnp.moveaxis(o, 1, 2)
+
+    bench_attn("jax splash mha", splash)
+except Exception as e:
+    print("splash import fail:", type(e).__name__, str(e)[:120], flush=True)
+
+# ---- CE variants ----
+N, d, V = B * S, 768, 50304
+x = jax.random.normal(jax.random.PRNGKey(1), (N, d), jnp.bfloat16)
+head = jax.random.normal(jax.random.PRNGKey(2), (d, V), jnp.bfloat16)
+tgt = jax.random.randint(jax.random.PRNGKey(4), (N,), 0, V)
+
+
+def bench_ce(name, cefn):
+    g = jax.value_and_grad(cefn, argnums=(0, 1))
+
+    def chain(x0, h0):
+        tot = jnp.float32(0)
+        for _ in range(4):
+            l, (dx, dh) = g((x0 + tot * 0).astype(jnp.bfloat16), h0)
+            tot = tot + l
+        return tot
+
+    try:
+        jfn = jax.jit(chain)
+
+        def run(reps):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jfn(x, head)
+            fetch(out)
+            return time.perf_counter() - t0
+
+        dt = net_time(run, 2)
+        print(f"{name:40s} {dt*1e3/4:6.1f} ms", flush=True)
+    except Exception as e:
+        print(f"{name:40s} FAIL {type(e).__name__}: {str(e)[:100]}",
+              flush=True)
+
+
+def ce_noremat_f32(x, h):
+    logits = jnp.einsum("nd,dv->nv", x, h,
+                        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - true)
+
+
+def ce_noremat_bf16(x, h):
+    # store bf16 logits between fwd and bwd: halve the 4.9GB residency
+    logits = jnp.einsum("nd,dv->nv", x, h,
+                        preferred_element_type=jnp.float32)
+    logits = logits.astype(jnp.bfloat16)
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1)
+    true = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - true.astype(jnp.float32))
+
+
+@jax.custom_vjp
+def _ce_fused(x, h):
+    logits = jnp.einsum("nd,dv->nv", x, h,
+                        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - true)
+
+
+def _ce_fwd(x, h):
+    logits = jnp.einsum("nd,dv->nv", x, h,
+                        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(logits, tgt[:, None], axis=-1)[:, 0]
+    # residual: softmax in bf16 (the only [N,V] tensor kept)
+    p = jnp.exp(logits - lse[:, None]).astype(jnp.bfloat16)
+    return jnp.mean(lse - true), (x, h, p)
+
+
+def _ce_bwd(res, gbar):
+    x, h, p = res
+    n = p.shape[0]
+    dlog = p.astype(jnp.bfloat16)
+    # subtract one-hot: dlogits = (softmax - onehot) * g / N
+    dlog = dlog.at[jnp.arange(n), tgt].add(-1.0)
+    dlog = dlog * (gbar / n)
+    dx = jnp.einsum("nv,dv->nd", dlog, h)
+    dh = jnp.einsum("nd,nv->dv", x, dlog)
+    return dx.astype(x.dtype), dh.astype(h.dtype)
+
+
+_ce_fused.defvjp(_ce_fwd, _ce_bwd)
+
+bench_ce("CE no-remat f32 resid", ce_noremat_f32)
+bench_ce("CE no-remat bf16 resid", ce_noremat_bf16)
+bench_ce("CE custom-vjp bf16 softmax resid", _ce_fused)
+
+# ---- embed fwd+bwd ----
+table = jax.random.normal(jax.random.PRNGKey(5), (V, d), jnp.bfloat16)
+tok = jax.random.randint(jax.random.PRNGKey(6), (B, S), 0, V)
+
+
+def emb_loss(t):
+    return jnp.sum(t[tok].astype(jnp.float32))
+
+
+ge = jax.jit(lambda t: jax.grad(emb_loss)(t))
+
+
+def run_e(reps):
+    g = table
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = ge(g).astype(jnp.bfloat16)
+    fetch(g)
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_e, 3)
+print(f"{'embed gather fwd+bwd (scatter-add)':40s} {dt*1e3:6.1f} ms",
+      flush=True)

@@ -1,0 +1,207 @@
+"""Round-5 perf breakdown: latency-corrected ceiling + per-component step costs.
+
+Method: time N reps and 3N reps of the same chained jit fn; (t3 - t1)/2N
+cancels both the fetch latency and the dispatch overhead.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt as gpt_mod
+from ray_tpu.models import training
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.parallel.mesh import make_mesh
+
+
+def net_time(run, reps):
+    """run(n) -> wall seconds incl. fixed latency; returns secs/rep net."""
+    run(2)  # warm
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    leaves = [t for t in jax.tree.leaves(x) if hasattr(t, "dtype")]
+    float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1]))
+
+
+dev = jax.devices()[0]
+print("device:", dev.device_kind, flush=True)
+
+# --- 1. true matmul ceiling ---
+n = 4096
+a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+mm = jax.jit(lambda a, b: a @ b)
+
+
+def run_mm(reps):
+    c = a
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        c = mm(c, b)
+    fetch(c)
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_mm, 30)
+print(f"matmul {n}^3 ceiling: {2 * n**3 / dt / 1e12:.1f} TFLOPs", flush=True)
+
+# --- 2. full train step (current recipe) ---
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+B, S = 24, 1024
+mesh = make_mesh(dp=1, devices=[dev])
+fns = training.build_gpt_train(cfg, mesh)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), B, S,
+                                    cfg.vocab_size)
+
+
+def run_step(reps):
+    global state
+    t0 = time.perf_counter()
+    m = None
+    for _ in range(reps):
+        state, m = fns["step_fn"](state, batch)
+    fetch(m["loss"])
+    return time.perf_counter() - t0
+
+
+step_dt = net_time(run_step, 10)
+tok_s = B * S / step_dt
+print(f"full step: {step_dt*1e3:.1f} ms  ({tok_s:,.0f} tok/s, "
+      f"mfu {tok_s*6*123.6e6/1e12/197:.3f})", flush=True)
+
+# --- 3. attention fwd+bwd, 12 layers ---
+q = jax.random.normal(jax.random.PRNGKey(3), (B, S, 12, 64), jnp.bfloat16)
+
+
+def attn_loss(x):
+    o = flash_attention(x, x, x, causal=True)
+    return jnp.sum(o.astype(jnp.float32))
+
+
+ga = jax.jit(lambda x: functools.reduce(
+    lambda g, _: jax.grad(attn_loss)(g).astype(jnp.bfloat16), range(12), x))
+
+
+def run_attn(reps):
+    g = q
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = ga(g)
+    fetch(g)
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_attn, 5)
+print(f"attn fwd+bwd x12: {dt*1e3:.1f} ms", flush=True)
+
+# --- 4. CE fwd+bwd (no-remat, current) ---
+x = jax.random.normal(jax.random.PRNGKey(1), (B * S, 768), jnp.bfloat16)
+head = jax.random.normal(jax.random.PRNGKey(2), (768, 50304), jnp.bfloat16)
+tgt = jax.random.randint(jax.random.PRNGKey(4), (B * S,), 0, 50304)
+
+
+def ce(xc, hd):
+    s, nn = gpt_mod._chunked_ce(xc, hd, tgt, chunk=-1)
+    return s / nn
+
+
+gce = jax.grad(ce, argnums=(0, 1))
+
+
+def ce_rep(x0, h0):
+    gx, gh = x0, h0
+    for _ in range(4):
+        dx, dh = gce(gx.astype(jnp.bfloat16), gh.astype(jnp.bfloat16))
+        gx, gh = x0 + 0 * dx, h0 + 0 * dh
+    return gx, gh
+
+
+jce = jax.jit(ce_rep)
+
+
+def run_ce(reps):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jce(x, head)
+    fetch(out)
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_ce, 3)
+print(f"CE fwd+bwd (no-remat) x1: {dt*1e3/4:.1f} ms", flush=True)
+
+# --- 5. optimizer step alone (adamw on 124M params) ---
+tx = training.default_optimizer()
+params = state.params
+opt_state = tx.init(params)
+grads = jax.tree.map(lambda p: jnp.ones_like(p) * 1e-6, params)
+
+
+@jax.jit
+def opt_rep(params, opt_state):
+    import optax
+    for _ in range(4):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    return params, opt_state
+
+
+def run_opt(reps):
+    global params, opt_state
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, opt_state = opt_rep(params, opt_state)
+    fetch(params["ln_f"])
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_opt, 3)
+print(f"adamw step x1: {dt*1e3/4:.1f} ms", flush=True)
+
+# --- 6. per-layer non-attention matmuls (qkv+o+ffn) fwd+bwd x12 ---
+lp = jax.tree.map(lambda t: t[0], state.params["layers"])
+pos = jnp.arange(S)
+xh = jax.random.normal(jax.random.PRNGKey(8), (B, S, 768), jnp.bfloat16)
+
+
+def layer_no_attn(lp, x):
+    h = gpt_mod._norm(x, lp["ln1"], cfg.norm)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    attn = q + k + v  # stand-in for attention output
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    h2 = gpt_mod._norm(x, lp["ln2"], cfg.norm)
+    return x + gpt_mod._dense_ffn(lp, h2, cfg)
+
+
+def ln_loss(x):
+    y = x
+    for _ in range(12):
+        y = layer_no_attn(lp, y)
+    return jnp.sum(y.astype(jnp.float32))
+
+
+gl = jax.jit(jax.grad(ln_loss))
+
+
+def run_l(reps):
+    g = xh
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = gl(g).astype(jnp.bfloat16)
+    fetch(g)
+    return time.perf_counter() - t0
+
+
+dt = net_time(run_l, 5)
+print(f"12-layer dense matmuls fwd+bwd (no attn): {dt*1e3:.1f} ms",
+      flush=True)

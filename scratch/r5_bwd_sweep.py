@@ -1,0 +1,71 @@
+"""Strip-mined backward sweep: fwd fixed 1024, bwd blocks swept."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.parallel.ring_attention import local_attention
+
+B, H, S, D = 24, 12, 1024, 64
+q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+
+
+def net_time(run, reps):
+    run(2)
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    float(jnp.sum(x.astype(jnp.float32).ravel()[:1]))
+
+
+# numerics check on real hardware (grad, strip kernel)
+f = functools.partial(flash_attention, causal=True, bwd_block_q=256,
+                      bwd_block_k=256)
+g = jax.jit(jax.grad(lambda x: jnp.sum(
+    f(x, x, x).astype(jnp.float32) ** 2)))
+gref = jax.jit(jax.grad(lambda x: jnp.sum(
+    local_attention(x, x, x, causal=True).astype(jnp.float32) ** 2)))
+small = q[:2]
+da, db = g(small), gref(small)
+err = float(jnp.max(jnp.abs(da.astype(jnp.float32)
+                            - db.astype(jnp.float32))))
+ref = float(jnp.max(jnp.abs(db.astype(jnp.float32))))
+print(f"strip-bwd grad err {err:.4f} (ref max {ref:.1f})", flush=True)
+
+for bbq, bbk in ((1024, 1024), (512, 512), (256, 256), (512, 256),
+                 (256, 512)):
+    f = functools.partial(flash_attention, causal=True,
+                          bwd_block_q=bbq, bwd_block_k=bbk)
+
+    def loss(x, f=f):
+        return jnp.sum(f(x, x, x).astype(jnp.float32))
+
+    g1 = jax.grad(loss)
+
+    def chain(x, g1=g1):
+        for _ in range(6):
+            x = (g1(x) * 1e-3 + q).astype(jnp.bfloat16)
+        return x
+
+    try:
+        jfn = jax.jit(chain)
+
+        def run(reps):
+            y = q
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = jfn(y)
+            fetch(y)
+            return time.perf_counter() - t0
+
+        dt = net_time(run, 4)
+        print(f"fwd1024 + bwd({bbq:4d},{bbk:4d}): {dt*1e3/6:6.3f} "
+              f"ms/layer fwd+bwd -> {dt*1e3*2:5.1f} ms/step", flush=True)
+    except Exception as e:
+        print(f"bwd({bbq},{bbk}): FAIL {type(e).__name__} "
+              f"{str(e)[:120]}", flush=True)

@@ -1,0 +1,51 @@
+"""Forward-only flash block sweep (cheap compiles)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+
+B, H, S, D = 24, 12, 1024, 64
+q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+
+
+def net_time(run, reps):
+    run(2)
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    float(jnp.sum(x.astype(jnp.float32).ravel()[:1]))
+
+
+for bq, bk in ((1024, 1024), (512, 512), (256, 256), (256, 512),
+               (512, 256), (128, 256), (256, 128)):
+    f = functools.partial(flash_attention, causal=True,
+                          block_q=bq, block_k=bk)
+
+    def chain(x, f=f):
+        for _ in range(12):
+            x = (f(x, x, x) * 1e-3 + x).astype(jnp.bfloat16)
+        return x
+
+    try:
+        jfn = jax.jit(chain)
+
+        def run(reps):
+            y = q
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = jfn(y)
+            fetch(y)
+            return time.perf_counter() - t0
+
+        dt = net_time(run, 6)
+        print(f"fwd bq={bq:4d} bk={bk:4d}: {dt*1e3/12:6.3f} ms/layer "
+              f"({dt*1e3:5.1f} ms/12)", flush=True)
+    except Exception as e:
+        print(f"fwd bq={bq} bk={bk}: FAIL {type(e).__name__} "
+              f"{str(e)[:80]}", flush=True)

@@ -1,0 +1,52 @@
+"""fwd+bwd with FUSED ROPE (the model path) across bwd blocks."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+
+B, H, S, D = 24, 12, 1024, 64
+q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+pos = jnp.arange(S)
+
+
+def net_time(run, reps):
+    run(2)
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    float(jnp.sum(x.astype(jnp.float32).ravel()[:1]))
+
+
+for bbq, bbk in ((1024, 1024), (512, 512)):
+    f = functools.partial(flash_attention, causal=True,
+                          bwd_block_q=bbq, bwd_block_k=bbk)
+
+    def loss(x, f=f):
+        return jnp.sum(f(x, x, x, positions=pos).astype(jnp.float32))
+
+    g1 = jax.grad(loss)
+
+    def chain(x, g1=g1):
+        for _ in range(6):
+            x = (g1(x) * 1e-3 + q).astype(jnp.bfloat16)
+        return x
+
+    jfn = jax.jit(chain)
+
+    def run(reps):
+        y = q
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            y = jfn(y)
+        fetch(y)
+        return time.perf_counter() - t0
+
+    dt = net_time(run, 4)
+    print(f"rope fwd+bwd bwd=({bbq},{bbk}): {dt*1e3/6:6.3f} ms/layer "
+          f"-> {dt*1e3*2:5.1f} ms/step", flush=True)

@@ -1,0 +1,95 @@
+"""Splash attention: correctness + speed at the bench shape."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental.pallas.ops.tpu.splash_attention import (
+    splash_attention_kernel as sk, splash_attention_mask as sm)
+
+B, H, S, D = 24, 12, 1024, 64
+
+
+def net_time(run, reps):
+    run(2)
+    t1 = run(reps)
+    t3 = run(3 * reps)
+    return (t3 - t1) / (2 * reps)
+
+
+def fetch(x):
+    leaves = [t for t in jax.tree.leaves(x) if hasattr(t, "dtype")]
+    float(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:1]))
+
+
+q = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D), jnp.bfloat16)
+
+mask = sm.MultiHeadMask([sm.CausalMask((S, S)) for _ in range(H)])
+
+
+def make(block):
+    bs = None
+    if block:
+        bs = sk.BlockSizes(
+            block_q=block[0], block_kv=block[1],
+            block_kv_compute=block[1],
+            block_q_dkv=block[0], block_kv_dkv=block[1],
+            block_kv_dkv_compute=block[1],
+            use_fused_bwd_kernel=True)
+    kern = sk.make_splash_mha(mask=mask, block_sizes=bs,
+                              head_shards=1, q_seq_shards=1)
+    def attn(q, k, v):
+        return jax.vmap(kern)(q * (D ** -0.5), k, v)
+    return attn
+
+
+# correctness vs plain
+def ref(q, k, v):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * D**-0.5
+    msk = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(msk, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+attn = make(None)
+o = jax.jit(attn)(q, k, v)
+oref = jax.jit(ref)(q[:2], k[:2], v[:2])
+err = float(jnp.max(jnp.abs(o[:2].astype(jnp.float32)
+                            - oref.astype(jnp.float32))))
+print("max abs err vs ref:", err, flush=True)
+
+
+def bench(name, f):
+    def loss(q):
+        return jnp.sum(f(q, k, v).astype(jnp.float32))
+    g1 = jax.grad(loss)
+
+    def chain(x):
+        for _ in range(6):
+            x = g1(x).astype(jnp.bfloat16) * 1e-3 + q
+        return x
+    try:
+        jfn = jax.jit(chain)
+
+        def run(reps):
+            y = q
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = jfn(y)
+            fetch(y)
+            return time.perf_counter() - t0
+        dt = net_time(run, 4)
+        print(f"{name:36s} {dt*1e3/6:6.2f} ms/layer -> "
+              f"{dt*1e3*2:6.1f} ms/step(12)", flush=True)
+    except Exception as e:
+        print(f"{name:36s} FAIL {type(e).__name__} {str(e)[:90]}",
+              flush=True)
+
+
+bench("splash default blocks", make(None))
+bench("splash 512x1024 fused-bwd", make((512, 1024)))
+bench("splash 256x512 fused-bwd", make((256, 512)))

@@ -1,0 +1,49 @@
+"""Full train step with the new kernels, batch sweep."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import training
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.mesh import make_mesh
+
+
+def fetch(x):
+    float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32).ravel()[:1]))
+
+
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+for batch in (24, 32, 40, 48):
+    mesh = make_mesh(dp=1, devices=jax.devices())
+    fns = training.build_gpt_train(cfg, mesh)
+    try:
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch,
+                                        1024, cfg.vocab_size)
+        for _ in range(2):
+            state, m = fns["step_fn"](state, bd)
+            fetch(m["loss"])
+
+        def run(reps):
+            global state
+            t0 = time.perf_counter()
+            m = None
+            for _ in range(reps):
+                state, m = fns["step_fn"](state, bd)
+            fetch(m["loss"])
+            return time.perf_counter() - t0
+
+        run(2)
+        t1 = run(8)
+        t3 = run(24)
+        dt = (t3 - t1) / 16
+        tok = batch * 1024 / dt
+        print(f"batch={batch}: {dt*1e3:6.1f} ms/step  {tok:,.0f} tok/s "
+              f"(vs_baseline {tok/255000:.3f}, mfu "
+              f"{tok*6*123.6e6/1e12/197:.3f})", flush=True)
+    except Exception as e:
+        print(f"batch={batch}: FAIL {type(e).__name__} {str(e)[:100]}",
+              flush=True)
+    del state
