@@ -88,6 +88,10 @@ _d("object_spill_dir", "",
    "Directory for spilling evicted primary objects. '' = <session>/spill.")
 _d("object_store_mmap_threshold_bytes", 1024 * 1024,
    "Reads at or above this size return zero-copy views into shm.")
+_d("object_samehost_fastpath", 1,
+   "Same-host node-to-node transfers copy the sealed shm file "
+   "kernel-side instead of pulling RPC chunks (0 disables, e.g. to "
+   "exercise the broadcast chain in tests).")
 _d("object_transfer_chunk_bytes", 5 * 1024 * 1024,
    "Chunk size for node-to-node object pulls (reference: 5MiB chunks, "
    "common/ray_config_def.h object_manager_default_chunk_size).")
@@ -98,6 +102,10 @@ _d("object_gc_period_s", 1.0, "Control-plane GC sweep period.")
 
 # --- scheduler -------------------------------------------------------------
 _d("worker_pool_min_workers", 0, "Prestarted workers per node.")
+_d("worker_max_concurrent_starts", 16,
+   "Worker processes allowed to be starting (forked, not yet "
+   "registered) at once.  Startup cost is the child's imports, which "
+   "run in parallel across processes; this bounds the fork burst.")
 
 # --- memory monitor (reference: common/memory_monitor.h,
 # raylet/worker_killing_policy.cc) --------------------------------------

@@ -126,14 +126,11 @@ class _Waiters:
 
 
 def _free_trace(kind, oids, cp=None):
-    if os.environ.get("RAY_TPU_DEBUG_FREE") != "1":
+    from ray_tpu._private.debug_trace import enabled, trace
+    if not enabled("RAY_TPU_DEBUG_FREE"):
         return
-    import time as _t
-    import traceback as _tb
-    with open("/tmp/free_trace.log", "a") as f:
-        f.write(f"--- {_t.monotonic():.3f} {os.getpid()} cp={id(cp)} "
-                f"{kind} {[o.hex() for o in oids]}\n")
-        f.write("".join(_tb.format_stack(limit=6)) + "\n")
+    trace(f"free cp={id(cp)}", kind, [o.hex() for o in oids],
+          var="RAY_TPU_DEBUG_FREE", stack=6)
 
 
 class ControlPlane:
@@ -152,6 +149,11 @@ class ControlPlane:
         self._objects: Dict[bytes, Dict[str, Any]] = {}
         self._inline_data: Dict[bytes, bytes] = {}
         self._object_waiters = _Waiters()
+        # pending resolver kicks that arrived with no wait registered
+        # (consumed by the next wait_any(kick=key); see kick_waiters)
+        self._sticky_kicks: set = set()
+        # broadcast chains: object -> ordered list of puller nodes
+        self._bcast_chains: Dict[bytes, List[bytes]] = {}
         # actors
         self._actors: Dict[bytes, Dict[str, Any]] = {}
         self._named_actors: Dict[Tuple[str, str], bytes] = {}
@@ -351,15 +353,30 @@ class ControlPlane:
         """Block until the object is committed; returns its location."""
         out = self._object_waiters.wait_for(
             lambda: self.get_location(object_id), timeout, [object_id])
-        if out is None and os.environ.get("RAY_TPU_DEBUG_FREE") == "1":
-            with self._lock:
-                present = object_id in self._objects
-                n = len(self._objects)
-            with open("/tmp/waitdbg.log", "a") as f:
-                f.write(f"wait_object TIMEOUT oid={object_id.hex()} "
-                        f"present={present} cp_id={id(self)} "
-                        f"n_objects={n} type={type(object_id)}\n")
+        if out is None:
+            from ray_tpu._private.debug_trace import enabled, trace
+            if enabled("RAY_TPU_DEBUG_FREE"):
+                with self._lock:
+                    present = object_id in self._objects
+                    n = len(self._objects)
+                trace("wait_object TIMEOUT", f"oid={object_id.hex()}",
+                      f"present={present} cp_id={id(self)} n={n}",
+                      var="RAY_TPU_DEBUG_FREE")
         return out
+
+    def wait_fetch(self, object_id: bytes, timeout: Optional[float]
+                   ) -> Optional[Dict[str, Any]]:
+        """wait_object + inline payload in ONE round trip — the
+        small-object get() hot path (task/actor-call results) pays a
+        single RPC instead of wait + location + fetch."""
+        loc = self.wait_object(object_id, timeout)
+        if loc is None:
+            return None
+        data = None
+        if loc.get("where") == "inline":
+            with self._lock:
+                data = self._inline_data.get(bytes(object_id))
+        return {"loc": loc, "data": data}
 
     def get_locations(self, object_ids: List[bytes]
                       ) -> Dict[bytes, Optional[Dict[str, Any]]]:
@@ -374,12 +391,57 @@ class ControlPlane:
         with self._lock:
             return {bytes(o): loc(bytes(o)) for o in object_ids}
 
+    # ---------------------------------------------------- broadcast -----
+    def join_broadcast(self, object_id: bytes,
+                       node_id: bytes) -> Optional[Dict[str, Any]]:
+        """Register ``node_id`` as a puller of ``object_id`` and return
+        the node it should chain from (None = pull from the primary).
+
+        Chain-push broadcast (reference: ``object_manager/
+        push_manager.cc`` / the 1-GiB-to-many envelope): instead of N
+        pullers hammering the one source, each puller chains off the
+        previous one, re-serving chunks as they land — aggregate
+        bandwidth scales with the number of links, and the source
+        serves exactly one stream."""
+        object_id, node_id = bytes(object_id), bytes(node_id)
+        with self._lock:
+            chain = self._bcast_chains.setdefault(object_id, [])
+            parent = None
+            for n in reversed(chain):
+                if n == node_id:
+                    continue
+                info = self._nodes.get(n)
+                if info is not None and info.get("state") == "ALIVE":
+                    parent = {"node_id": n,
+                              "sock_path": info["sock_path"]}
+                    break
+            if node_id not in chain:
+                chain.append(node_id)
+            return parent
+
+    def leave_broadcast(self, object_id: bytes, node_id: bytes) -> None:
+        """Drop a failed puller so later joiners don't chain off it."""
+        with self._lock:
+            chain = self._bcast_chains.get(bytes(object_id))
+            if chain is not None:
+                try:
+                    chain.remove(bytes(node_id))
+                except ValueError:
+                    pass
+
     def kick_waiters(self, key: bytes) -> None:
         """Wake a ``wait_any(..., kick=key)`` blocked on stale ids.
 
         Node managers use this to interrupt their dependency-resolver's
-        standing wait when newly submitted tasks add ids to the set."""
-        self._object_waiters.notify([("__kick__", bytes(key))])
+        standing wait when newly submitted tasks add ids to the set.
+        The kick is *sticky*: if no wait is registered when it lands, the
+        next ``wait_any(kick=key)`` consumes it on entry and returns
+        immediately, so a kick can never be lost to the race between the
+        caller's RPC and the resolver's waiter registration."""
+        key = bytes(key)
+        with self._lock:
+            self._sticky_kicks.add(key)
+        self._object_waiters.notify([("__kick__", key)])
 
     def wait_any(self, object_ids: List[bytes], num_returns: int,
                  timeout: Optional[float],
@@ -399,14 +461,18 @@ class ControlPlane:
                     else time.monotonic() + timeout)
         w = self._object_waiters.register(keys)
         try:
+            kicked = False
             with self._lock:
                 # tombstoned (owner-died, already freed) ids count as
                 # ready: the subsequent get() raises OwnerDiedError
                 # instead of the wait hanging forever
                 done = [o for o in ids if o in self._objects
                         or o in self._owner_died_tombstones]
+                if kick is not None and bytes(kick) in self._sticky_kicks:
+                    self._sticky_kicks.discard(bytes(kick))
+                    kicked = True
             remaining = set(ids) - set(done)
-            while len(done) < num_returns and remaining:
+            while not kicked and len(done) < num_returns and remaining:
                 wait_t = 1.0
                 if deadline is not None:
                     wait_t = deadline - time.monotonic()
@@ -427,6 +493,8 @@ class ControlPlane:
                     done.extend(newly)
                     remaining.difference_update(newly)
                 if kick_key is not None and kick_key in fired:
+                    with self._lock:
+                        self._sticky_kicks.discard(bytes(kick))
                     break
             return done
         finally:
@@ -440,6 +508,7 @@ class ControlPlane:
                 if o in self._objects:
                     self._objects.pop(o, None)
                     self._inline_data.pop(o, None)
+                    self._bcast_chains.pop(o, None)
                     freed += 1
             if freed:
                 self._j("free_objects", [bytes(o) for o in object_ids])

@@ -1,0 +1,58 @@
+"""Env-gated debug tracing into the session log directory.
+
+Replaces the ad-hoc fixed-path ``/tmp/*.log`` scaffolding: predictable
+/tmp filenames are a symlink hazard on shared hosts, and traces belong
+with the session's other logs.  Enable with ``RAY_TPU_DEBUG_TRACE=1``
+(or the legacy per-subsystem vars); lines land in
+``<session_dir>/logs/debug_trace_<pid>.log`` via the logging module, or
+a secure tempfile when no session dir is known.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+_logger: Optional[logging.Logger] = None
+
+
+def enabled(var: str = "RAY_TPU_DEBUG_TRACE") -> bool:
+    return (os.environ.get(var) == "1"
+            or os.environ.get("RAY_TPU_DEBUG_TRACE") == "1")
+
+
+def _get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        logger = logging.getLogger("ray_tpu.debug_trace")
+        logger.propagate = False
+        logger.setLevel(logging.DEBUG)
+        session = os.environ.get("RAY_TPU_SESSION_DIR")
+        if session:
+            log_dir = os.path.join(session, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(log_dir,
+                                f"debug_trace_{os.getpid()}.log")
+        else:
+            import tempfile
+            fd, path = tempfile.mkstemp(prefix="ray_tpu_trace_",
+                                        suffix=".log")
+            os.close(fd)
+        logger.addHandler(logging.FileHandler(path))
+        _logger = logger
+    return _logger
+
+
+def trace(tag: str, *parts, var: str = "RAY_TPU_DEBUG_TRACE",
+          stack: int = 0) -> None:
+    """One trace line (and optionally a short stack) if enabled."""
+    if not enabled(var):
+        return
+    msg = (f"{time.monotonic():.3f} {os.getpid()} {tag} "
+           + " ".join(str(p) for p in parts))
+    if stack:
+        import traceback
+        msg += "\n" + "".join(traceback.format_stack(limit=stack))
+    _get_logger().debug(msg)

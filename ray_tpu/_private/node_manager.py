@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -73,6 +74,60 @@ class _ResilientCP:
 
         call.__name__ = name
         return call
+
+
+class _ForkedProc:
+    """Popen-shaped handle for a worker forked by the forkserver.
+
+    The child is the *template's* child, not ours (and the template
+    auto-reaps), so liveness can't use waitpid — and a bare pid check
+    is unsafe once the kernel recycles the pid.  Identity is the
+    (pid, /proc start_time) pair recorded at fork: poll() reports dead
+    and kill()/terminate() become no-ops the moment the pid belongs to
+    a different process."""
+
+    def __init__(self, pid: int, start_time: Optional[int] = None):
+        self.pid = pid
+        self._start_time = start_time
+
+    def _alive(self) -> bool:
+        from ray_tpu._private.worker_forkserver import proc_start_time
+        if self._start_time is None:
+            # the fork reply carried no start_time: the child died and
+            # was reaped before it could be stat'ed.  Treat as dead —
+            # a bare pid match here could be a recycled pid, and
+            # signalling it would hit an unrelated process.
+            return False
+        now = proc_start_time(self.pid)
+        return now is not None and now == self._start_time
+
+    def poll(self) -> Optional[int]:
+        return None if self._alive() else 0
+
+    def terminate(self) -> None:
+        if not self._alive():
+            return
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        if not self._alive():
+            return
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired(f"pid:{self.pid}",
+                                                timeout or 0)
+            time.sleep(0.01)
+        return 0
 
 
 class _Worker:
@@ -205,6 +260,10 @@ class NodeManager:
         self.cp = control_plane  # ControlPlane, or _ResilientCP(RpcClient)
         self.cp_sock_path = cp_sock_path
         self.store = shm_store
+        if getattr(shm_store, "on_evict", None) is None:
+            # dropped secondary copies must leave the broadcast chain,
+            # or later joiners chain off a node that has nothing
+            shm_store.on_evict = self._on_store_evict
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
         self.node_ip = node_ip
@@ -222,6 +281,11 @@ class NodeManager:
 
         self._workers: Dict[bytes, _Worker] = {}
         self._idle: deque = deque()
+        # pre-warmed worker forkserver (lazy; CPU workers only)
+        self._forksrv_proc: Optional[subprocess.Popen] = None
+        self._forksrv_sock: Optional[socket.socket] = None
+        self._forksrv_failed = False
+        self._forksrv_lock = threading.RLock()
         self._starting = 0
         self._actors: Dict[bytes, _ActorState] = {}
         self._pending = _PendingQueues()         # ready-to-schedule specs
@@ -257,9 +321,14 @@ class NodeManager:
             defaultdict(lambda: defaultdict(int)))
         self._owner_totals: Dict[bytes, int] = {}
         self._owner_zero_since: Dict[bytes, float] = {}
-        # holder -> node hosting it: a whole-node death purges every
-        # holder that died with it (its own NM can't send the purge)
-        self._owner_holder_node: Dict[bytes, bytes] = {}
+        # holder -> {node -> {oid: count}}: per-NODE contributions, so a
+        # whole-node death subtracts exactly what that node's processes
+        # flushed (its own NM can't send the purge) without touching the
+        # same holder's pins from surviving nodes — e.g. the caller-side
+        # pre-pin and the hosting NM's pin share the task:<id> holder
+        # but live on different nodes.
+        self._owner_holder_contrib: Dict[
+            bytes, Dict[bytes, Dict[bytes, int]]] = {}
         self._owner_peers: Dict[str, protocol.RpcClient] = {}
         self._last_owner_sweep = time.time()
 
@@ -339,10 +408,17 @@ class NodeManager:
         now = time.time()
         with self._owner_lock:
             if holder_node:
-                self._owner_holder_node[holder_id] = holder_node
+                contrib = self._owner_holder_contrib.setdefault(
+                    holder_id, {}).setdefault(holder_node, {})
             held = self._owner_by_holder[holder_id]
             for oid, d in deltas.items():
                 oid = bytes(oid)
+                if holder_node:
+                    c = contrib.get(oid, 0) + d
+                    if c:
+                        contrib[oid] = c
+                    else:
+                        contrib.pop(oid, None)
                 held[oid] += d
                 if held[oid] == 0:
                     held.pop(oid)
@@ -364,7 +440,7 @@ class NodeManager:
         contributed to objects owned here."""
         with self._owner_lock:
             held = self._owner_by_holder.pop(holder_id, None)
-            self._owner_holder_node.pop(holder_id, None)
+            self._owner_holder_contrib.pop(holder_id, None)
         if held:
             self.update_owned_refs(b"_purge",
                                    {o: -d for o, d in held.items()})
@@ -372,14 +448,31 @@ class NodeManager:
                 self._owner_by_holder.pop(b"_purge", None)
 
     def purge_owned_node_holders(self, node_id: bytes) -> None:
-        """A whole node died: drop every contribution flushed here by
-        holders that lived on it (their NM died with them; the head
-        broadcasts this from its node-death handler)."""
+        """A whole node died: subtract exactly the contributions flushed
+        here by processes on that node (their NM died with them; the
+        head broadcasts this from its node-death handler).  Holders with
+        pins from surviving nodes keep those pins."""
         with self._owner_lock:
-            victims = [h for h, n in self._owner_holder_node.items()
-                       if n == node_id]
-        for h in victims:
-            self.purge_owned_holder(h)
+            victims = []
+            for h, nodes in list(self._owner_holder_contrib.items()):
+                contrib = nodes.pop(node_id, None)
+                if contrib:
+                    # clamp to what the holder still actually holds: a
+                    # stale/negative contribution must not resurrect an
+                    # emptied holder (the defaultdict would recreate it
+                    # with residual counts nothing will ever purge)
+                    held = (self._owner_by_holder.get(h) or {})
+                    deltas = {}
+                    for oid, d in contrib.items():
+                        take = min(d, held.get(oid, 0))
+                        if take > 0:
+                            deltas[oid] = -take
+                    if deltas:
+                        victims.append((h, deltas))
+                if not nodes:
+                    self._owner_holder_contrib.pop(h, None)
+        for h, deltas in victims:
+            self.update_owned_refs(h, deltas)
 
     def debug_state(self) -> Dict[str, Any]:
         """Introspection snapshot for ``ray-tpu stack``-style debugging:
@@ -488,11 +581,8 @@ class NodeManager:
         self._wake.set()
 
     def _satrace(self, *parts) -> None:
-        if os.environ.get("RAY_TPU_DEBUG_FREE") != "1":
-            return
-        with open("/tmp/sat_trace.log", "a") as f:
-            f.write(f"{time.monotonic():.3f} {os.getpid()} "
-                    + " ".join(str(p) for p in parts) + "\n")
+        from ray_tpu._private.debug_trace import trace
+        trace("submit_actor_task", *parts, var="RAY_TPU_DEBUG_FREE")
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         """Queue a method call on an actor hosted by this node."""
@@ -634,7 +724,13 @@ class NodeManager:
         view = self.store.get_view(object_id)
         if view is None:
             return None
-        return {"size": len(view)}
+        meta = {"size": len(view), "ip": self.node_ip}
+        # same-host fastpath: a co-hosted puller kernel-copies the
+        # sealed file instead of pulling RPC chunks
+        path = self.store.sealed_path(object_id)
+        if path:
+            meta["path"] = path
+        return meta
 
     def push_object_chunk(self, object_id: bytes, total: int,
                           offset: int, data: bytes) -> bool:
@@ -648,13 +744,64 @@ class NodeManager:
                            length: int) -> Optional[bytes]:
         return self.store.read_chunk(object_id, offset, length)
 
+    def fetch_partial_chunk(self, object_id: bytes, offset: int,
+                            length: int):
+        """Broadcast-chain read: serve from a sealed copy OR the prefix
+        an in-progress pull on this node has already written (None =
+        not there yet; the downstream puller polls).  ``{"gone": True}``
+        = no copy and no pull in flight here — the puller should stop
+        polling and re-chain instead of waiting out its stall budget."""
+        data = self.store.read_partial_chunk(object_id, offset, length)
+        if data is None and not self.store.has_any_copy(object_id):
+            return {"gone": True}
+        return data
+
+    # ------------------------------------------------------------------
+    # Log access (``ray logs`` parity + dashboard log pane; reference:
+    # dashboard/modules/log/log_agent.py serves per-node worker logs)
+    # ------------------------------------------------------------------
+    def list_logs(self) -> List[Dict[str, Any]]:
+        log_dir = os.path.join(self.session_dir, "logs")
+        out: List[Dict[str, Any]] = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                path = os.path.join(log_dir, name)
+                if os.path.isfile(path):
+                    out.append({"name": name,
+                                "size": os.path.getsize(path),
+                                "mtime": os.path.getmtime(path)})
+        except OSError:
+            pass
+        return out
+
+    def tail_log(self, name: str, nbytes: int = 65536) -> bytes:
+        if os.sep in name or name.startswith("."):
+            raise ValueError(f"bad log name {name!r}")
+        path = os.path.join(self.session_dir, "logs", name)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - nbytes))
+                return f.read(nbytes)
+        except OSError:
+            return b""
+
     def delete_objects(self, object_ids: List[bytes]) -> int:
         """GC fan-out target: drop local shm copies of freed objects."""
         n = 0
         for oid in object_ids:
             if self.store.delete(oid):
+                self._on_store_evict(oid)
                 n += 1
         return n
+
+    def _on_store_evict(self, object_id: bytes) -> None:
+        """A local copy was dropped: leave the object's broadcast chain
+        so downstream pullers aren't pointed at an empty parent."""
+        try:
+            self.cp.leave_broadcast(object_id, self.node_id)
+        except Exception:  # noqa: BLE001 — bookkeeping best-effort
+            pass
 
     # ------------------------------------------------------------------
     # Worker channel (hijacked connection)
@@ -865,14 +1012,19 @@ class NodeManager:
         analogue: ``raylet/dependency_manager.cc``.
         """
         while not self._stopped.is_set():
+            # snapshot + blocked flag under ONE lock acquisition: a task
+            # registering after the snapshot then sees blocked=True and
+            # sends a kick; the CP keeps kicks sticky so one that lands
+            # before wait_any registers its waiter is consumed on entry
+            # instead of lost (30s stall otherwise).
             with self._lock:
                 deps = list(self._dep_map)
+                if deps:
+                    self._dep_blocked = True
             if not deps:
                 self._dep_kick.wait(timeout=1.0)
                 self._dep_kick.clear()
                 continue
-            with self._lock:
-                self._dep_blocked = True
             try:
                 ready = self.cp.wait_any(deps, 1, 30.0, kick=self.node_id)
             except Exception:  # noqa: BLE001
@@ -1037,7 +1189,12 @@ class NodeManager:
         if worker is None:
             with self._res_lock:
                 release(self.resources_available, spec.resources)
-            self._maybe_spawn_worker(need_tpu)
+            # spawn toward the whole same-shape backlog, not one worker
+            # per dispatch wake (this spec + everything queued behind it)
+            with self._lock:
+                backlog = 1 + len(self._pending._queues.get(
+                    _PendingQueues.shape_key(spec), ()))
+            self._maybe_spawn_worker(need_tpu, count=backlog)
             return False
         try:
             chips = self._assign_chips(spec, worker)
@@ -1090,21 +1247,30 @@ class NodeManager:
                                if w.state == "idle" and w.sock is not None)
             return None
 
-    def _maybe_spawn_worker(self, tpu: bool = False):
-        with self._lock:
-            # Bound concurrent starts: worker startup is expensive (python +
-            # preloaded jax); a tight dispatch loop must not fork-bomb.
-            max_concurrent_starts = max(2, int(os.cpu_count() or 1))
-            if self._starting >= max_concurrent_starts:
-                return
-            max_workers = int(self.resources_total.get("CPU", 1)) + 64
-            if len(self._workers) + self._starting >= max_workers:
-                return
-            self._starting += 1
-        self._spawn_worker(tpu)
+    def _maybe_spawn_worker(self, tpu: bool = False, count: int = 1):
+        """Spawn up to ``count`` workers toward the pending backlog.
 
-    def _spawn_worker(self, tpu: bool = False):
-        worker_id = WorkerID.from_random().binary()
+        Worker startup cost is dominated by the child's imports, which
+        parallelize across processes — so an actor-creation burst (128
+        actors = 128 workers) spawns in batches instead of one per
+        dispatch wake (the round-4 probe measured 2 actors/s precisely
+        because of that serialization).  ``_starting`` still bounds the
+        in-flight forks so a tight dispatch loop cannot fork-bomb.
+        """
+        spawn = 0
+        with self._lock:
+            max_concurrent_starts = GLOBAL_CONFIG.worker_max_concurrent_starts
+            max_workers = int(self.resources_total.get("CPU", 1)) + 64
+            while (spawn < count
+                   and self._starting + spawn < max_concurrent_starts
+                   and (len(self._workers) + self._starting + spawn
+                        < max_workers)):
+                spawn += 1
+            self._starting += spawn
+        for _ in range(spawn):
+            self._spawn_worker(tpu)
+
+    def _worker_env(self, worker_id: bytes, tpu: bool) -> Dict[str, str]:
         env = dict(os.environ)
         if not tpu:
             # CPU workers skip the TPU runtime entirely: drop any site hook
@@ -1131,18 +1297,104 @@ class NodeManager:
             "RAY_TPU_LOG_TO_DRIVER":
                 "1" if GLOBAL_CONFIG.log_to_driver else "0",
         })
+        return env
+
+    def _ensure_forkserver(self) -> Optional[protocol.RpcClient]:
+        """Start (once) and connect to the pre-warmed worker forkserver.
+
+        Returns the connected socket wrapper, or None if the template
+        is unavailable (caller falls back to cold spawn)."""
+        with self._forksrv_lock:
+            if self._forksrv_sock is not None:
+                return self._forksrv_sock
+            if self._forksrv_failed:
+                return None
+            sock_path = os.path.join(
+                self.session_dir, "sockets",
+                f"forksrv_{self.node_id.hex()[:12]}.sock")
+            if self._forksrv_proc is None or \
+                    self._forksrv_proc.poll() is not None:
+                env = self._worker_env(b"\0" * 16, tpu=False)
+                env["RAY_TPU_FORKSRV_SOCK"] = sock_path
+                os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+                log_dir = os.path.join(self.session_dir, "logs")
+                os.makedirs(log_dir, exist_ok=True)
+                out = open(os.path.join(log_dir, "forkserver.log"), "ab")
+                self._forksrv_proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "ray_tpu._private.worker_forkserver"],
+                    env=env, stdout=out, stderr=subprocess.STDOUT)
+                out.close()
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                try:
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(sock_path)
+                    self._forksrv_sock = s
+                    return s
+                except (FileNotFoundError, ConnectionRefusedError, OSError):
+                    if self._forksrv_proc.poll() is not None:
+                        break
+                    time.sleep(0.05)
+            self._forksrv_failed = True
+            return None
+
+    def _fork_worker(self, worker_id: bytes, env: Dict[str, str],
+                     log_path: str) -> "Optional[tuple]":
+        """Ask the forkserver for a worker; returns (pid, start_time)
+        or None (caller falls back to cold spawn)."""
+        from ray_tpu._private import worker_forkserver as fsrv
+        sock = self._ensure_forkserver()
+        if sock is None:
+            return None
+        # only ship the vars the child must override; the template
+        # already inherited the rest of the NM environment
+        child_env = {k: v for k, v in env.items()
+                     if k.startswith("RAY_TPU_") or k == "JAX_PLATFORMS"}
+        with self._forksrv_lock:
+            try:
+                fsrv._send_obj(sock, {"env": child_env,
+                                      "log_path": log_path})
+                reply = fsrv._recv_obj(sock)
+                return reply["pid"], reply.get("start_time")
+            except (EOFError, OSError, ConnectionResetError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._forksrv_sock = None
+                self._forksrv_failed = True
+                return None
+
+    def _spawn_worker(self, tpu: bool = False):
+        worker_id = WorkerID.from_random().binary()
+        env = self._worker_env(worker_id, tpu)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(
-            log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=False)
-        out.close()
+        log_path = os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.log")
+        proc = None
+        if not tpu:
+            forked = self._fork_worker(worker_id, env, log_path)
+            if forked is not None:
+                proc = _ForkedProc(*forked)
+        if proc is None:
+            out = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=False)
+            out.close()
         with self._lock:
-            worker = _Worker(worker_id, proc, tpu=tpu)
-            self._workers[worker_id] = worker
+            # a forked worker can register its stream before we get here;
+            # attach the proc handle to the existing entry in that case
+            worker = self._workers.get(worker_id)
+            if worker is None:
+                worker = _Worker(worker_id, proc, tpu=tpu)
+                self._workers[worker_id] = worker
+            else:
+                worker.proc = proc
+                worker.tpu = tpu
 
     def _assign_chips(self, spec: TaskSpec,
                       worker: _Worker) -> Optional[List[int]]:
@@ -1517,4 +1769,18 @@ class NodeManager:
                         w.proc.wait(timeout=1.0)
                     except subprocess.TimeoutExpired:
                         w.proc.kill()
+        with self._forksrv_lock:
+            if self._forksrv_sock is not None:
+                try:
+                    self._forksrv_sock.close()
+                except OSError:
+                    pass
+                self._forksrv_sock = None
+            if self._forksrv_proc is not None:
+                self._forksrv_proc.terminate()
+                try:
+                    self._forksrv_proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    self._forksrv_proc.kill()
+                self._forksrv_proc = None
         self._server.shutdown()

@@ -71,11 +71,15 @@ class ShmStore:
     PUSH_STALE_S = 300.0
 
     def __init__(self, root: str, capacity: Optional[int] = None,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 on_evict=None):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.capacity = capacity or _default_capacity()
         self.spill_dir = spill_dir
+        # best-effort notification that a *dropped* (not spilled) copy
+        # left this node — broadcast-chain bookkeeping hangs off it
+        self.on_evict = on_evict
         self._lock = threading.Lock()
         # id -> (size, last_access); rebuilt lazily from disk on miss
         self._index: Dict[bytes, Tuple[int, float]] = {}
@@ -215,10 +219,24 @@ class ShmStore:
         # concurrently must not interleave into one file.
         tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
         written = 0
-        with open(tmp, "wb") as f:
-            for chunk in chunks:
-                f.write(chunk)
-                written += len(chunk)
+        try:
+            with open(tmp, "wb") as f:
+                for chunk in chunks:
+                    f.write(chunk)
+                    # visible watermark: the broadcast chain re-serves
+                    # this partial file to downstream pullers as chunks
+                    # land
+                    f.flush()
+                    written += len(chunk)
+        except BaseException:
+            # a failed source mid-stream must not orphan the tmp file:
+            # downstream chain pullers read any .tmp.* as "pull in
+            # progress here" and would poll this node pointlessly
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         if written != size:
             os.unlink(tmp)
             raise IOError(f"object {object_id.hex()}: streamed {written} "
@@ -240,6 +258,98 @@ class ShmStore:
         if view is None:
             return None
         return bytes(view[offset:offset + length])
+
+    def sealed_path(self, object_id: bytes) -> Optional[str]:
+        """Filesystem path of a sealed object (same-host fastpath: a
+        co-hosted node copies the file kernel-side instead of pulling
+        RPC chunks)."""
+        path = self._path(object_id)
+        if os.path.exists(path):
+            return path
+        if self.spill_dir is not None:
+            sp = self._spill_path(object_id)
+            if os.path.exists(sp):
+                return sp
+        return None
+
+    def read_partial_chunk(self, object_id: bytes, offset: int,
+                           length: int) -> Optional[bytes]:
+        """Serve a chunk from an IN-PROGRESS pull of this object.
+
+        Broadcast-chain read side (reference: push_manager.cc re-serves
+        chunks as they arrive): a downstream puller reads the prefix a
+        concurrent upstream pull has already written.  Returns None if
+        no writer has reached offset+length yet (caller polls)."""
+        import glob as _glob
+        sealed = self.read_chunk(object_id, offset, length)
+        if sealed is not None:
+            return sealed
+        best: Optional[str] = None
+        best_size = -1
+        for cand in _glob.glob(self._path(object_id) + ".tmp.*"):
+            try:
+                size = os.path.getsize(cand)
+            except OSError:
+                continue
+            if size > best_size:
+                best, best_size = cand, size
+        if best is None or best_size < offset + length:
+            return None
+        try:
+            with open(best, "rb") as f:
+                f.seek(offset)
+                data = f.read(length)
+            return data if len(data) == length else None
+        except OSError:
+            return None
+
+    def has_any_copy(self, object_id: bytes) -> bool:
+        """Sealed, spilled, or in-progress-pull presence of the object
+        on this node (broadcast-chain "is the parent worth polling")."""
+        import glob as _glob
+        if os.path.exists(self._path(object_id)):
+            return True
+        if self.spill_dir and os.path.exists(self._spill_path(object_id)):
+            return True
+        # an active pull flushes every chunk, so its tmp mtime stays
+        # fresh; a tmp orphaned by a SIGKILLed writer goes stale and
+        # must not read as "in progress" forever
+        now = time.time()
+        for cand in _glob.glob(self._path(object_id) + ".tmp.*"):
+            try:
+                if now - os.path.getmtime(cand) < 60.0:
+                    return True
+            except OSError:
+                continue
+        return False
+
+    def put_file_copy(self, object_id: bytes, src_path: str,
+                      size: int) -> bool:
+        """Seal a local secondary copy from another store's sealed file
+        (same-host transfer: one kernel-side copy, no RPC)."""
+        import shutil
+        self._ensure_capacity(size)
+        path = self._path(object_id)
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            shutil.copyfile(src_path, tmp)
+            if os.path.getsize(tmp) != size:
+                os.unlink(tmp)
+                return False
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            if object_id in self._index:
+                os.unlink(tmp)
+                return True
+            os.rename(tmp, path)
+            self._index[object_id] = (size, time.monotonic())
+            self._used += size
+        return True
 
     # --------------------------------------------------------- read -----
     def contains(self, object_id: bytes) -> bool:
@@ -354,9 +464,14 @@ class ShmStore:
                 return False
         try:
             os.unlink(path)
-            return True
         except FileNotFoundError:
             return False
+        if self.on_evict is not None:
+            try:
+                self.on_evict(object_id)
+            except Exception:  # noqa: BLE001 — notification best-effort
+                pass
+        return True
 
     def _restore_from_spill(self, object_id: bytes) -> bool:
         if not self.spill_dir:

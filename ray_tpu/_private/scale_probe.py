@@ -174,8 +174,8 @@ def main() -> Dict[str, Any]:
     for name, fn in (
         ("wait_10k_refs", probe_wait_many_refs),
         ("broadcast_1gib_8_nodes", probe_broadcast),
-        ("queue_100k_noop_tasks", probe_queue_tasks),
-        ("actors_128", lambda: probe_actors(128)),
+        ("queue_500k_noop_tasks", lambda: probe_queue_tasks(500_000)),
+        ("actors_1024", lambda: probe_actors(1024)),
     ):
         t0 = time.perf_counter()
         try:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextvars
 import hashlib
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -50,12 +51,28 @@ class CoreWorker:
         self.cp = control_plane
         self.nm = node_manager
         self.store = shm_store
+        if shm_store is not None \
+                and getattr(shm_store, "on_evict", None) is None:
+            # a dropped secondary copy must leave the broadcast chain
+            # (same wiring as the NM's store instance; whichever process
+            # evicts tells the CP)
+            def _left(oid, _self=self):
+                try:
+                    _self.cp.leave_broadcast(oid, _self.node_id)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            shm_store.on_evict = _left
         self.session_dir = session_dir
         self.namespace = namespace
         self._nm_notify = nm_notify  # callable(msg) to notify NM blocked state
         self._fn_cache: Dict[bytes, Any] = {}
         self._fn_keys: Dict[int, bytes] = {}  # id(fn) -> registered key
         self._actor_nm_cache: Dict[bytes, Any] = {}
+        self._actor_direct_cache: Dict[bytes, Any] = {}
+        # direct_addr whose dial failed: calls stay on the NM relay
+        # (which preserves per-caller order) until the actor publishes
+        # a *different* addr — mixing paths could reorder calls
+        self._actor_direct_failed: Dict[bytes, str] = {}
         self._seq_lock = threading.Lock()
         self._actor_seq: Dict[bytes, int] = {}
         # Client-side buffering for calls to not-yet-ALIVE actors
@@ -139,9 +156,11 @@ class CoreWorker:
                                is_error=is_error, owner=owner,
                                owner_addr=owner_addr or "")
 
-    def _fetch_committed(self, oid: bytes, loc: Dict[str, Any]) -> Any:
+    def _fetch_committed(self, oid: bytes, loc: Dict[str, Any],
+                         preloaded: Optional[bytes] = None) -> Any:
         if loc["where"] == "inline":
-            data = self.cp.get_inline(oid)
+            data = preloaded if preloaded is not None \
+                else self.cp.get_inline(oid)
             if data is None:
                 raise KeyError(f"inline object {oid.hex()} vanished")
             value = serialization.deserialize_frame(memoryview(data))
@@ -172,25 +191,116 @@ class CoreWorker:
             if meta is None:
                 return False
             size = meta["size"]
-            chunk_bytes = GLOBAL_CONFIG.object_transfer_chunk_bytes
-
-            def chunks():
-                off = 0
-                while off < size:
-                    n = min(chunk_bytes, size - off)
-                    data = peer.call("fetch_object_chunk", oid, off, n)
-                    if data is None or len(data) != n:
-                        raise IOError(
-                            f"short chunk pulling {oid.hex()} "
-                            f"({0 if data is None else len(data)}/{n})")
-                    yield data
-                    off += n
-
-            self.store.put_stream(oid, size, chunks())
+            # Same-host fastpath: co-hosted nodes share tmpfs, so a
+            # sealed source file copies kernel-side — one memcpy, no
+            # RPC chunking (multi-node-per-host deployments; the sim
+            # fixtures are exactly this shape).
+            path = meta.get("path")
+            if path and GLOBAL_CONFIG.object_samehost_fastpath \
+                    and self._same_host(meta.get("ip")) \
+                    and os.path.exists(path) \
+                    and self.store.put_file_copy(oid, path, size):
+                self.num_remote_pulls += 1
+                return True
+            if self._pull_chained(oid, size, peer):
+                self.num_remote_pulls += 1
+                return True
+            return False
         except (OSError, IOError, ConnectionError):
             return False
-        self.num_remote_pulls += 1
-        return True
+
+    def _pull_chained(self, oid: bytes, size: int, primary_peer) -> bool:
+        """Chain-push broadcast pull (reference: push_manager.cc role).
+
+        Join the object's broadcast chain at the CP; pull chunks from
+        the assigned parent — which may still be mid-pull itself, in
+        which case its node re-serves the prefix it already has
+        (``fetch_partial_chunk``) and we poll forward.  On a dead or
+        stalled parent, leave the chain and restart against the
+        primary, so a mid-broadcast node death costs one retry, not the
+        broadcast."""
+        chunk_bytes = GLOBAL_CONFIG.object_transfer_chunk_bytes
+        parent_peer, parent_node = primary_peer, None
+        try:
+            parent = self.cp.join_broadcast(oid, self.node_id)
+            if parent is not None:
+                parent_node = parent["node_id"]
+                parent_peer = self._nm_peer(parent["sock_path"])
+        except Exception:  # noqa: BLE001 — no chain: primary direct
+            pass
+
+        def chunks_from(peer, partial: bool):
+            off = 0
+            stall_deadline = time.monotonic() + 20.0
+            # a parent that reports "gone" has no copy and no pull in
+            # flight — give it a short grace (it may be between its
+            # join and its first written chunk), then re-chain
+            gone_deadline = time.monotonic() + 3.0
+            while off < size:
+                n = min(chunk_bytes, size - off)
+                method = ("fetch_partial_chunk" if partial
+                          else "fetch_object_chunk")
+                data = peer.call(method, oid, off, n)
+                if isinstance(data, dict):         # {"gone": True}
+                    if off > 0 or time.monotonic() > gone_deadline:
+                        raise IOError(
+                            f"parent lost {oid.hex()} at {off}")
+                    time.sleep(0.05)
+                    continue
+                if data is None:
+                    if not partial:
+                        raise IOError(f"object {oid.hex()} gone at src")
+                    if time.monotonic() > stall_deadline:
+                        raise IOError(f"parent stalled at {off}")
+                    time.sleep(0.02)
+                    continue
+                if len(data) != n:
+                    raise IOError(
+                        f"short chunk pulling {oid.hex()} "
+                        f"({len(data)}/{n})")
+                stall_deadline = time.monotonic() + 20.0
+                yield data
+                off += n
+
+        if parent_node is not None:
+            try:
+                self.store.put_stream(
+                    oid, size, chunks_from(parent_peer, partial=True))
+                return True
+            except (OSError, IOError, ConnectionError):
+                # parent died/stalled mid-chain: drop it and fall back
+                try:
+                    self.cp.leave_broadcast(oid, parent_node)
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            self.store.put_stream(
+                oid, size, chunks_from(primary_peer, partial=False))
+            return True
+        except (OSError, IOError, ConnectionError):
+            try:
+                self.cp.leave_broadcast(oid, self.node_id)
+            except Exception:  # noqa: BLE001
+                pass
+            return False
+
+    def _same_host(self, src_ip: Optional[str]) -> bool:
+        """Whether the source node's sealed file is on THIS host's
+        tmpfs.  UDS sessions are single-host by construction; TCP
+        sessions compare the source ip against our own NM's — a path
+        that merely *exists* locally could be a different host's
+        bind-mounted store."""
+        from ray_tpu._private.protocol import is_tcp_address, \
+            parse_tcp_address
+        if not self.nm_addr or not is_tcp_address(self.nm_addr):
+            return True
+        if not src_ip:
+            return False
+        try:
+            local_ip, _ = parse_tcp_address(self.nm_addr)
+        except Exception:  # noqa: BLE001
+            return False
+        return src_ip == local_ip
 
     def _nm_peer(self, sock_path: str):
         from ray_tpu._private.protocol import RpcClient
@@ -272,7 +382,11 @@ class CoreWorker:
                 raise TypeError(
                     f"get() expects ObjectRef(s), got {type(r).__name__}")
         ids = [r.binary() for r in ref_list]
-        unready = [o for o in ids if self.cp.get_location(o) is None]
+        # one bulk location RPC; blocked waits use the combined
+        # wait+fetch so a small result costs one round trip total
+        locs = self.cp.get_locations(ids)
+        preloaded: Dict[bytes, bytes] = {}
+        unready = [o for o in ids if locs.get(o) is None]
         if unready:
             self._notify_blocked(True)
             try:
@@ -281,21 +395,25 @@ class CoreWorker:
                 for o in unready:
                     t = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
-                    loc = self.cp.wait_object(o, t)
-                    if loc is None:
+                    out = self.cp.wait_fetch(o, t)
+                    if out is None:
                         raise GetTimeoutError(
                             f"get() timed out waiting for {o.hex()}")
+                    locs[o] = out["loc"]
+                    if out.get("data") is not None:
+                        preloaded[o] = out["data"]
             finally:
                 self._notify_blocked(False)
         values = []
         for o in ids:
-            loc = self.cp.get_location(o)
+            loc = locs.get(o)
             if loc is None:
                 raise GetTimeoutError(f"object {o.hex()} not available")
             if loc.get("owner_died"):
                 loc = self._handle_owner_died(o)
             try:
-                value = self._fetch_committed(o, loc)
+                value = self._fetch_committed(o, loc,
+                                              preloaded=preloaded.get(o))
             except KeyError:
                 loc = self._recover_object(o)
                 value = self._fetch_committed(o, loc)
@@ -433,7 +551,7 @@ class CoreWorker:
             name=opts.get("name") or getattr(fn, "__qualname__", "task"),
             function_key=fn_key, args=ser_args, kwargs=ser_kwargs,
             num_returns=1 if streaming else num_returns,
-            resources=opts["resources"],
+            resources=dict(opts["resources"]),
             max_retries=opts.get(
                 "max_retries", GLOBAL_CONFIG.task_default_max_retries),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
@@ -582,6 +700,24 @@ class CoreWorker:
 
     def _route_now(self, spec: TaskSpec, streaming: bool = False,
                    restarts_seen: Optional[int] = None) -> None:
+        # Direct caller->callee transport (reference:
+        # transport/direct_actor_task_submitter.cc): dial the actor
+        # worker's own socket, skipping the hosting NM's relay +
+        # queue + task-event machinery on the per-call hot path.
+        # Streaming calls and misses fall back to the NM relay (which
+        # also owns restart-time requeueing).
+        if not streaming:
+            direct = self._actor_direct(spec.actor_id)
+            if direct is not None:
+                try:
+                    direct.call("call_actor", spec)
+                    self._record_inflight(spec, streaming,
+                                          restarts_seen)
+                    return
+                except Exception:  # noqa: BLE001 — stale addr: relay
+                    self._actor_direct_cache.pop(spec.actor_id, None)
+                    self._actor_direct_failed[spec.actor_id] = (
+                        direct.sock_path)
         nm = self._actor_nm(spec.actor_id, wait=False)
         if nm is self.nm and self.mode == "driver":
             nm.submit_actor_task(spec)
@@ -590,6 +726,34 @@ class CoreWorker:
         else:
             nm.submit_actor_task(spec)
         self._record_inflight(spec, streaming, restarts_seen)
+
+    def _actor_direct(self, actor_id: bytes):
+        """Cached client to the actor's direct-call socket (None when
+        the actor hasn't published one / is mid-restart).  "No direct
+        addr" is cached with a TTL: without it every call to such an
+        actor pays a control-plane round trip on the hot path."""
+        client = self._actor_direct_cache.get(actor_id)
+        if client is not None:
+            if isinstance(client, float):       # negative entry
+                if time.monotonic() < client:
+                    return None
+                self._actor_direct_cache.pop(actor_id, None)
+            else:
+                return client
+        info = self.cp.get_actor_info(actor_id)
+        if not info or info.get("state") != "ALIVE":
+            return None
+        addr = info.get("direct_addr")
+        if not addr:
+            self._actor_direct_cache[actor_id] = time.monotonic() + 10.0
+            return None
+        if self._actor_direct_failed.get(actor_id) == addr:
+            return None  # relay-pinned until the actor re-publishes
+        self._actor_direct_failed.pop(actor_id, None)
+        from ray_tpu._private.protocol import RpcClient
+        client = RpcClient(addr, connect_timeout=2.0)
+        self._actor_direct_cache[actor_id] = client
+        return client
 
     # ------------------------------------------------------------------
     # In-flight actor call tracking.  If the hosting node dies, the node
@@ -709,13 +873,8 @@ class CoreWorker:
                 {spec.ref_owners.get(d) for d in deps})
 
     def _abtrace(self, *parts) -> None:
-        import os
-        if os.environ.get("RAY_TPU_DEBUG_ACTOR_BUFFER") != "1":
-            return
-        import time as _t
-        with open("/tmp/ab_trace.log", "a") as f:
-            f.write(f"{_t.monotonic():.3f} {os.getpid()} "
-                    + " ".join(str(p) for p in parts) + "\n")
+        from ray_tpu._private.debug_trace import trace
+        trace("actor_buffer", *parts, var="RAY_TPU_DEBUG_ACTOR_BUFFER")
 
     def _route_or_buffer(self, spec: TaskSpec, streaming: bool) -> None:
         """Route to the actor's node manager, or buffer until it's ALIVE.

@@ -59,6 +59,17 @@ class WorkerProcess:
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
         self.is_async_actor = False
+        # direct caller->callee channel (populated on actor creation)
+        self._direct_server = None
+        # task_id -> "running" | ("done", error): duplicate deliveries
+        # across the direct and relay channels are suppressed, but the
+        # NM-notification obligation of a relayed dup is preserved
+        import collections
+        self._seen_tasks: "dict[bytes, object]" = {}
+        self._seen_order: "collections.deque[bytes]" = \
+            collections.deque()
+        self._late_notify: "set[bytes]" = set()
+        self._seen_lock = threading.Lock()
 
     def _send(self, msg: Dict[str, Any]):
         with self._send_lock:
@@ -216,11 +227,19 @@ class WorkerProcess:
                 asyncio.run_coroutine_threadsafe(
                     self._event_loop_lag_monitor(spec.actor_id),
                     self.actor_loop)
-            elif spec.max_concurrency > 1:
+            else:
+                # always a pool (size 1 = strict serialization): direct
+                # caller connections submit from their own threads, so
+                # execution must funnel through one ordered executor
                 self.actor_pool = ThreadPoolExecutor(
-                    max_workers=spec.max_concurrency,
+                    max_workers=max(1, spec.max_concurrency),
                     thread_name_prefix="actor")
             self.core.put_object(spec.return_object_ids()[0], None)
+            # publish the direct-call address BEFORE flipping ALIVE:
+            # every caller that observes the actor as ALIVE then uses
+            # ONE channel from its first call — no relay/direct
+            # interleaving window to break per-caller ordering
+            self._start_direct_server(spec.actor_id)
             self._send({"type": "actor_ready", "actor_id": spec.actor_id,
                         "pid": os.getpid()})
         except BaseException as e:  # noqa: BLE001
@@ -277,16 +296,119 @@ class WorkerProcess:
                       "is blocking the loop (use asyncio.to_thread for "
                       "CPU/blocking work)", flush=True)
 
-    def _dispatch_actor_task(self, spec: TaskSpec):
+    def _dedup(self, spec: TaskSpec, notify_nm: bool = True) -> bool:
+        """True if this task was already seen (at-least-once resend
+        across the direct and relay channels).
+
+        A relayed duplicate of a task first delivered on the direct
+        channel carries an obligation the direct run didn't have: the
+        NM that relayed it now tracks the task inflight and holds its
+        dependency pins until a 'done' arrives.  Swallowing the dup
+        silently would leak both — so a dup with ``notify_nm`` either
+        emits 'done' now (run already finished) or flags the running
+        task to notify at completion."""
+        with self._seen_lock:
+            state = self._seen_tasks.get(spec.task_id)
+            if state is not None:
+                if notify_nm:
+                    if state == "running":
+                        self._late_notify.add(spec.task_id)
+                        return True
+                    done, error = state
+                else:
+                    return True
+                # fall through to send outside the lock
+            else:
+                self._seen_tasks[spec.task_id] = "running"
+                self._seen_order.append(spec.task_id)
+                if len(self._seen_order) > 4096:
+                    # evict the oldest COMPLETED entry — a still-running
+                    # task must keep its dedup record or a cross-channel
+                    # duplicate would re-execute it.  Bounded rotation:
+                    # if everything is running (pathological), grow.
+                    for _ in range(len(self._seen_order)):
+                        old = self._seen_order.popleft()
+                        if self._seen_tasks.get(old) == "running":
+                            self._seen_order.append(old)
+                            continue
+                        self._seen_tasks.pop(old, None)
+                        self._late_notify.discard(old)
+                        break
+                return False
+        self._send({"type": "done", "task_id": spec.task_id,
+                    "error": error})
+        return True
+
+    def _finish_actor_task(self, spec: TaskSpec, notify_nm: bool,
+                           error: bool) -> None:
+        """Completion bookkeeping shared by the sync and async runners:
+        record the outcome for duplicate deliveries, notify the NM when
+        either the original delivery or a relayed duplicate needs it."""
+        with self._seen_lock:
+            if spec.task_id in self._seen_tasks:
+                self._seen_tasks[spec.task_id] = ("done", error)
+            late = spec.task_id in self._late_notify
+            self._late_notify.discard(spec.task_id)
+        if notify_nm or late:
+            self._send({"type": "done", "task_id": spec.task_id,
+                        "error": error})
+        if not notify_nm:
+            self._purge_direct_pins(spec)
+
+    def _dispatch_actor_task(self, spec: TaskSpec,
+                             notify_nm: bool = True):
+        if self._dedup(spec, notify_nm):
+            return
         if self.is_async_actor and self.actor_loop is not None:
             asyncio.run_coroutine_threadsafe(
-                self._run_actor_task_async(spec), self.actor_loop)
+                self._run_actor_task_async(spec, notify_nm),
+                self.actor_loop)
         elif self.actor_pool is not None:
-            self.actor_pool.submit(self._run_actor_task, spec)
+            self.actor_pool.submit(self._run_actor_task, spec, notify_nm)
         else:
-            self._run_actor_task(spec)
+            self._run_actor_task(spec, notify_nm)
 
-    def _run_actor_task(self, spec: TaskSpec):
+    # ------------------------------------------------------------------
+    # Direct caller->callee channel.  Reference:
+    # core_worker/transport/direct_actor_task_submitter.cc — callers
+    # dial the actor process's own socket; the hosting node manager
+    # stays out of the per-call hot path (placement/restart only).
+    # ------------------------------------------------------------------
+    class _DirectHandler:
+        def __init__(self, proc: "WorkerProcess"):
+            self._proc = proc
+
+        def call_actor(self, spec: TaskSpec) -> bool:
+            """Enqueue one actor call; returns once queued (results
+            travel through the object store as usual).  Per-caller
+            ordering: RpcClient conns are FIFO and the actor executor
+            drains submissions in order."""
+            self._proc._dispatch_actor_task(spec, notify_nm=False)
+            return True
+
+    def _start_direct_server(self, actor_id: bytes) -> None:
+        from ray_tpu._private.protocol import is_tcp_address, \
+            parse_tcp_address
+        if is_tcp_address(self.nm_sock):
+            # TCP session: a UDS path would be unreachable from other
+            # hosts — bind an ephemeral TCP port on the NM's interface
+            host, _ = parse_tcp_address(self.nm_sock)
+            path = f"tcp://{host}:0"
+        else:
+            path = os.path.join(self.session_dir, "sockets",
+                                f"actor_{actor_id.hex()[:12]}_"
+                                f"{os.getpid()}.sock")
+        try:
+            self._direct_server = protocol.RpcServer(
+                path, self._DirectHandler(self),
+                name=f"actor-{actor_id.hex()[:6]}")
+            self.cp.call("update_actor", actor_id,
+                         direct_addr=self._direct_server.address)
+        except Exception:  # noqa: BLE001 — relay path still works
+            traceback.print_exc()
+            self._direct_server = None
+
+    def _run_actor_task(self, spec: TaskSpec, notify_nm: bool = True):
         self.core.current_task_id = spec.task_id
         try:
             method = self._lookup_method(spec)
@@ -301,11 +423,24 @@ class WorkerProcess:
             error = True
         finally:
             self.core.current_task_id = None
-        self._send({"type": "done", "task_id": spec.task_id, "error": error})
+        self._finish_actor_task(spec, notify_nm, error)
         if spec.actor_method == "__ray_terminate__":
             os._exit(0)
 
-    async def _run_actor_task_async(self, spec: TaskSpec):
+    def _purge_direct_pins(self, spec: TaskSpec) -> None:
+        """Direct calls bypass the hosting NM, so the callee releases
+        the caller's dependency pre-pins at completion (the relay path
+        does this in the NM's _unpin_dependencies)."""
+        deps = spec.dependencies()
+        if not deps:
+            return
+        from ray_tpu._private import owner_routing
+        owner_routing.route_purge(
+            self.cp, self.core._nm_peer, b"task:" + spec.task_id,
+            {spec.ref_owners.get(d) for d in deps})
+
+    async def _run_actor_task_async(self, spec: TaskSpec,
+                                    notify_nm: bool = True):
         self.core.current_task_id = spec.task_id
         try:
             method = self._lookup_method(spec)
@@ -321,7 +456,7 @@ class WorkerProcess:
         except BaseException as e:  # noqa: BLE001
             self._commit_error(spec, e)
             error = True
-        self._send({"type": "done", "task_id": spec.task_id, "error": error})
+        self._finish_actor_task(spec, notify_nm, error)
         if spec.actor_method == "__ray_terminate__":
             os._exit(0)
 

@@ -69,6 +69,8 @@ class RemoteFunction:
     def __init__(self, fn, **default_opts):
         self._function = fn
         self._default_opts = default_opts
+        self._prepared = None   # submit_opts template (built once:
+        #                         options are per-RemoteFunction static)
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -86,23 +88,33 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs, opts: Dict[str, Any]):
         worker = global_worker()
-        resources = normalize_resources(
-            opts.get("num_cpus"), opts.get("num_gpus"), opts.get("num_tpus"),
-            opts.get("resources"), opts.get("memory"), default_cpus=1.0)
-        strategy = normalize_strategy(opts.get("scheduling_strategy"))
-        resources = _apply_pg_resources(resources, strategy)
-        submit_opts = {
-            "num_returns": opts.get("num_returns", 1),
-            "resources": resources,
-            "scheduling_strategy": strategy,
-            "name": opts.get("name"),
-            "max_retries": opts.get("max_retries"),
-            "retry_exceptions": opts.get("retry_exceptions", False),
-            "runtime_env": opts.get("runtime_env"),
-        }
-        if submit_opts["max_retries"] is None:
-            from ray_tpu._private.config import GLOBAL_CONFIG
-            submit_opts["max_retries"] = GLOBAL_CONFIG.task_default_max_retries
+        # opts are fixed per RemoteFunction (options() returns a new
+        # one), so the normalized submit template is built exactly once
+        # — .remote() in a tight submission loop skips the dict churn
+        submit_opts = self._prepared if opts is self._default_opts \
+            else None
+        if submit_opts is None:
+            resources = normalize_resources(
+                opts.get("num_cpus"), opts.get("num_gpus"),
+                opts.get("num_tpus"), opts.get("resources"),
+                opts.get("memory"), default_cpus=1.0)
+            strategy = normalize_strategy(opts.get("scheduling_strategy"))
+            resources = _apply_pg_resources(resources, strategy)
+            submit_opts = {
+                "num_returns": opts.get("num_returns", 1),
+                "resources": resources,
+                "scheduling_strategy": strategy,
+                "name": opts.get("name"),
+                "max_retries": opts.get("max_retries"),
+                "retry_exceptions": opts.get("retry_exceptions", False),
+                "runtime_env": opts.get("runtime_env"),
+            }
+            if submit_opts["max_retries"] is None:
+                from ray_tpu._private.config import GLOBAL_CONFIG
+                submit_opts["max_retries"] = \
+                    GLOBAL_CONFIG.task_default_max_retries
+            if opts is self._default_opts:
+                self._prepared = submit_opts
         return worker.submit_task(self._function, args, kwargs, submit_opts)
 
     @property

@@ -1,0 +1,37 @@
+"""Profile the task-submit hot path (driver in-process)."""
+import cProfile
+import os
+import pstats
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu  # noqa: E402
+
+ray_tpu.init(num_cpus=16)
+
+
+@ray_tpu.remote(num_cpus=1)
+def noop():
+    return None
+
+
+# warm
+ray_tpu.get([noop.remote() for _ in range(100)])
+
+N = 20_000
+pr = cProfile.Profile()
+pr.enable()
+t0 = time.perf_counter()
+refs = [noop.remote() for _ in range(N)]
+submit_s = time.perf_counter() - t0
+pr.disable()
+print(f"submit: {N/submit_s:,.0f}/s ({submit_s:.2f}s)")
+t1 = time.perf_counter()
+while refs:
+    chunk, refs = refs[:10_000], refs[10_000:]
+    ray_tpu.get(chunk)
+drain_s = time.perf_counter() - t1
+print(f"drain: {N/drain_s:,.0f}/s ({drain_s:.2f}s)")
+stats = pstats.Stats(pr)
+stats.sort_stats("cumulative").print_stats(30)
+ray_tpu.shutdown()

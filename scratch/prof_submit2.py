@@ -1,0 +1,73 @@
+"""Component-level submit costs (dispatch stalled via impossible shape)."""
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu  # noqa: E402
+from ray_tpu._private.worker import global_worker  # noqa: E402
+
+ray_tpu.init(num_cpus=16)
+w = global_worker()
+
+
+@ray_tpu.remote(num_cpus=1)
+def noop():
+    return None
+
+
+# warm one
+ray_tpu.get(noop.remote())
+
+N = 20_000
+
+# (a) full remote() but with a resource shape that never dispatches
+# (requires custom resource nobody has -> infeasible check? it would fail
+# infeasible.  Use num_cpus=16 so at most one runs at a time: dispatch
+# mostly idle.)
+big = noop.options(num_cpus=16)
+t0 = time.perf_counter()
+refs = [big.remote() for _ in range(N)]
+dt = time.perf_counter() - t0
+print(f"submit (serialized dispatch): {N/dt:,.0f}/s  ({dt/N*1e6:.0f} us)")
+
+# (b) spec building only
+t0 = time.perf_counter()
+for _ in range(N):
+    from ray_tpu._private.ids import TaskID
+    tid = TaskID.for_normal_task(w.job_id)
+dt = time.perf_counter() - t0
+print(f"TaskID gen: {dt/N*1e6:.1f} us")
+
+from ray_tpu._private.task_spec import TaskSpec, SchedulingStrategy  # noqa
+fn_key = w.register_function(noop.func)
+t0 = time.perf_counter()
+for _ in range(N):
+    tid = TaskID.for_normal_task(w.job_id)
+    spec = TaskSpec(
+        task_id=tid.binary(), job_id=w.job_id.binary(), name="noop",
+        function_key=fn_key, args=[], kwargs={}, num_returns=1,
+        resources={"CPU": 1.0}, max_retries=3, retry_exceptions=False,
+        scheduling_strategy=SchedulingStrategy(), is_generator=False,
+        owner_id=w.worker_id.binary(), owner_addr=w.nm_addr,
+        ref_owners={}, runtime_env={}, parent_task_id=None)
+dt = time.perf_counter() - t0
+print(f"TaskID+TaskSpec build: {dt/N*1e6:.1f} us")
+
+# (c) add_task_event
+cp = w.cp
+t0 = time.perf_counter()
+for i in range(N):
+    cp.add_task_event({"task_id": "ab" * 8, "name": "noop",
+                       "state": "PENDING", "node": "cd" * 8})
+dt = time.perf_counter() - t0
+print(f"add_task_event: {dt/N*1e6:.1f} us")
+
+# (d) ObjectRef + track
+from ray_tpu.object_ref import ObjectRef  # noqa
+t0 = time.perf_counter()
+for i in range(N):
+    r = ObjectRef(os.urandom(20), None)
+dt = time.perf_counter() - t0
+print(f"ObjectRef+track: {dt/N*1e6:.1f} us")
+
+ray_tpu.shutdown()

@@ -170,3 +170,112 @@ def test_tpu_pod_slice_resources(monkeypatch):
     monkeypatch.setenv("TPU_WORKER_ID", "3")
     assert M.get_pod_head_resource_name() is None
     assert M.get_pod_worker_id() == 3
+
+
+def test_purge_node_holders_no_resurrect():
+    """A dead node's contribution purge must clamp to what the holder
+    still holds — a stale negative contribution must not resurrect an
+    emptied holder with counts nothing will ever release."""
+    import threading
+    from collections import defaultdict
+
+    from ray_tpu._private.node_manager import NodeManager
+
+    nm = object.__new__(NodeManager)   # owner tables only
+    nm._owner_lock = threading.Lock()
+    nm._owner_by_holder = defaultdict(lambda: defaultdict(int))
+    nm._owner_totals = {}
+    nm._owner_zero_since = {}
+    nm._owner_holder_contrib = {}
+
+    h = b"task:x"
+    nm.update_owned_refs(h, {b"o1": 1}, holder_node=b"A")
+    nm.update_owned_refs(h, {b"o1": -1}, holder_node=b"B")
+    assert nm._owner_totals == {}
+    nm.purge_owned_node_holders(b"B")      # must NOT resurrect +1
+    assert nm._owner_totals == {}
+    assert not nm._owner_by_holder.get(h)
+
+    # normal path: the dead node's own pin is released, the survivor's
+    # stays
+    nm.update_owned_refs(h, {b"o2": 1}, holder_node=b"A")
+    nm.update_owned_refs(h, {b"o2": 1}, holder_node=b"B")
+    assert nm._owner_totals[b"o2"] == 2
+    nm.purge_owned_node_holders(b"A")
+    assert nm._owner_totals[b"o2"] == 1
+    nm.purge_owned_node_holders(b"B")
+    assert nm._owner_totals == {}
+
+
+def test_autoscaler_v2_reconciler_state_machine():
+    """v2 reconciler: instances converge to targets through the state
+    machine, a flaky provider retries (bounded), dead nodes re-launch,
+    and excess instances terminate (ref: autoscaler/v2/instance_manager
+    /reconciler.py)."""
+    from ray_tpu.autoscaler.v2 import (FAILED, InstanceReconciler,
+                                       RAY_RUNNING, ReconcilerConfig,
+                                       TERMINATED)
+
+    class FakeProvider:
+        def __init__(self):
+            self.fail_next = 1      # first create_node raises
+            self.created = []
+            self.terminated = []
+            self._n = 0
+
+        def create_node(self, node_type):
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise RuntimeError("cloud burp")
+            self._n += 1
+            nid = bytes([self._n]) * 16
+            self.created.append(nid)
+            return nid
+
+        def terminate_node(self, node_id):
+            self.terminated.append(node_id)
+
+    provider = FakeProvider()
+    alive = set()
+
+    def nodes():
+        return [{"node_id": n, "state": "ALIVE"} for n in alive]
+
+    rec = InstanceReconciler(
+        provider, ReconcilerConfig(request_timeout_s=0.1,
+                                   allocate_timeout_s=0.2,
+                                   max_retries=2),
+        list_cluster_nodes=nodes)
+    rec.set_target("worker", 2)
+    rec.reconcile()              # queue 2, one create fails -> retry
+    rec.reconcile()              # retry succeeds; both allocated
+    assert len(provider.created) == 2
+    alive.update(provider.created)
+    rec.reconcile()              # nodes joined
+    s = rec.summary()["instances"]
+    assert s.get(RAY_RUNNING) == 2, s
+
+    # node death -> instance released and replaced
+    dead = provider.created[0]
+    alive.discard(dead)
+    rec.reconcile()              # detect death, terminate, queue new
+    rec.reconcile()              # create replacement
+    assert dead in provider.terminated
+    alive.update(n for n in provider.created if n not in alive)
+    rec.reconcile()
+    s = rec.summary()["instances"]
+    assert s.get(RAY_RUNNING) == 2, s
+
+    # scale down
+    rec.set_target("worker", 1)
+    rec.reconcile()
+    s = rec.summary()["instances"]
+    assert s.get(RAY_RUNNING) == 1 and s.get(TERMINATED, 0) >= 1, s
+
+    # a provider that always fails ends in FAILED, bounded retries
+    provider.fail_next = 99
+    rec.set_target("worker", 2)
+    for _ in range(6):
+        rec.reconcile()
+    s = rec.summary()["instances"]
+    assert s.get(FAILED, 0) >= 1, s
