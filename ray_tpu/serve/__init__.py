@@ -69,6 +69,70 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
     return wrap
 
 
+def ingress(asgi_app) -> Callable:
+    """``@serve.ingress(app)`` — route HTTP through an ASGI application
+    (parity: ``serve/api.py:168`` with FastAPI; here ANY ASGI callable
+    works, FastAPI included, so the framework carries no FastAPI pin).
+
+    The decorated deployment's replicas run one ASGI request cycle per
+    HTTP request forwarded by the proxy: full path/query/header fidelity,
+    the app's own routing, middleware and status codes — instead of the
+    proxy's default JSON convention.
+
+    ``asgi_app`` may be the ASGI callable itself or a zero-arg factory
+    (use a factory when the app isn't picklable)."""
+    def wrap(cls):
+        if not isinstance(cls, type):
+            raise TypeError("@serve.ingress decorates a deployment class")
+
+        async def __serve_asgi__(self, scope: Dict[str, Any],
+                                 body: bytes):
+            app = getattr(self, "_serve_asgi_app", None)
+            if app is None:
+                app = asgi_app
+                # zero-arg factory vs ASGI callable (3 params)
+                import inspect as _inspect
+                try:
+                    if len(_inspect.signature(app).parameters) == 0:
+                        app = app()
+                except (TypeError, ValueError):
+                    pass
+                self._serve_asgi_app = app
+            scope = dict(scope)
+            scope["headers"] = [(k.encode() if isinstance(k, str) else k,
+                                 v.encode() if isinstance(v, str) else v)
+                                for k, v in scope.get("headers", [])]
+            sent = {"status": 500, "headers": [], "chunks": []}
+            got_body = {"done": False}
+
+            async def receive():
+                if got_body["done"]:
+                    return {"type": "http.disconnect"}
+                got_body["done"] = True
+                return {"type": "http.request", "body": body or b"",
+                        "more_body": False}
+
+            async def send(message):
+                if message["type"] == "http.response.start":
+                    sent["status"] = message["status"]
+                    sent["headers"] = [
+                        (k.decode() if isinstance(k, bytes) else k,
+                         v.decode() if isinstance(v, bytes) else v)
+                        for k, v in message.get("headers", [])]
+                elif message["type"] == "http.response.body":
+                    sent["chunks"].append(message.get("body", b""))
+
+            await app(scope, receive, send)
+            return {"status": sent["status"], "headers": sent["headers"],
+                    "body": b"".join(sent["chunks"])}
+
+        cls.__serve_asgi__ = __serve_asgi__
+        cls.__serve_is_asgi__ = True
+        return cls
+
+    return wrap
+
+
 # ------------------------------------------------------------------ run
 def _get_or_create_controller():
     try:
@@ -106,6 +170,8 @@ def _collect_deployments(app: Application, app_name: str,
             "max_ongoing": dep.max_ongoing_requests,
             "user_config": dep.user_config,
             "autoscaling_config": dep.autoscaling_config,
+            "asgi": bool(getattr(dep.func_or_class,
+                                 "__serve_is_asgi__", False)),
         })
     return dep.name
 
@@ -113,16 +179,19 @@ def _collect_deployments(app: Application, app_name: str,
 def run(app: Application, *, name: str = "default",
         route_prefix: str = "/", blocking: bool = False,
         http_port: Optional[int] = None,
+        http_host: str = "127.0.0.1",
         grpc_port: Optional[int] = None) -> DeploymentHandle:
+    """Deploy ``app``; proxies bind loopback unless ``http_host`` opts
+    into a routable interface (e.g. ``"0.0.0.0"``)."""
     controller = _get_or_create_controller()
     deployments: List[Dict[str, Any]] = []
     ingress = _collect_deployments(app, name, deployments)
     ray_tpu.get(controller.deploy_application.remote(
         name, deployments, ingress), timeout=300)
     if http_port is not None:
-        start_http_proxy(http_port)
+        start_http_proxy(http_port, http_host)
     if grpc_port is not None:
-        start_grpc_proxy(grpc_port)
+        start_grpc_proxy(grpc_port, http_host)
     return DeploymentHandle(name)
 
 

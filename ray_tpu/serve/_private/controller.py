@@ -334,7 +334,8 @@ class ServeController:
             return None
         return {"deployment": name, "replicas": list(entry["replicas"]),
                 "version": entry["version"],
-                "max_ongoing": entry["config"].get("max_ongoing", 8)}
+                "max_ongoing": entry["config"].get("max_ongoing", 8),
+                "asgi": entry["config"].get("asgi", False)}
 
     def list_applications(self):
         return {app: {"deployments": {

@@ -59,30 +59,30 @@ class HTTPProxy:
         self._runner = None
         self._ready = False
         self._starting = False
+        self._handles = {}
 
     async def _start(self):
         from aiohttp import web
 
         async def handle(request: "web.Request"):
-            from ray_tpu.serve.handle import DeploymentHandle
             path = request.path.strip("/")
             app_name = path.split("/")[0] if path else "default"
+            if await self._is_asgi(app_name):
+                return await self._asgi_dispatch(app_name, request)
             stream = (request.query.get("stream") == "1"
                       or request.headers.get("X-Serve-Streaming") == "1")
             try:
                 body: Any = None
                 if request.can_read_body:
                     body = _decode_body(await request.read())
-                handle = DeploymentHandle(app_name)
+                handle = self._handle(app_name)
                 if stream:
                     return await self._stream_response(
                         request, handle, body)
-                # dispatch (routing fetch + pow-2 probes) does blocking
-                # RPCs -> executor; the result wait itself is async, so
-                # no thread is held while the model computes
-                loop = asyncio.get_running_loop()
-                resp_obj = await loop.run_in_executor(
-                    None, lambda: handle.remote(body))
+                # async end-to-end: routing fetch + pow-2 probes await
+                # on this event loop (handle.remote_async), then the
+                # result ref is awaited — no thread per request
+                resp_obj = await handle.remote_async(body)
                 response = await resp_obj.ref
                 if isinstance(response, (dict, list, int, float, bool)) \
                         or response is None:
@@ -100,6 +100,77 @@ class HTTPProxy:
         await site.start()
         self._ready = True
 
+    def _handle(self, app_name: str):
+        """Cached per-app ingress handles: each handle owns a routing
+        cache + long-poll thread, so per-request construction would
+        refetch routing from the controller every call."""
+        from ray_tpu.serve.handle import DeploymentHandle
+        h = self._handles.get(app_name)
+        if h is None:
+            # bounded LRU: the key is a client-supplied path segment, so
+            # unique bogus paths must not grow this without limit
+            if len(self._handles) >= 256:
+                evict = next(iter(self._handles))
+                self._handles.pop(evict, None)
+            h = self._handles[app_name] = DeploymentHandle(app_name)
+        else:
+            # move-to-end for LRU recency
+            self._handles[app_name] = self._handles.pop(app_name)
+        return h
+
+    async def _is_asgi(self, app_name: str) -> bool:
+        """Whether this app's ingress is an ASGI deployment — read per
+        request from the handle's routing table, which the long-poll
+        invalidates on redeploy (a positive cache here would survive an
+        ASGI→plain redeploy and dispatch a method the new replicas
+        don't have)."""
+        try:
+            routing = await self._handle(app_name)._get_routing_async()
+        except Exception:  # noqa: BLE001 — unknown app: default path
+            return False
+        return bool(routing.get("asgi"))
+
+    async def _asgi_dispatch(self, app_name: str, request):
+        """Forward the request as one ASGI cycle on an ingress replica
+        (reference: ``serve.ingress(fastapi_app)``, serve/api.py:168)."""
+        from aiohttp import web
+        prefix = f"/{app_name}"
+        path = request.path
+        if path.startswith(prefix):
+            path = path[len(prefix):] or "/"
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "scheme": request.scheme,
+            "path": path,
+            "raw_path": path.encode(),
+            "root_path": "",
+            "query_string": request.query_string.encode(),
+            "headers": [(k.lower(), v)
+                        for k, v in request.headers.items()],
+            "client": (request.remote, 0),
+            "server": (self.host, self.port),
+        }
+        body = await request.read() if request.can_read_body else b""
+        handle = self._handle(app_name).options(
+            method_name="__serve_asgi__")
+        try:
+            resp_obj = await handle.remote_async(scope, body)
+            result = await resp_obj.ref
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        from multidict import CIMultiDict
+        headers = CIMultiDict()
+        for k, v in result.get("headers", []):
+            # multidict: repeated headers (Set-Cookie!) must survive
+            if k.lower() not in ("content-length", "transfer-encoding"):
+                headers.add(k, v)
+        return web.Response(status=result.get("status", 200),
+                            headers=headers,
+                            body=result.get("body", b""))
+
     async def _stream_response(self, request, handle, body):
         from aiohttp import web
         sse = "text/event-stream" in request.headers.get("Accept", "")
@@ -107,9 +178,7 @@ class HTTPProxy:
             headers={"Content-Type": ("text/event-stream" if sse
                                       else "application/x-ndjson")})
         await resp.prepare(request)
-        loop = asyncio.get_running_loop()
-        gen = await loop.run_in_executor(
-            None, lambda: handle.options(stream=True).remote(body))
+        gen = await handle.options(stream=True).remote_async(body)
         async for ref in gen.ref_generator:
             item = await ref
             payload = _encode_item(item)

@@ -124,6 +124,7 @@ class DeploymentHandle:
         sub._mux_affinity = self._mux_affinity
         sub._mux_probe_ok = self._mux_probe_ok
         sub._get_routing = self._get_routing
+        sub._get_routing_async = self._get_routing_async
         self.__dict__[name] = sub
         return sub
 
@@ -141,11 +142,16 @@ class DeploymentHandle:
         sub._mux_affinity = self._mux_affinity
         sub._mux_probe_ok = self._mux_probe_ok
         sub._get_routing = self._get_routing
+        sub._get_routing_async = self._get_routing_async
         return sub
 
     def _controller(self):
-        from ray_tpu.serve._private.controller import CONTROLLER_NAME
-        return ray_tpu.get_actor(CONTROLLER_NAME)
+        ctrl = self.__dict__.get("_controller_handle")
+        if ctrl is None:
+            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+            self.__dict__["_controller_handle"] = ctrl
+        return ctrl
 
     def _get_routing(self, refresh: bool = False) -> Dict[str, Any]:
         with self._lock:
@@ -219,6 +225,108 @@ class DeploymentHandle:
         ref = replica.handle_request.remote(self._method, args, kwargs,
                                             mux)
         return DeploymentResponse(ref)
+
+    # ------------------------------------------------------------------
+    # Async dispatch (proxy hot path).  Same routing logic as the sync
+    # path, but every wait point — controller fetch, replica probes,
+    # scale-from-zero backoff — is awaited on the caller's event loop
+    # instead of burning an executor thread per request (reference:
+    # the proxy is async end-to-end, ``serve/_private/proxy.py:423``).
+    # ------------------------------------------------------------------
+    async def _get_routing_async(self, refresh: bool = False):
+        with self._lock:
+            routing = None if refresh else self._routing
+        if routing is None:
+            ref = self._controller().get_routing.remote(
+                self._app, self._deployment)
+            import asyncio
+            routing = await asyncio.wait_for(ref, timeout=30)
+            if routing is None:
+                raise RuntimeError(
+                    f"no deployment {self._deployment or '(ingress)'} "
+                    f"in app {self._app!r}")
+            with self._lock:
+                self._routing = routing
+        self._start_poller(routing["deployment"])
+        return routing
+
+    async def _wait_for_replicas_async(self, timeout_s: float = 30.0):
+        import asyncio
+        import time as _time
+        routing = await self._get_routing_async()
+        deadline = _time.monotonic() + timeout_s
+        kicked = False
+        while not routing["replicas"]:
+            if not kicked:
+                try:
+                    await asyncio.wait_for(
+                        self._controller().request_upscale.remote(
+                            self._app, routing["deployment"]), timeout=30)
+                except Exception:  # noqa: BLE001 — retried below
+                    pass
+                kicked = True
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"deployment {routing['deployment']!r} has no "
+                    f"replicas after {timeout_s}s")
+            await asyncio.sleep(0.1)
+            routing = await self._get_routing_async(refresh=True)
+        return routing
+
+    async def _pick_replica_async(self):
+        import asyncio
+        routing = await self._get_routing_async()
+        if not routing["replicas"]:
+            routing = await self._wait_for_replicas_async()
+        replicas = routing["replicas"]
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+
+        async def _aw(ref):
+            return await ref
+
+        try:
+            qa, qb = await asyncio.wait_for(
+                asyncio.gather(_aw(a.num_ongoing.remote()),
+                               _aw(b.num_ongoing.remote())),
+                timeout=5)
+        except Exception:  # noqa: BLE001 - refresh and fall back
+            routing = await self._get_routing_async(refresh=True)
+            if not routing["replicas"]:
+                routing = await self._wait_for_replicas_async()
+            return random.choice(routing["replicas"])
+        return a if qa <= qb else b
+
+    async def remote_async(self, *args, **kwargs):
+        """Route + dispatch without blocking the event loop; returns the
+        same DeploymentResponse/Generator as ``remote()``."""
+        mux = self._mux_id
+        if mux:
+            import time as _time
+            routing = await self._get_routing_async()
+            replica = self._mux_affinity.get(mux)
+            if replica is not None and replica in routing["replicas"]:
+                last_ok = self._mux_probe_ok.get(replica, 0.0)
+                if _time.monotonic() - last_ok > self._MUX_PROBE_TTL_S:
+                    import asyncio
+                    try:
+                        await asyncio.wait_for(
+                            replica.num_ongoing.remote(), timeout=5)
+                        self._mux_probe_ok[replica] = _time.monotonic()
+                    except Exception:  # noqa: BLE001 — crashed: re-pin
+                        await self._get_routing_async(refresh=True)
+                        self._mux_probe_ok.pop(replica, None)
+                        replica = None
+            else:
+                replica = None
+            if replica is None:
+                replica = await self._pick_replica_async()
+                self._mux_affinity[mux] = replica
+                self._mux_probe_ok[replica] = _time.monotonic()
+            return self._dispatch(replica, args, kwargs, mux)
+        replica = await self._pick_replica_async()
+        return self._dispatch(replica, args, kwargs)
 
     def remote(self, *args, **kwargs):
         mux = self._mux_id
