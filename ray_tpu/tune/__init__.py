@@ -7,6 +7,7 @@ from ray_tpu.train.session import report as _train_report
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler,
                                      MedianStoppingRule,
+                                     PB2,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search.bayesopt import GPSearcher
 from ray_tpu.tune.search.sample import (choice, grid_search, loguniform,
@@ -14,6 +15,7 @@ from ray_tpu.tune.search.sample import (choice, grid_search, loguniform,
                                         uniform)
 from ray_tpu.tune.search.searcher import BasicVariantGenerator, Searcher
 from ray_tpu.tune.search.tpe import TPESearcher
+from ray_tpu.tune.trainable import Trainable
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 
@@ -62,6 +64,6 @@ __all__ = [
     "quniform", "sample_from", "grid_search", "with_resources",
     "with_parameters", "run", "ASHAScheduler", "FIFOScheduler",
     "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",
+    "PB2", "PopulationBasedTraining", "Searcher", "BasicVariantGenerator",
     "TPESearcher", "GPSearcher",
 ]

@@ -291,3 +291,106 @@ class PopulationBasedTraining:
 
     def on_trial_complete(self, trial_id: str):
         self._scores.pop(trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (parity: ``tune/schedulers/pb2.py``).
+
+    PBT with the random explore step replaced by a GP-bandit: observed
+    (time, hyperparams) -> reward-improvement transitions from the whole
+    population fit a Gaussian process, and the exploited trial's new
+    config maximizes UCB within ``hyperparam_bounds`` — data-efficient
+    mutation for small populations (Parker-Holder et al., NeurIPS '20).
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 ucb_kappa: float = 2.0, n_candidates: int = 256):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = dict(hyperparam_bounds or {})
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # transition dataset: rows [t, *hyperparams] -> reward delta
+        self._transitions: List = []
+        self._last_metric: Dict[str, float] = {}
+
+    def _record_transition(self, trial_id: str, t: float,
+                           metric: float) -> None:
+        prev = self._last_metric.get(trial_id)
+        self._last_metric[trial_id] = metric
+        if prev is None:
+            return
+        cfg = self._configs.get(trial_id, {})
+        try:
+            x = [float(t)] + [float(cfg[k]) for k in self.bounds]
+        except (KeyError, TypeError, ValueError):
+            return
+        self._transitions.append((x, metric - prev))
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric) if self.metric else None
+        if t is not None and metric is not None:
+            self._record_transition(trial_id, float(t),
+                                    self._norm(float(metric)))
+        return super().on_result(trial_id, result)
+
+    def _mutate(self, config: Dict) -> Dict:
+        """GP-UCB explore (overrides PBT's random perturbation)."""
+        import numpy as np
+        out = dict(config)
+        keys = list(self.bounds)
+        if not keys:
+            return out
+        lows = np.array([self.bounds[k][0] for k in keys], float)
+        highs = np.array([self.bounds[k][1] for k in keys], float)
+        span = np.maximum(highs - lows, 1e-12)
+        rng = np.random.default_rng(self._rng.randrange(2 ** 31))
+        cand = rng.uniform(size=(self.n_candidates, len(keys)))
+        data = self._transitions[-256:]
+        if len(data) >= 4:
+            X = np.array([row for row, _ in data], float)
+            y = np.array([dy for _, dy in data], float)
+            # normalize: time to [0,1] over observed range, hps by bounds
+            t0, t1 = X[:, 0].min(), max(X[:, 0].max(), X[:, 0].min() + 1)
+            Xn = np.empty_like(X)
+            Xn[:, 0] = (X[:, 0] - t0) / (t1 - t0)
+            Xn[:, 1:] = (X[:, 1:] - lows) / span
+            ystd = y.std() or 1.0
+            yn = (y - y.mean()) / ystd
+            ls, noise = 0.3, 1e-3
+            K = _pb2_rbf(Xn, Xn, ls) + noise * np.eye(len(Xn))
+            try:
+                L = np.linalg.cholesky(K)
+                alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+                # candidates evaluated at the *next* time step (1.0)
+                C = np.concatenate(
+                    [np.ones((len(cand), 1)), cand], axis=1)
+                Ks = _pb2_rbf(C, Xn, ls)
+                mu = Ks @ alpha
+                v = np.linalg.solve(L, Ks.T)
+                var = np.maximum(1.0 - (v ** 2).sum(0), 1e-9)
+                score = mu + self.kappa * np.sqrt(var)
+                best = cand[int(np.argmax(score))]
+            except np.linalg.LinAlgError:
+                best = cand[0]
+        else:
+            best = cand[0]
+        for i, k in enumerate(keys):
+            val = lows[i] + best[i] * span[i]
+            if isinstance(config.get(k), int):
+                val = int(round(val))
+            out[k] = val
+        return out
+
+
+def _pb2_rbf(a, b, ls):
+    import numpy as np
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    return np.exp(-0.5 * d2 / (ls * ls))

@@ -10,6 +10,7 @@ resources, giving max-concurrency-by-resources like the reference.
 from __future__ import annotations
 
 import os
+import shutil
 import threading
 import time
 import uuid
@@ -143,6 +144,10 @@ class Tuner:
                  _restored_trials: Optional[List[Trial]] = None):
         if hasattr(trainable, "as_trainable"):
             trainable = trainable.as_trainable()
+        from ray_tpu.tune.trainable import is_trainable_class, \
+            wrap_trainable
+        if is_trainable_class(trainable):
+            trainable = wrap_trainable(trainable)
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
@@ -400,6 +405,12 @@ class Tuner:
                     if checkpoint is not None:
                         trial.checkpoint = checkpoint.persist(
                             os.path.join(storage, trial.trial_id))
+                        if getattr(checkpoint, "_ephemeral_source",
+                                   False):
+                            # class-Trainable wrapper tempdir: persisted
+                            # copy is durable, drop the per-step source
+                            shutil.rmtree(checkpoint.path,
+                                          ignore_errors=True)
                         save_state(throttled=True)
                     decision = scheduler.on_result(trial.trial_id,
                                                    metrics)
