@@ -1,10 +1,16 @@
 """``ray_tpu.rllib`` — reinforcement learning (parity: ``ray.rllib``)."""
 
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
+                                                      MultiAgentPPOConfig)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.rl_module import (DiscreteMLPModule,
                                           MLPModuleConfig)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
+                                               MultiAgentEnv,
+                                               MultiAgentEnvRunner)
 
 __all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
            "DiscreteMLPModule", "MLPModuleConfig",

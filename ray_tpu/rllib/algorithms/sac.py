@@ -1,0 +1,243 @@
+"""SAC — soft actor-critic on JAX (continuous control).
+
+Parity: reference ``rllib/algorithms/sac/`` (new stack): off-policy
+actor-critic with twin clipped-double-Q critics, tanh-squashed Gaussian
+policy, automatic entropy-temperature tuning, and polyak-averaged
+target critics.  TPU-first: actor+critic+alpha updates fuse into ONE
+jitted step over the sampled minibatch; replay stays in host numpy
+(``dqn.ReplayBuffer`` shape, continuous actions).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.core.rl_module import (ContinuousModuleConfig,
+                                          SquashedGaussianModule,
+                                          TwinQModule)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+
+@dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_env_runners: int = 1
+    rollout_length: int = 128
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005              # polyak target coefficient
+    initial_alpha: float = 1.0
+    target_entropy: Optional[float] = None   # default: -act_dim
+    buffer_size: int = 100_000
+    learn_start: int = 1_000
+    train_batch_size: int = 256
+    updates_per_iteration: int = 64
+    hidden: tuple = (256, 256)
+    seed: int = 0
+
+    def environment(self, env: str, env_config: Optional[Dict] = None):
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if rollout_length:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SACLearner:
+    """One jitted SAC update: critics + actor + alpha + polyak."""
+
+    def __init__(self, actor: SquashedGaussianModule, critic: TwinQModule,
+                 config: SACConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.actor = actor
+        self.critic = critic
+        self.config = config
+        cfg = config
+        act_dim = actor.config.act_dim
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None
+                          else -float(act_dim))
+        self.tx_actor = optax.adam(cfg.actor_lr)
+        self.tx_critic = optax.adam(cfg.critic_lr)
+        self.tx_alpha = optax.adam(cfg.alpha_lr)
+
+        def critic_loss(cp, ap, tcp, log_alpha, batch, key):
+            next_a, next_logp = actor.sample(ap, batch["next_obs"], key)
+            q1t, q2t = critic.forward(tcp, batch["next_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            soft_q = jnp.minimum(q1t, q2t) - alpha * next_logp
+            target = batch["rewards"] + cfg.gamma * \
+                (1.0 - batch["terminateds"]) * soft_q
+            target = jax.lax.stop_gradient(target)
+            q1, q2 = critic.forward(cp, batch["obs"], batch["actions"])
+            loss = jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+            return loss, {"q1_mean": jnp.mean(q1),
+                          "critic_loss": loss}
+
+        def actor_loss(ap, cp, log_alpha, batch, key):
+            a, logp = actor.sample(ap, batch["obs"], key)
+            q1, q2 = critic.forward(cp, batch["obs"], a)
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            loss = jnp.mean(alpha * logp - jnp.minimum(q1, q2))
+            return loss, {"actor_loss": loss,
+                          "entropy": -jnp.mean(logp),
+                          "logp_mean": jnp.mean(logp)}
+
+        def alpha_loss(log_alpha, logp_mean):
+            return -log_alpha * jax.lax.stop_gradient(
+                logp_mean + target_entropy)
+
+        @jax.jit
+        def update(state, batch, key):
+            (ap, cp, tcp, log_alpha,
+             opt_a, opt_c, opt_al) = state
+            k1, k2 = jax.random.split(key)
+            (closs, cmetrics), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)(cp, ap, tcp, log_alpha,
+                                           batch, k1)
+            cupd, opt_c = self.tx_critic.update(cgrads, opt_c, cp)
+            cp = optax.apply_updates(cp, cupd)
+            (aloss, ametrics), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(ap, cp, log_alpha, batch, k2)
+            aupd, opt_a = self.tx_actor.update(agrads, opt_a, ap)
+            ap = optax.apply_updates(ap, aupd)
+            algrad = jax.grad(alpha_loss)(log_alpha,
+                                          ametrics["logp_mean"])
+            alupd, opt_al = self.tx_alpha.update(
+                {"a": algrad}, opt_al, {"a": log_alpha})
+            log_alpha = optax.apply_updates({"a": log_alpha}, alupd)["a"]
+            tcp = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, tcp, cp)
+            metrics = {**cmetrics, **ametrics,
+                       "alpha": jnp.exp(log_alpha)}
+            metrics.pop("logp_mean", None)
+            return (ap, cp, tcp, log_alpha, opt_a, opt_c, opt_al), \
+                metrics
+
+        self._update = update
+
+    def init_state(self, key):
+        import jax
+        import jax.numpy as jnp
+        ka, kc = jax.random.split(key)
+        ap = self.actor.init_params(ka)
+        cp = self.critic.init_params(kc)
+        log_alpha = jnp.asarray(
+            np.log(self.config.initial_alpha), jnp.float32)
+        return (ap, cp, cp, log_alpha,
+                self.tx_actor.init(ap), self.tx_critic.init(cp),
+                self.tx_alpha.init({"a": log_alpha}))
+
+
+class SAC:
+    """Algorithm driver (parity: ``SAC.train()``)."""
+
+    def __init__(self, config: SACConfig):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+        self.config = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_shape = probe.observation_space.shape
+        space = probe.action_space
+        probe.close()
+        mcfg = ContinuousModuleConfig(
+            obs_dim=int(np.prod(obs_shape)),
+            act_dim=int(np.prod(space.shape)),
+            act_low=tuple(np.asarray(space.low).ravel().tolist()),
+            act_high=tuple(np.asarray(space.high).ravel().tolist()),
+            hidden=tuple(config.hidden))
+        self.actor = SquashedGaussianModule(mcfg)
+        self.critic = TwinQModule(mcfg)
+        self.learner = SACLearner(self.actor, self.critic, config)
+        self.state = self.learner.init_state(
+            jax.random.PRNGKey(config.seed))
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        blob = cloudpickle.dumps(self.actor)
+        self.env_runners = [
+            SingleAgentEnvRunner.remote(
+                config.env, blob, config.rollout_length,
+                seed=config.seed + i, env_config=config.env_config)
+            for i in range(config.num_env_runners)]
+        self.buffer = ReplayBuffer(config.buffer_size, obs_shape,
+                                   seed=config.seed)
+        # continuous actions: retype the buffer's action storage
+        self.buffer.actions = np.zeros(
+            (config.buffer_size, mcfg.act_dim), np.float32)
+        self.iteration = 0
+        self.timesteps_total = 0
+        self.updates_total = 0
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        t0 = time.time()
+        cfg = self.config
+        actor_params = jax.tree.map(np.asarray, self.state[0])
+        params_ref = ray_tpu.put(actor_params)
+        warmup = self.timesteps_total < cfg.learn_start
+        batches = ray_tpu.get(
+            [r.sample_continuous.remote(params_ref, warmup)
+             for r in self.env_runners], timeout=600)
+        for b in batches:
+            self.buffer.add_batch(b)
+            self.timesteps_total += len(b["obs"])
+
+        metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= cfg.learn_start:
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self._key, sub = jax.random.split(self._key)
+                self.state, metrics = self.learner._update(
+                    self.state, mb, sub)
+                self.updates_total += 1
+        runner_metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.env_runners],
+            timeout=120)
+        returns = [m["episode_return_mean"] for m in runner_metrics
+                   if not np.isnan(m["episode_return_mean"])]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "updates_total": self.updates_total,
+            "buffer_size": len(self.buffer),
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "time_this_iter_s": time.time() - t0,
+            **{f"learner/{k}": float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        for runner in self.env_runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass

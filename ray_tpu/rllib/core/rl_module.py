@@ -74,3 +74,105 @@ class DiscreteMLPModule:
         logp = jnp.take_along_axis(
             jax.nn.log_softmax(logits), actions[..., None], -1)[..., 0]
         return actions, logp, value
+
+
+@dataclass
+class ContinuousModuleConfig:
+    obs_dim: int = 3
+    act_dim: int = 1
+    act_low: Tuple[float, ...] = (-1.0,)
+    act_high: Tuple[float, ...] = (1.0,)
+    hidden: Tuple[int, ...] = (256, 256)
+    log_std_bounds: Tuple[float, float] = (-10.0, 2.0)
+    dtype: Any = jnp.float32
+
+
+def _mlp_init(key, sizes, dtype, out_scale=0.01, out_dim=None):
+    layers = []
+    keys = jax.random.split(key, len(sizes))
+    for i in range(len(sizes) - 1):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * \
+            (2.0 / sizes[i]) ** 0.5
+        layers.append({"w": w.astype(dtype),
+                       "b": jnp.zeros(sizes[i + 1], dtype)})
+    if out_dim is not None:
+        w = jax.random.normal(keys[-1], (sizes[-1], out_dim)) * out_scale
+        layers.append({"w": w.astype(dtype),
+                       "b": jnp.zeros(out_dim, dtype)})
+    return layers
+
+
+def _mlp_apply(layers, x, final_linear: bool):
+    n = len(layers)
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1 or not final_linear:
+            x = jax.nn.relu(x)
+    return x
+
+
+class SquashedGaussianModule:
+    """Tanh-squashed Gaussian actor (SAC policy; reference:
+    ``rllib/algorithms/sac/sac_rl_module.py`` action dist)."""
+
+    def __init__(self, config: ContinuousModuleConfig):
+        self.config = config
+        self._low = np.asarray(config.act_low, np.float32)
+        self._high = np.asarray(config.act_high, np.float32)
+
+    def init_params(self, key):
+        cfg = self.config
+        sizes = (cfg.obs_dim,) + tuple(cfg.hidden)
+        return {"trunk": _mlp_init(key, sizes, cfg.dtype,
+                                   out_scale=0.01,
+                                   out_dim=2 * cfg.act_dim)}
+
+    def dist_params(self, params, obs):
+        out = _mlp_apply(params["trunk"], obs, final_linear=True)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        lo, hi = self.config.log_std_bounds
+        log_std = lo + 0.5 * (hi - lo) * (jnp.tanh(log_std) + 1.0)
+        return mean, log_std
+
+    def sample(self, params, obs, key):
+        """-> (action in env bounds, log_prob)."""
+        mean, log_std = self.dist_params(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        logp = (-0.5 * (eps ** 2 + 2 * log_std
+                        + jnp.log(2 * jnp.pi))).sum(-1)
+        a = jnp.tanh(pre)
+        # tanh change-of-variables
+        logp -= jnp.log(jnp.clip(1 - a ** 2, 1e-6)).sum(-1)
+        scale = (self._high - self._low) / 2.0
+        act = self._low + (a + 1.0) * scale
+        logp -= jnp.log(scale).sum()
+        return act, logp
+
+    def deterministic(self, params, obs):
+        mean, _ = self.dist_params(params, obs)
+        a = jnp.tanh(mean)
+        return self._low + (a + 1.0) * (self._high - self._low) / 2.0
+
+
+class TwinQModule:
+    """Clipped double-Q critics (reference: SAC twin Q)."""
+
+    def __init__(self, config: ContinuousModuleConfig):
+        self.config = config
+
+    def init_params(self, key):
+        cfg = self.config
+        k1, k2 = jax.random.split(key)
+        sizes = (cfg.obs_dim + cfg.act_dim,) + tuple(cfg.hidden)
+        return {"q1": _mlp_init(k1, sizes, cfg.dtype, out_scale=1.0,
+                                out_dim=1),
+                "q2": _mlp_init(k2, sizes, cfg.dtype, out_scale=1.0,
+                                out_dim=1)}
+
+    def forward(self, params, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        q1 = _mlp_apply(params["q1"], x, final_linear=True)[..., 0]
+        q2 = _mlp_apply(params["q2"], x, final_linear=True)[..., 0]
+        return q1, q2

@@ -135,3 +135,46 @@ class SingleAgentEnvRunner:
             "num_episodes": len(self.completed_returns),
         }
         return out
+
+    def sample_continuous(self, params, warmup_random: bool = False
+                          ) -> "Dict[str, np.ndarray]":
+        """Stochastic continuous-action rollout (SAC exploration):
+        actions sampled from the squashed-Gaussian policy (or the env's
+        action space during warmup), transitions for the replay buffer."""
+        import jax
+        if getattr(self, "_jit_cont", None) is None:
+            self._jit_cont = jax.jit(self.module.sample)
+            self._key = jax.random.PRNGKey(
+                int(self.rng.integers(2 ** 31)))
+        T = self.rollout_length
+        act_dim = int(np.prod(self.env.action_space.shape))
+        obs_buf = np.zeros((T,) + np.shape(self.obs), np.float32)
+        next_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros((T, act_dim), np.float32)
+        rew_buf = np.zeros((T,), np.float32)
+        done_buf = np.zeros((T,), np.float32)
+        for t in range(T):
+            if warmup_random:
+                a = self.env.action_space.sample().astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a = np.asarray(self._jit_cont(
+                    params, self.obs[None, :], sub)[0][0], np.float32)
+            obs_buf[t] = self.obs
+            act_buf[t] = a.reshape(act_dim)
+            nxt, rew, terminated, truncated, _ = self.env.step(
+                a.reshape(self.env.action_space.shape))
+            rew_buf[t] = rew
+            done_buf[t] = float(terminated)
+            next_buf[t] = nxt
+            self._episode_return += rew
+            self._episode_len += 1
+            if terminated or truncated:
+                self.completed_returns.append(self._episode_return)
+                self.completed_lengths.append(self._episode_len)
+                self._episode_return = 0.0
+                self._episode_len = 0
+                nxt, _ = self.env.reset()
+            self.obs = np.asarray(nxt, np.float32)
+        return {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                "next_obs": next_buf, "terminateds": done_buf}
