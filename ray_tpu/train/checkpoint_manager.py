@@ -69,17 +69,31 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> Checkpoint:
-        try:
-            # stamp metrics BEFORE persisting: a remote checkpoint's
-            # set_metadata would have to re-upload
-            meta = checkpoint.get_metadata()
-            meta["metrics"] = {k: v for k, v in metrics.items()
-                               if isinstance(v, (int, float, str, bool))}
-            checkpoint.set_metadata(meta)
-        except Exception:  # noqa: BLE001 — metadata is best-effort
-            pass
+        clean = {k: v for k, v in metrics.items()
+                 if isinstance(v, (int, float, str, bool))}
+
+        def stamp(ckpt: Checkpoint) -> None:
+            try:
+                meta = ckpt.get_metadata()
+                meta["metrics"] = clean
+                ckpt.set_metadata(meta)
+            except Exception:  # noqa: BLE001 — metadata is best-effort
+                import logging
+                logging.getLogger(__name__).warning(
+                    "checkpoint metadata stamp failed for %s",
+                    ckpt.path, exc_info=True)
+
+        # Local destination: stamp the PERSISTED copy (don't mutate the
+        # caller's directory).  Remote destination: set_metadata can't
+        # write through a URI, so pre-stamp the local source just before
+        # the upload carries it; a remote source keeps its metadata.
+        dest_remote = is_remote_uri(self.storage_dir)
+        if dest_remote and not is_remote_uri(checkpoint.path):
+            stamp(checkpoint)
         persisted = checkpoint.persist(
             self.storage_dir, f"checkpoint_{self._index:06d}")
+        if not dest_remote:
+            stamp(persisted)
         self._index += 1
         self.latest = persisted
         attr = self.config.checkpoint_score_attribute

@@ -1,0 +1,14 @@
+"""``ray_tpu.train.huggingface`` — HF Transformers fine-tuning on TPU.
+
+Parity: ``python/ray/train/huggingface/`` (TransformersTrainer), built
+TPU-native: checkpoints port into the in-tree XLA GPT once and train
+sharded (see ``transformers_trainer.py``).
+"""
+
+from ray_tpu.train.huggingface.transformers_trainer import (
+    TransformersTrainer)
+from ray_tpu.train.huggingface.weights import (export_gpt2, gpt2_config,
+                                               load_model, port_gpt2)
+
+__all__ = ["TransformersTrainer", "port_gpt2", "export_gpt2",
+           "gpt2_config", "load_model"]

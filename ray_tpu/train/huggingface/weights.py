@@ -1,0 +1,167 @@
+"""HF Transformers ↔ native-flax GPT-2 weight porting.
+
+Parity target: the reference's HF integration
+(``python/ray/train/huggingface/transformers/``) fine-tunes HF torch
+models directly; the TPU-native equivalent ports the checkpoint once
+into the in-tree XLA GPT (``ray_tpu.models.gpt``) and trains that —
+bf16 matmuls, sharding rules, fused attention — instead of dragging a
+torch module graph onto TPU.
+
+``port_gpt2`` maps ``GPT2LMHeadModel`` state (HF ``Conv1D`` stores
+weights as ``[in, out]``) onto the stacked-[L, ...] param tree of
+``GPTConfig(use_bias=True, norm="layernorm", act="gelu",
+pos="learned")`` — an exact-architecture match, verified logit-for-
+logit by ``tests/test_hf_port.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.models.gpt import GPTConfig
+
+
+def gpt2_config(hf_config, dtype=None, **overrides) -> GPTConfig:
+    """GPTConfig matching an HF ``GPT2Config`` exactly."""
+    import jax.numpy as jnp
+    kw: Dict[str, Any] = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        max_seq=hf_config.n_positions,
+        norm="layernorm",
+        act="gelu",
+        pos="learned",
+        use_bias=True,
+        tie_embeddings=True,
+        dtype=dtype or jnp.bfloat16,
+    )
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def port_gpt2(model_or_state, hf_config=None, dtype=None,
+              **config_overrides) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """(HF GPT2LMHeadModel | state_dict, config) -> (GPTConfig, params).
+
+    Returns numpy-leaved params (cheap to ship through the object store
+    to train workers, converted to device arrays at mesh-placement
+    time).
+    """
+    if hf_config is None:
+        hf_config = model_or_state.config
+    state = (model_or_state if isinstance(model_or_state, dict)
+             else model_or_state.state_dict())
+    sd = {k.replace("transformer.", ""): _np(v) for k, v in state.items()}
+    cfg = gpt2_config(hf_config, dtype=dtype, **config_overrides)
+    d, H, hd, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.n_layers
+
+    def stack(fmt: str, post=lambda a: a) -> np.ndarray:
+        return np.stack([post(sd[fmt.format(i=i)]) for i in range(L)])
+
+    qkv_w = stack("h.{i}.attn.c_attn.weight")          # [L, d, 3d]
+    qkv_b = stack("h.{i}.attn.c_attn.bias")            # [L, 3d]
+    wq, wk, wv = np.split(qkv_w, 3, axis=2)
+    bq, bk, bv = np.split(qkv_b, 3, axis=1)
+    layers = {
+        "ln1": stack("h.{i}.ln_1.weight"),
+        "ln1_b": stack("h.{i}.ln_1.bias"),
+        "wq": wq.reshape(L, d, H, hd),
+        "wk": wk.reshape(L, d, H, hd),
+        "wv": wv.reshape(L, d, H, hd),
+        "bq": bq.reshape(L, H, hd),
+        "bk": bk.reshape(L, H, hd),
+        "bv": bv.reshape(L, H, hd),
+        "wo": stack("h.{i}.attn.c_proj.weight",
+                    lambda a: a.reshape(H, hd, d)),
+        "bo": stack("h.{i}.attn.c_proj.bias"),
+        "ln2": stack("h.{i}.ln_2.weight"),
+        "ln2_b": stack("h.{i}.ln_2.bias"),
+        "w1": stack("h.{i}.mlp.c_fc.weight"),
+        "b1": stack("h.{i}.mlp.c_fc.bias"),
+        "w2": stack("h.{i}.mlp.c_proj.weight"),
+        "b2": stack("h.{i}.mlp.c_proj.bias"),
+    }
+    params = {
+        "embed": sd["wte.weight"],
+        "pos_embed": sd["wpe.weight"],
+        "layers": layers,
+        "ln_f": sd["ln_f.weight"],
+        "ln_f_b": sd["ln_f.bias"],
+    }
+    return cfg, params
+
+
+def export_gpt2(params: Dict[str, Any], hf_model) -> None:
+    """Write native params back into an HF ``GPT2LMHeadModel`` in place
+    (round-trip path: fine-tune on TPU, hand back an HF checkpoint)."""
+    import torch
+
+    cfg = hf_model.config
+    d, H = cfg.n_embd, cfg.n_head
+    hd = d // H
+    L = cfg.n_layer
+    p = {k: np.asarray(v, dtype=np.float32)
+         for k, v in _flatten(params).items()}
+
+    def t(a):
+        return torch.from_numpy(np.ascontiguousarray(a))
+
+    sd = hf_model.state_dict()
+    sd["transformer.wte.weight"].copy_(t(p["embed"]))
+    sd["transformer.wpe.weight"].copy_(t(p["pos_embed"]))
+    sd["transformer.ln_f.weight"].copy_(t(p["ln_f"]))
+    sd["transformer.ln_f.bias"].copy_(t(p["ln_f_b"]))
+    if "lm_head.weight" in sd:
+        sd["lm_head.weight"].copy_(t(p["embed"]))
+    for i in range(L):
+        pre = f"transformer.h.{i}."
+        qkv_w = np.concatenate([
+            p["layers.wq"][i].reshape(d, d),
+            p["layers.wk"][i].reshape(d, d),
+            p["layers.wv"][i].reshape(d, d)], axis=1)
+        qkv_b = np.concatenate([
+            p["layers.bq"][i].reshape(d),
+            p["layers.bk"][i].reshape(d),
+            p["layers.bv"][i].reshape(d)])
+        sd[pre + "attn.c_attn.weight"].copy_(t(qkv_w))
+        sd[pre + "attn.c_attn.bias"].copy_(t(qkv_b))
+        sd[pre + "attn.c_proj.weight"].copy_(
+            t(p["layers.wo"][i].reshape(d, d)))
+        sd[pre + "attn.c_proj.bias"].copy_(t(p["layers.bo"][i]))
+        sd[pre + "ln_1.weight"].copy_(t(p["layers.ln1"][i]))
+        sd[pre + "ln_1.bias"].copy_(t(p["layers.ln1_b"][i]))
+        sd[pre + "ln_2.weight"].copy_(t(p["layers.ln2"][i]))
+        sd[pre + "ln_2.bias"].copy_(t(p["layers.ln2_b"][i]))
+        sd[pre + "mlp.c_fc.weight"].copy_(t(p["layers.w1"][i]))
+        sd[pre + "mlp.c_fc.bias"].copy_(t(p["layers.b1"][i]))
+        sd[pre + "mlp.c_proj.weight"].copy_(t(p["layers.w2"][i]))
+        sd[pre + "mlp.c_proj.bias"].copy_(t(p["layers.b2"][i]))
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def load_model(model, dtype=None, **overrides
+               ) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """Accepts an HF model instance, a state_dict+config pair, or a
+    checkpoint path / hub name (resolved via ``from_pretrained``)."""
+    if isinstance(model, str):
+        from transformers import GPT2LMHeadModel
+        model = GPT2LMHeadModel.from_pretrained(model)
+    return port_gpt2(model, dtype=dtype, **overrides)
