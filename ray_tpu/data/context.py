@@ -1,0 +1,31 @@
+"""DataContext — process-wide execution options for Datasets.
+
+Parity role: ``python/ray/data/context.py`` (DataContext) — the knobs
+the streaming executor reads at operator-construction time.  Thread
+through ``DataContext.get_current()``; tests and jobs mutate the
+singleton.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    # per-operator cap on OUTSTANDING bytes (in-flight task outputs +
+    # completed-but-unreleased buffer).  None = task-count budgets only.
+    # (reference: backpressure_policy/concurrency_cap + the resource
+    # manager's per-op memory budgets)
+    op_bytes_budget: Optional[int] = None
+    # default per-operator in-flight task cap
+    op_task_budget: int = 8
+
+    _current: "Optional[DataContext]" = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = cls()
+        return cls._current

@@ -331,14 +331,18 @@ class Dataset:
                                          descending=descending))
 
     def union(self, other: "Dataset") -> "Dataset":
-        left = self.materialize()
-        right = other.materialize()
-        return Dataset(left._block_refs + right._block_refs)
+        """Lazy concatenation: both branches keep their own logical
+        plans and stream at consumption time — nothing materializes
+        (parity: the reference keeps Union in the logical plan,
+        ``data/_internal/logical/operators/n_ary_operator.py``)."""
+        return _UnionDataset([self, other])
 
     def limit(self, n: int) -> "Dataset":
-        rows = self.take(n)
-        from ray_tpu.data import from_items
-        return from_items(rows)
+        """Lazy prefix: consumption stops pulling upstream blocks once
+        ``n`` rows are out — a limit over an expensive pipeline never
+        runs the whole thing (parity: lazy Limit in the logical plan,
+        ``one_to_one_operator.py``)."""
+        return _LimitDataset(self, n)
 
     def zip(self, other: "Dataset") -> "Dataset":
         import pyarrow as pa
@@ -698,3 +702,118 @@ class GroupedData:
             res = fn(format_batch(group, batch_format))
             blocks.append(ray_tpu.put(batch_to_block(res)))
         return Dataset(blocks)
+
+
+# ------------------------------------------------------ lazy set ops
+class _StreamSourceDataset(Dataset):
+    """A plan whose *input blocks* are another dataset's output stream.
+
+    Used where an op needs the full logical row set of a composite
+    source (all-to-all after a union, transforms after a limit): the
+    source still streams block-by-block, but this plan's operators see
+    one unified input list, preserving global semantics."""
+
+    def __init__(self, source: Dataset, ops: Optional[List[_Op]] = None):
+        super().__init__([], ops)
+        self._source = source
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        return _StreamSourceDataset(self._source, self._ops + [op])
+
+    def _execute(self, window: int = DEFAULT_WINDOW
+                 ) -> Iterator[ObjectRef]:
+        from ray_tpu.data.streaming_executor import StreamingExecutor
+        refs = list(self._source._execute(window))
+        executor = StreamingExecutor(self._build_operators(window))
+        yield from executor.execute(refs)
+
+    def num_blocks(self) -> int:
+        return self._source.num_blocks()
+
+    def __repr__(self):
+        return f"StreamSourceDataset(source={self._source!r})"
+
+
+class _UnionDataset(Dataset):
+    """Streaming union: each branch executes its own plan; the merged
+    stream is their concatenation.  Further transforms push down into
+    every branch, so laziness survives chaining."""
+
+    def __init__(self, parts: List[Dataset]):
+        super().__init__([])
+        # flatten nested unions so deep chains stay one level
+        flat: List[Dataset] = []
+        for p in parts:
+            if isinstance(p, _UnionDataset):
+                flat.extend(p._parts)
+            else:
+                flat.append(p)
+        self._parts = flat
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        if isinstance(op, _MapOp):
+            # stateless per-block ops distribute over the branches
+            return _UnionDataset([p._with_op(op) for p in self._parts])
+        # all-to-all ops (sort/shuffle/repartition) need the *global*
+        # row set, and a class-UDF actor pool must be built once over
+        # the merged stream (per-branch pools would double the actors
+        # and the model-load cost): feed the union's stream in as one
+        # input
+        return _StreamSourceDataset(self, [op])
+
+    def _execute(self, window: int = DEFAULT_WINDOW
+                 ) -> Iterator[ObjectRef]:
+        for p in self._parts:
+            yield from p._execute(window)
+
+    def num_blocks(self) -> int:
+        return sum(p.num_blocks() for p in self._parts)
+
+    def __repr__(self):
+        return f"UnionDataset(parts={len(self._parts)})"
+
+
+@ray_tpu.remote(max_retries=3)
+def _head_block(block: Block, n: int) -> Block:
+    return BlockAccessor.for_block(block).take_rows(np.arange(n))
+
+
+class _LimitDataset(Dataset):
+    """Streaming limit: pulls upstream blocks only until ``n`` rows are
+    satisfied (abandoning the executor's generator stops all further
+    launches), trimming the final block remotely."""
+
+    def __init__(self, parent: Dataset, n: int):
+        super().__init__([])
+        self._parent = parent
+        self._n = n
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        # transforms after a limit operate on the n-row prefix; keep
+        # them lazy — the limit runs when the chained plan is consumed
+        return _StreamSourceDataset(self, [op])
+
+    def num_blocks(self) -> int:
+        # upper bound: the prefix never spans more blocks than the
+        # parent has (exact count is only known at consumption)
+        return self._parent.num_blocks()
+
+    def _execute(self, window: int = DEFAULT_WINDOW
+                 ) -> Iterator[ObjectRef]:
+        remaining = self._n
+        if remaining <= 0:
+            return
+        for ref in self._parent._execute(window):
+            block = ray_tpu.get(ref, timeout=600)
+            rows = BlockAccessor.for_block(block).num_rows()
+            if rows <= remaining:
+                remaining -= rows
+                yield ref
+            else:
+                yield _head_block.remote(ref, remaining)
+                remaining = 0
+            if remaining <= 0:
+                return
+
+    def __repr__(self):
+        return f"LimitDataset(n={self._n})"

@@ -32,12 +32,37 @@ from ray_tpu.object_ref import ObjectRef
 DEFAULT_OP_BUDGET = 8
 
 
+def _ref_size(ref: ObjectRef) -> int:
+    """Committed size of a block ref (0 for inline/unknown)."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        loc = global_worker().cp.get_locations(
+            [ref.binary()]).get(ref.binary())
+        return int(loc.get("size", 0)) if loc else 0
+    except Exception:  # noqa: BLE001 — sizing is best-effort
+        return 0
+
+
 class PhysicalOperator:
-    """Base: bounded in-flight tasks + in-order output release."""
+    """Base: bounded in-flight tasks + in-order output release.
+
+    Two backpressure axes (reference:
+    ``data/_internal/execution/backpressure_policy/`` +
+    ``resource_manager.py`` per-op budgets):
+    - task count: at most ``budget`` concurrent tasks;
+    - memory: with ``DataContext.op_bytes_budget`` set, launches pause
+      while the operator's OUTSTANDING bytes (completed-but-unreleased
+      buffer + estimated in-flight outputs) exceed the cap — a fat map
+      stage can't balloon the object store however fast upstream feeds
+      it.  One launch is always allowed when nothing is outstanding, so
+      a block bigger than the budget still makes progress.
+    """
 
     def __init__(self, name: str, budget: int = DEFAULT_OP_BUDGET):
+        from ray_tpu.data.context import DataContext
         self.name = name
         self.budget = budget
+        self.bytes_budget = DataContext.get_current().op_bytes_budget
         self.inqueue: deque = deque()           # (seq, ref) from upstream
         self.inflight: Dict[bytes, Tuple[int, ObjectRef]] = {}
         self._completed: Dict[int, ObjectRef] = {}
@@ -45,6 +70,11 @@ class PhysicalOperator:
         self._next_out = 0                       # next seq to release
         self.input_done = False
         self.max_observed_inflight = 0
+        self._out_sizes: Dict[int, int] = {}
+        self._buffered_bytes = 0
+        self._avg_out_bytes = 0.0
+        self._n_sized = 0
+        self.max_outstanding_bytes = 0
 
     # -- upstream side -------------------------------------------------
     def add_input(self, ref: ObjectRef) -> None:
@@ -55,8 +85,22 @@ class PhysicalOperator:
         self.input_done = True
 
     # -- scheduling ----------------------------------------------------
+    def outstanding_bytes(self) -> int:
+        return int(self._buffered_bytes
+                   + len(self.inflight) * self._avg_out_bytes)
+
     def can_launch(self) -> bool:
-        return bool(self.inqueue) and len(self.inflight) < self.budget
+        if not self.inqueue or len(self.inflight) >= self.budget:
+            return False
+        if self.bytes_budget is not None and \
+                (self.inflight or self._completed):
+            if self._n_sized == 0:
+                # no output-size estimate yet: probe with ONE task
+                # instead of blind-launching the whole task budget
+                return False
+            if self.outstanding_bytes() >= self.bytes_budget:
+                return False
+        return True
 
     def launch_one(self) -> Optional[ObjectRef]:
         """Submit the next queued block; returns the task ref to track."""
@@ -73,12 +117,22 @@ class PhysicalOperator:
     def on_done(self, ref: ObjectRef) -> None:
         seq, out = self.inflight.pop(ref.binary())
         self._completed[seq] = out
+        if self.bytes_budget is not None:
+            size = _ref_size(out)
+            self._out_sizes[seq] = size
+            self._buffered_bytes += size
+            self._n_sized += 1
+            self._avg_out_bytes += (size - self._avg_out_bytes) \
+                / self._n_sized
+            self.max_outstanding_bytes = max(self.max_outstanding_bytes,
+                                             self.outstanding_bytes())
 
     def release_ready(self) -> List[ObjectRef]:
         """Outputs whose predecessors have all been released (in order)."""
         out = []
         while self._next_out in self._completed:
             out.append(self._completed.pop(self._next_out))
+            self._buffered_bytes -= self._out_sizes.pop(self._next_out, 0)
             self._next_out += 1
         return out
 

@@ -402,3 +402,109 @@ def test_streaming_split_equal_splits_leftover_blocks(ray_start_regular):
     a, b = ray_tpu.get([drain.remote(i) for i in its], timeout=120)
     assert sorted(a + b) == list(range(50))
     assert abs(len(a) - len(b)) <= 1
+
+
+def test_union_streams_lazily(ray_start_regular):
+    """union() must not materialize its branches: a side-effecting map
+    over each branch only runs as the union stream is consumed."""
+    import ray_tpu.data as rdata
+
+    a = rdata.from_items([{"x": i} for i in range(20)])
+    b = rdata.from_items([{"x": i + 100} for i in range(20)])
+
+    def bump(row):
+        return {"x": row["x"] + 1}
+
+    u = a.map(bump).union(b.map(bump))
+    # building the union ran nothing (no block refs were produced)
+    assert u.num_blocks() == a.num_blocks() + b.num_blocks()
+    first = u.take(3)
+    assert [r["x"] for r in first] == [1, 2, 3]
+    total = u.count()
+    assert total == 40
+    vals = sorted(r["x"] for r in u.take_all())
+    assert vals[:3] == [1, 2, 3] and vals[-1] == 120
+    # further ops push down into both branches lazily
+    doubled = u.map(lambda r: {"x": r["x"] * 2})
+    assert sorted(r["x"] for r in doubled.take_all())[0] == 2
+
+
+def test_limit_stops_upstream_execution(ray_start_regular):
+    """limit(n) consumes only the prefix of the stream: upstream map
+    tasks for blocks past the limit never run."""
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.data as rdata
+
+    counter = ray_tpu.put(0)  # marker object id namespace
+
+    @ray_tpu.remote
+    class Touch:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+
+        def count(self):
+            return self.n
+
+    touch = Touch.options(name="limit_probe").remote()
+    ray_tpu.get(touch.bump.remote())  # ensure alive
+    ray_tpu.get(touch.count.remote())
+
+    def spy(batch):
+        import ray_tpu as rt
+        a = rt.get_actor("limit_probe")
+        a.bump.remote()
+        return batch
+
+    # 16 blocks x 10 rows; limit 25 rows needs only 3 blocks
+    ds = rdata.from_items([{"x": i} for i in range(160)],
+                          override_num_blocks=16).map_batches(spy)
+    rows = ds.limit(25).take_all()
+    assert len(rows) == 25
+    import time
+    time.sleep(0.5)
+    touched = ray_tpu.get(touch.count.remote()) - 1
+    assert touched < 16, f"limit ran {touched}/16 upstream blocks"
+
+
+def test_op_bytes_budget_backpressure(ray_start_regular):
+    """With DataContext.op_bytes_budget set, a fat map stage's
+    outstanding bytes stay under the cap while the pipeline streams."""
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.data as rdata
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.streaming_executor import StreamingExecutor
+
+    ctx = DataContext.get_current()
+    old = ctx.op_bytes_budget
+    ctx.op_bytes_budget = 2 * 1024 * 1024
+    try:
+        # 12 blocks, each mapping to ~0.8 MB output
+        ds = rdata.from_items(
+            [{"i": i} for i in range(12)], override_num_blocks=12)
+
+        def fatten(batch):
+            n = len(batch["i"])
+            return {"i": batch["i"],
+                    "blob": np.zeros((n, 200_000), np.float32)}
+
+        ds2 = ds.map_batches(fatten)
+        ops = ds2._build_operators(8)
+        executor = StreamingExecutor(ops)
+        consumed = 0
+        for ref in executor.execute(list(ds2._block_refs)):
+            ray_tpu.get(ref, timeout=120)
+            consumed += 1
+        assert consumed == 12
+        fat_op = ops[0]
+        assert fat_op.max_outstanding_bytes <= ctx.op_bytes_budget \
+            + 900_000, fat_op.max_outstanding_bytes
+        assert fat_op.max_outstanding_bytes > 0
+    finally:
+        ctx.op_bytes_budget = old
