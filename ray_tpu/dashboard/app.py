@@ -138,6 +138,103 @@ class Dashboard:
             return json_response(
                 await loop.run_in_executor(None, _serve_apps_blocking))
 
+        # ---- per-entity drill-down + logs (reference:
+        # dashboard/modules/{actor,node,log}) ----
+        def _nm_client(node_hex: str):
+            from ray_tpu._private.protocol import RpcClient
+            from ray_tpu._private.worker import global_worker
+            info = global_worker().cp.get_node(bytes.fromhex(node_hex))
+            if info is None:
+                return None
+            return RpcClient(info["sock_path"])
+
+        def _actor_detail_blocking(actor_hex: str):
+            from ray_tpu._private.worker import global_worker
+            info = global_worker().cp.get_actor_info(
+                bytes.fromhex(actor_hex))
+            if info is None:
+                return None
+            out = {k: (v.hex() if isinstance(v, bytes) else v)
+                   for k, v in info.items()
+                   if isinstance(v, (str, int, float, bool, bytes,
+                                     type(None)))}
+            out["actor_id"] = actor_hex
+            return out
+
+        def _node_detail_blocking(node_hex: str):
+            from ray_tpu._private.worker import global_worker
+            info = global_worker().cp.get_node(bytes.fromhex(node_hex))
+            if info is None:
+                return None
+            out = dict(info)
+            out["node_id"] = node_hex
+            client = _nm_client(node_hex)
+            if client is not None:
+                try:
+                    out["debug_state"] = client.call("debug_state")
+                except Exception:  # noqa: BLE001
+                    pass
+            return {k: v for k, v in out.items()
+                    if not isinstance(v, bytes)}
+
+        async def actor_detail(request):
+            loop = asyncio.get_running_loop()
+            data = await loop.run_in_executor(
+                None, _actor_detail_blocking,
+                request.match_info["actor_id"])
+            if data is None:
+                return web.json_response({"error": "not found"},
+                                         status=404)
+            return json_response(data)
+
+        async def node_detail(request):
+            loop = asyncio.get_running_loop()
+            data = await loop.run_in_executor(
+                None, _node_detail_blocking,
+                request.match_info["node_id"])
+            if data is None:
+                return web.json_response({"error": "not found"},
+                                         status=404)
+            return json_response(data)
+
+        async def logs_list(request):
+            node = request.query.get("node_id")
+            if not node:
+                return web.json_response({"error": "node_id required"},
+                                         status=400)
+            loop = asyncio.get_running_loop()
+
+            def blocking():
+                client = _nm_client(node)
+                return client.call("list_logs") if client else None
+
+            data = await loop.run_in_executor(None, blocking)
+            if data is None:
+                return web.json_response({"error": "node not found"},
+                                         status=404)
+            return json_response(data)
+
+        async def logs_tail(request):
+            node = request.query.get("node_id")
+            name = request.query.get("name")
+            n = int(request.query.get("nbytes", 65536))
+            if not node or not name:
+                return web.json_response(
+                    {"error": "node_id and name required"}, status=400)
+            loop = asyncio.get_running_loop()
+
+            def blocking():
+                client = _nm_client(node)
+                return client.call("tail_log", name, n) if client \
+                    else None
+
+            data = await loop.run_in_executor(None, blocking)
+            if data is None:
+                return web.json_response({"error": "node not found"},
+                                         status=404)
+            return web.Response(text=data.decode("utf-8", "replace"),
+                                content_type="text/plain")
+
         app = web.Application()
         app.router.add_get("/", index)
         app.router.add_get("/api/nodes", nodes)
@@ -149,6 +246,10 @@ class Dashboard:
         app.router.add_get("/api/timeline", timeline)
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/serve", serve_apps)
+        app.router.add_get("/api/actors/{actor_id}", actor_detail)
+        app.router.add_get("/api/nodes/{node_id}", node_detail)
+        app.router.add_get("/api/logs", logs_list)
+        app.router.add_get("/api/logs/tail", logs_tail)
         app.router.add_get("/metrics", metrics)
         runner = web.AppRunner(app)
         await runner.setup()

@@ -1,0 +1,102 @@
+"""Tracing hooks (parity: ``python/ray/util/tracing/tracing_helper.py``).
+
+The reference patches every remote call with OpenTelemetry spans when
+``ray.init(_tracing_startup_hook=...)`` is set.  Here tracing is a
+light seam over the same points: if ``opentelemetry`` is importable the
+spans are real OTel spans (exported by whatever provider the user
+configured); otherwise an in-process recorder keeps (name, start, end,
+attributes) tuples so tests and the timeline can still observe the
+graph.  Zero overhead when never enabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = False
+_tracer = None          # otel tracer when available
+_records: List[Dict[str, Any]] = []   # fallback recorder
+_MAX_RECORDS = 10_000
+
+
+def enable_tracing() -> bool:
+    """Turn on span emission; True if real OpenTelemetry is active."""
+    global _enabled, _tracer
+    with _lock:
+        _enabled = True
+        if _tracer is None:
+            try:
+                from opentelemetry import trace as otel_trace
+                _tracer = otel_trace.get_tracer("ray_tpu")
+            except Exception:  # noqa: BLE001 — recorder fallback
+                _tracer = None
+        return _tracer is not None
+
+
+def disable_tracing() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def recorded_spans() -> List[Dict[str, Any]]:
+    """Fallback-recorder contents (OTel-less environments/tests)."""
+    with _lock:
+        return list(_records)
+
+
+def clear_recorded() -> None:
+    with _lock:
+        _records.clear()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Trace one operation.  No-op (two attr reads) when disabled."""
+    if not _enabled:
+        yield None
+        return
+    if _tracer is not None:
+        with _tracer.start_as_current_span(name) as s:
+            for k, v in attributes.items():
+                try:
+                    s.set_attribute(k, v)
+                except Exception:  # noqa: BLE001
+                    pass
+            yield s
+        return
+    rec = {"name": name, "start": time.time(), "attributes": attributes}
+    try:
+        yield rec
+    finally:
+        rec["end"] = time.time()
+        with _lock:
+            _records.append(rec)
+            if len(_records) > _MAX_RECORDS:
+                del _records[:len(_records) - _MAX_RECORDS]
+
+
+def task_span(spec) -> "contextlib.AbstractContextManager":
+    """Span for one task/actor-method execution (worker side)."""
+    if not _enabled:
+        return contextlib.nullcontext()
+    return span(
+        f"task::{getattr(spec, 'name', '?')}",
+        task_id=getattr(spec, 'task_id', b'').hex()[:16],
+        actor_method=getattr(spec, 'actor_method', None) or "",
+    )
+
+
+def submit_span(name: str) -> "contextlib.AbstractContextManager":
+    """Span for a submission on the caller side."""
+    if not _enabled:
+        return contextlib.nullcontext()
+    return span(f"submit::{name}")

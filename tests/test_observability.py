@@ -86,6 +86,37 @@ def test_dashboard_api(ray_start_regular):
     metrics = requests.get(
         f"http://127.0.0.1:{port}/metrics", timeout=10)
     assert metrics.status_code == 200
+    # per-entity drill-down + log panes (dashboard/modules parity)
+    node_id = nodes[0]["node_id"]
+    detail = requests.get(
+        f"http://127.0.0.1:{port}/api/nodes/{node_id}",
+        timeout=10).json()
+    assert detail["node_id"] == node_id
+    assert "debug_state" in detail
+    logs = requests.get(
+        f"http://127.0.0.1:{port}/api/logs?node_id={node_id}",
+        timeout=10).json()
+    assert isinstance(logs, list)
+    if logs:
+        tail = requests.get(
+            f"http://127.0.0.1:{port}/api/logs/tail?"
+            f"node_id={node_id}&name={logs[0]['name']}", timeout=10)
+        assert tail.status_code == 200
+
+    @ray_start_regular.remote
+    class Probe:
+        def ping(self):
+            return 1
+
+    a = Probe.remote()
+    ray_start_regular.get(a.ping.remote())
+    actors = requests.get(
+        f"http://127.0.0.1:{port}/api/actors", timeout=10).json()
+    aid = actors[0]["actor_id"]
+    adetail = requests.get(
+        f"http://127.0.0.1:{port}/api/actors/{aid}", timeout=10).json()
+    assert adetail["actor_id"] == aid
+    assert adetail.get("state") == "ALIVE"
 
 
 def test_cli_status_and_list(ray_start_regular):
