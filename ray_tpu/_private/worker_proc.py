@@ -42,6 +42,8 @@ class WorkerProcess:
         self.stream = self.nm_client.hijack(
             "stream_worker", self.worker_id.binary())
         self._send_lock = threading.Lock()
+        from ray_tpu.util.tracing import maybe_enable_from_cluster
+        maybe_enable_from_cluster(self.cp)
         self.core = CoreWorker(
             mode="worker", job_id=JobID.nil(), worker_id=self.worker_id,
             node_id=self.node_id, control_plane=self.cp,
@@ -82,7 +84,14 @@ class WorkerProcess:
                 msg = protocol.recv_msg(self.stream)
             except (protocol.ConnectionClosed, ConnectionResetError,
                     OSError, EOFError):
-                return
+                # NM channel dropped without an "exit" handshake: the
+                # node manager died.  Exit NOW — a lingering actor
+                # worker keeps answering cached direct-channel calls,
+                # split-braining with the incarnation the health loop
+                # restarts elsewhere.
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(1)
             kind = msg.get("type")
             if kind == "exit":
                 self._send({"type": "exit"})
@@ -175,6 +184,7 @@ class WorkerProcess:
 
     # ------------------------------------------------------------------
     def _execute_task(self, spec: TaskSpec, chips):
+        from ray_tpu.util.tracing import task_span
         self.core.current_task_id = spec.task_id
         error = False
         error_payload = None
@@ -183,7 +193,7 @@ class WorkerProcess:
             self._set_visible_chips(chips)
             fn = self.core.load_function(spec.function_key)
             args, kwargs = self._resolve_args(spec)
-            with _renv.applied(spec.runtime_env):
+            with _renv.applied(spec.runtime_env), task_span(spec):
                 if inspect.iscoroutinefunction(fn):
                     result = asyncio.run(fn(*args, **kwargs))
                 else:
@@ -409,11 +419,13 @@ class WorkerProcess:
             self._direct_server = None
 
     def _run_actor_task(self, spec: TaskSpec, notify_nm: bool = True):
+        from ray_tpu.util.tracing import task_span
         self.core.current_task_id = spec.task_id
         try:
             method = self._lookup_method(spec)
             args, kwargs = self._resolve_args(spec)
-            result = method(*args, **kwargs)
+            with task_span(spec):
+                result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
             self._commit_results(spec, result)

@@ -57,9 +57,20 @@ def test_restartable_actor_moves_off_dead_node(cluster_fast_health):
     c = Counter.remote()
     assert ray.get(c.node.remote(), timeout=60) == node_b.hex()
     _sigkill_node(node, node_b)
-    # health loop declares the node dead, head reschedules the actor
-    new_node = ray.get(c.node.remote(), timeout=60)
-    assert new_node != node_b.hex()
+    # Health loop declares the node dead, head reschedules the actor.
+    # A call racing the kill may still reach the original worker over
+    # the direct channel (only the NM died; the worker fences itself on
+    # the channel EOF moments later) — poll until the relocated
+    # incarnation answers.  If the orphan were never fenced, the cached
+    # direct socket would answer node_b forever and this times out.
+    deadline = time.time() + 60
+    while True:
+        new_node = ray.get(c.node.remote(), timeout=60)
+        if new_node != node_b.hex():
+            break
+        assert time.time() < deadline, \
+            "actor never moved off the dead node"
+        time.sleep(0.5)
     assert ray.get(c.bump.remote(), timeout=30) == 1   # fresh state
 
 
