@@ -196,7 +196,20 @@ def param_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     return axes
 
 
+# env-gated alternate paths for per-shape A/B (both step-neutral or
+# slightly negative at the v5e GPT-2 bench shape — XLA's scheduler
+# already overlaps the traffic they remove — but they cut resident/
+# streamed bytes, which matters in memory-bound regimes):
+#   PALLAS_NORM — fused rmsnorm fwd/bwd kernel (ops/rmsnorm.py)
+#   FUSED_CE — bf16-resident logits via ops/fused_ce.py custom vjp
+_PALLAS_NORM = os.environ.get("RAY_TPU_PALLAS_NORM", "0") == "1"
+_FUSED_CE = os.environ.get("RAY_TPU_FUSED_CE", "0") == "1"
+
+
 def _norm(x, scale, kind: str, bias=None, eps: float = 1e-6):
+    if kind == "rmsnorm" and bias is None and _PALLAS_NORM:
+        from ray_tpu.ops.rmsnorm import rmsnorm
+        return rmsnorm(x, scale, eps)
     x32 = x.astype(jnp.float32)
     if kind == "rmsnorm":
         x32 = x32 * lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
@@ -272,6 +285,9 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     constrain = functools.partial(shd.constrain, mesh=mesh)
     eps = 1e-5 if cfg.use_bias else 1e-6  # HF GPT-2 uses eps=1e-5
     h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
+    # (a fused [d, 3Hk] qkv projection was A/B'd on the v5e bench and
+    # lost ~5%: the runtime weight concat serializes against the
+    # matmul and XLA already pipelines the three projections)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
@@ -408,6 +424,9 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
     """
     N, d = x.shape
     remat = chunk >= 0
+    if not remat and _FUSED_CE:
+        from ray_tpu.ops.fused_ce import ce_sum_bf16
+        return ce_sum_bf16(x, head.astype(x.dtype), targets)
     if chunk <= 0:
         chunk = N
 

@@ -1,0 +1,89 @@
+"""Cross-entropy over a large vocab with bf16 logit residuals.
+
+The no-remat CE keeps the [N, V] logits alive between forward and
+backward — at GPT-2 bench shape that is a 4.9 GB f32 tensor whose
+write + three reduce passes + backward read run at HBM rate and
+dominate the loss block (~25 ms/step).  autodiff *should* be able to
+keep the residual in bf16, but XLA materializes the f32 matmul output
+when both the lse reduce and the saved residual consume it (measured:
+the astype(bf16) round-trip variant is net slower).
+
+This custom_vjp forces the split the hardware wants:
+
+- forward: logits = (x @ head) -> bf16 in the matmul epilogue (f32
+  accumulation, no f32 materialization); lse/true-logit reduces read
+  the bf16 tensor; exactly that bf16 tensor is saved.
+- backward: p = exp(logits - lse) recomputed from bf16 in one fused
+  pass; dlogits stays bf16 into the two grad matmuls.
+
+Halves the resident bytes and every pass over them.  The bf16 rounding
+of saved logits perturbs gradients well below batch noise (logits are
+O(10); bf16 eps ~ 0.008 relative; softmax differences cancel in
+p - onehot).  Numerics guard: lse and the loss accumulate in f32.
+
+Measured on the GPT-2 v5e bench (env RAY_TPU_FUSED_CE=1): ~-1.5%
+step time — the f32 passes it removes were already overlapped with
+MXU work by XLA's scheduler at that shape, and the custom_vjp
+boundary costs some fusion freedom.  Kept for memory-bound regimes
+(the resident-logits footprint halves: 2.5 GB vs 4.9 GB at bench
+shape, which is what unlocks larger batches); default off.
+
+Reference role: the loss path of the reference's torch trainers
+(F.cross_entropy); the residual-dtype design is TPU-first.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def ce_sum_bf16(x, head, targets):
+    """x [N, d] bf16, head [d, V], targets [N] int32 (-1 = masked).
+
+    Returns (sum_nll, n_valid) with bf16 logit residuals."""
+    out, _ = _ce_fwd(x, head, targets)
+    return out
+
+
+def _logits_bf16(x, head):
+    return jax.lax.dot_general(
+        x, head, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
+def _ce_fwd(x, head, targets):
+    logits = _logits_bf16(x, head)                       # [N, V] bf16
+    l32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(l32, axis=-1)      # [N] f32
+    true = jnp.take_along_axis(
+        l32, jnp.maximum(targets, 0)[:, None], axis=-1)[:, 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    out = (jnp.sum((lse - true) * mask), jnp.sum(mask))
+    return out, (x, head, targets, logits, lse)
+
+
+def _ce_bwd(res, g):
+    x, head, targets, logits, lse = res
+    gs, _ = g                                  # d/d(sum_nll); n is count
+    n = logits.shape[0]
+    mask = (targets >= 0)
+    # p - onehot, scaled by the incoming cotangent; one fused pass over
+    # the bf16 logits, dlogits written bf16 straight into the matmuls
+    p = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    onehot = jax.nn.one_hot(jnp.maximum(targets, 0), logits.shape[1],
+                            dtype=jnp.float32)
+    dl = ((p - onehot) * (gs * mask[:, None])).astype(jnp.bfloat16)
+    dx = jax.lax.dot_general(
+        dl, head, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    dh = jax.lax.dot_general(
+        x, dl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(head.dtype)
+    return dx, dh, None
+
+
+ce_sum_bf16.defvjp(_ce_fwd, _ce_bwd)

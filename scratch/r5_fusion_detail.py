@@ -1,0 +1,66 @@
+"""Capture a step trace and aggregate XLA ops by (kind, shape-ish name
+stem) so 12-layer repeats group; print every group >0.5 ms/step."""
+import glob
+import re
+import shutil
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import training
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.mesh import make_mesh
+
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+B, S = 24, 1024
+mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+fns = training.build_gpt_train(cfg, mesh)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), B, S,
+                                    cfg.vocab_size)
+for _ in range(2):
+    state, m = fns["step_fn"](state, batch)
+    float(m["loss"])
+
+shutil.rmtree("/tmp/jaxtrace", ignore_errors=True)
+with jax.profiler.trace("/tmp/jaxtrace"):
+    for _ in range(3):
+        state, m = fns["step_fn"](state, batch)
+    float(m["loss"])
+time.sleep(1)
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E402
+
+xplane = sorted(glob.glob("/tmp/jaxtrace/**/*.xplane.pb",
+                          recursive=True))[-1]
+xs = xplane_pb2.XSpace()
+xs.ParseFromString(open(xplane, "rb").read())
+
+for plane in xs.planes:
+    if plane.name != "/device:TPU:0":
+        continue
+    meta = plane.event_metadata
+    line = max(plane.lines, key=lambda l: len(l.events))
+    groups = defaultdict(lambda: [0.0, 0])
+    for ev in line.events:
+        m = meta.get(ev.metadata_id)
+        name = m.name if m else "?"
+        # strip the %op.NNN counter so layer-repeated instances group,
+        # keep the output shape as the signature
+        stem = re.sub(r"\.\d+", "", name.split(" = ")[0])
+        shape = ""
+        mm = re.search(r"= \(?([a-z0-9]+\[[0-9,]*\])", name)
+        if mm:
+            shape = mm.group(1)
+        key = f"{stem} {shape}"
+        groups[key][0] += ev.duration_ps
+        groups[key][1] += 1
+    print("ms/step  count/step  op")
+    for k, (dur, cnt) in sorted(groups.items(), key=lambda kv: -kv[1][0]):
+        ms = dur / 3e9
+        if ms < 0.5:
+            continue
+        print(f"{ms:7.2f}  {cnt/3:6.1f}   {k[:110]}")

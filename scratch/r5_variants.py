@@ -1,0 +1,71 @@
+"""Sweep train-step variants on the real chip (one variant per run).
+
+Usage: python scratch/r5_variants.py <variant>
+Variants set env knobs BEFORE importing the model code, then time the
+full jitted train step at the bench shape.
+"""
+import os
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+# env knobs must land before ray_tpu imports read them
+if VARIANT == "exp2":
+    os.environ["RAY_TPU_ATTN_EXP2"] = "1"
+elif VARIANT == "ce_bf16":
+    os.environ["RAY_TPU_CE_BF16_RESID"] = "1"
+elif VARIANT == "bwd1024":
+    os.environ["RAY_TPU_ATTN_BWD_BQ"] = "1024"
+    os.environ["RAY_TPU_ATTN_BWD_BK"] = "1024"
+elif VARIANT == "exp2_ce":
+    os.environ["RAY_TPU_ATTN_EXP2"] = "1"
+    os.environ["RAY_TPU_CE_BF16_RESID"] = "1"
+elif VARIANT == "pnorm":
+    os.environ["RAY_TPU_PALLAS_NORM"] = "1"
+elif VARIANT == "fqkv":
+    os.environ["RAY_TPU_FUSED_QKV"] = "1"
+elif VARIANT == "fce":
+    os.environ["RAY_TPU_FUSED_CE"] = "1"
+elif VARIANT == "all3":
+    os.environ["RAY_TPU_PALLAS_NORM"] = "1"
+    os.environ["RAY_TPU_FUSED_QKV"] = "1"
+    os.environ["RAY_TPU_FUSED_CE"] = "1"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+batch, seq, steps = 24, 1024, 30
+kw = dict(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+          remat=False, unroll_layers=True, ce_chunk=-1)
+if VARIANT == "b32_chunk":
+    batch = 32
+    kw["ce_chunk"] = 8192
+elif VARIANT == "b32_nochunk":
+    batch = 32
+elif VARIANT == "b16":
+    batch = 16
+elif VARIANT == "ce8192":
+    kw["ce_chunk"] = 8192
+
+cfg = GPTConfig.gpt2(**kw)
+mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+fns = training.build_gpt_train(cfg, mesh)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for _ in range(2):
+    state, m = fns["step_fn"](state, bd)
+    float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(steps):
+    state, m = fns["step_fn"](state, bd)
+loss = float(m["loss"])
+dt = (time.perf_counter() - t0) / steps
+tok = batch * seq / dt
+print(f"{VARIANT}: {dt*1e3:7.1f} ms/step  {tok:,.0f} tok/s  "
+      f"(vs_baseline {tok/255000:.3f})  loss {loss:.3f}", flush=True)
