@@ -563,7 +563,9 @@ class CoreWorker:
             runtime_env=opts.get("runtime_env") or {},
             parent_task_id=self.current_task_id,
         )
-        self.nm.submit_task(spec)
+        from ray_tpu.util.tracing import submit_span
+        with submit_span(spec.name):
+            self.nm.submit_task(spec)
         if streaming:
             return ObjectRefGenerator(task_id.binary())
         refs = [ObjectRef(o, self.nm_addr or None)

@@ -1,20 +1,70 @@
 """State API (parity: ``python/ray/util/state``): programmatic listing of
-cluster entities, backed by the control plane tables."""
+cluster entities, backed by the control plane tables.
+
+Every ``list_*`` takes ``filters`` — ``(key, op, value)`` triples with
+the reference's predicate set (``= != < <= > >= contains in``,
+``util/state/common.py`` role) — and ``offset`` for pagination; rows
+come back in stable order so ``offset``/``limit`` windows stitch.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.worker import global_worker
+
+Filter = Tuple[str, str, Any]
 
 
 def _cp():
     return global_worker().cp
 
 
-def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+def _match(row: Dict[str, Any], key: str, op: str, value: Any) -> bool:
+    have = row.get(key)
+    if op in ("=", "=="):
+        return str(have) == str(value)
+    if op == "!=":
+        return str(have) != str(value)
+    if op == "contains":
+        return str(value) in str(have)
+    if op == "in":
+        if isinstance(value, (str, bytes)):
+            # a bare string would be iterated per-character and match
+            # nothing, silently — make the misuse loud
+            raise TypeError(
+                "'in' filter value must be a list/tuple/set of "
+                f"candidates, got {type(value).__name__}")
+        return str(have) in [str(v) for v in value]
+    # ordered comparisons: numeric when both sides parse, else lexical
+    try:
+        a, b = float(have), float(value)  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        a, b = str(have), str(value)      # type: ignore[assignment]
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ValueError(f"unsupported filter op {op!r}")
+
+
+def _window(rows: List[Dict[str, Any]],
+            filters: Optional[List[Filter]], limit: int,
+            offset: int) -> List[Dict[str, Any]]:
+    if filters:
+        for key, op, value in filters:
+            rows = [r for r in rows if _match(r, key, op, value)]
+    return rows[offset:offset + limit]
+
+
+def list_nodes(limit: int = 1000, filters: Optional[List[Filter]] = None,
+               offset: int = 0) -> List[Dict[str, Any]]:
     out = []
-    for info in _cp().list_nodes()[:limit]:
+    for info in _cp().list_nodes():
         out.append({
             "node_id": info["node_id"].hex(),
             "state": info["state"],
@@ -25,13 +75,14 @@ def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
             "load": info.get("load", {}),
             "death_reason": info.get("death_reason", ""),
         })
-    return out
+    out.sort(key=lambda r: r["node_id"])
+    return _window(out, filters, limit, offset)
 
 
-def list_actors(limit: int = 1000,
-                filters: Optional[List] = None) -> List[Dict[str, Any]]:
+def list_actors(limit: int = 1000, filters: Optional[List[Filter]] = None,
+                offset: int = 0) -> List[Dict[str, Any]]:
     out = []
-    for info in _cp().list_actors()[:limit]:
+    for info in _cp().list_actors():
         row = {
             "actor_id": info["actor_id"].hex(),
             "class_name": info.get("class_name"),
@@ -43,15 +94,14 @@ def list_actors(limit: int = 1000,
             "num_restarts": info.get("num_restarts", 0),
         }
         out.append(row)
-    if filters:
-        for key, op, value in filters:
-            assert op == "=", "only equality filters supported"
-            out = [r for r in out if str(r.get(key)) == str(value)]
-    return out
+    out.sort(key=lambda r: r["actor_id"])
+    return _window(out, filters, limit, offset)
 
 
-def list_tasks(limit: int = 10000) -> List[Dict[str, Any]]:
-    events = _cp().list_task_events(limit=limit)
+def list_tasks(limit: int = 10000,
+               filters: Optional[List[Filter]] = None,
+               offset: int = 0) -> List[Dict[str, Any]]:
+    events = _cp().list_task_events(limit=100000)
     latest: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         tid = ev.get("task_id")
@@ -63,16 +113,23 @@ def list_tasks(limit: int = 10000) -> List[Dict[str, Any]]:
             cur["node_id"] = ev["node"]
         cur.setdefault("events", []).append(
             {"state": ev.get("state"), "time": ev.get("time")})
-    return list(latest.values())[:limit]
+    rows = sorted(latest.values(), key=lambda r: r["task_id"] or "")
+    return _window(rows, filters, limit, offset)
 
 
-def list_objects(limit: int = 10000) -> List[Dict[str, Any]]:
-    return _cp().list_objects()[:limit]
+def list_objects(limit: int = 10000,
+                 filters: Optional[List[Filter]] = None,
+                 offset: int = 0) -> List[Dict[str, Any]]:
+    rows = _cp().list_objects()
+    rows.sort(key=lambda r: str(r.get("object_id", "")))
+    return _window(rows, filters, limit, offset)
 
 
-def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+def list_placement_groups(limit: int = 1000,
+                          filters: Optional[List[Filter]] = None,
+                          offset: int = 0) -> List[Dict[str, Any]]:
     out = []
-    for info in _cp().list_placement_groups()[:limit]:
+    for info in _cp().list_placement_groups():
         out.append({
             "placement_group_id": info["pg_id"].hex(),
             "name": info.get("name", ""),
@@ -80,7 +137,8 @@ def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
             "strategy": info.get("strategy"),
             "bundles": info.get("bundles", []),
         })
-    return out
+    out.sort(key=lambda r: r["placement_group_id"])
+    return _window(out, filters, limit, offset)
 
 
 def summarize_tasks() -> Dict[str, int]:

@@ -24,14 +24,30 @@ _MAX_RECORDS = 10_000
 
 
 def enable_tracing() -> bool:
-    """Turn on span emission; True if real OpenTelemetry is active."""
+    """Turn on span emission; True if real OpenTelemetry is active.
+
+    The flag is process-local, so it is ALSO published to the control
+    plane: worker processes check it at startup (``worker_proc``) and
+    emit execute-side spans.  Workers already running before the enable
+    keep tracing off until restarted (same init-time contract as the
+    reference's ``_tracing_startup_hook``)."""
+    _publish("1")
     global _enabled, _tracer
     with _lock:
         _enabled = True
         if _tracer is None:
             try:
                 from opentelemetry import trace as otel_trace
-                _tracer = otel_trace.get_tracer("ray_tpu")
+
+                # only route spans to OTel when the user actually
+                # configured a provider — the library default
+                # (ProxyTracerProvider with no SDK behind it) swallows
+                # spans silently, which would also starve the
+                # in-process recorder that tests and the timeline read
+                provider = otel_trace.get_tracer_provider()
+                if type(provider).__name__ not in (
+                        "ProxyTracerProvider", "NoOpTracerProvider"):
+                    _tracer = otel_trace.get_tracer("ray_tpu")
             except Exception:  # noqa: BLE001 — recorder fallback
                 _tracer = None
         return _tracer is not None
@@ -39,8 +55,58 @@ def enable_tracing() -> bool:
 
 def disable_tracing() -> None:
     global _enabled
+    _publish("0")
     with _lock:
         _enabled = False
+
+
+_KV_KEY = b"__ray_tpu_tracing__"
+
+
+def _publish(val: str) -> None:
+    """Best-effort cluster-wide flag (no-op outside a ray_tpu session)."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        global_worker().cp.kv_put(_KV_KEY, val.encode(), True, "_sys")
+    except Exception:  # noqa: BLE001 — local-only tracing still works
+        pass
+
+
+_cluster_cp = None
+_cluster_checked = 0.0
+_CLUSTER_TTL_S = 5.0
+
+
+def maybe_enable_from_cluster(cp) -> None:
+    """Worker-startup hook: adopt (and keep polling, via the TTL check
+    in :func:`_refresh`) the cluster-wide tracing flag."""
+    global _cluster_cp
+    _cluster_cp = cp
+    _refresh(force=True)
+
+
+def _refresh(force: bool = False) -> None:
+    """Re-read the cluster flag at most every ``_CLUSTER_TTL_S`` so an
+    ``enable_tracing()`` on the driver reaches already-running workers
+    within seconds (one KV read per worker per TTL — off the hot path
+    unless tracing state actually changes anything)."""
+    global _enabled, _cluster_checked
+    if _cluster_cp is None:
+        return
+    now = time.monotonic()
+    if not force and now - _cluster_checked < _CLUSTER_TTL_S:
+        return
+    _cluster_checked = now
+    try:
+        val = _cluster_cp.kv_get(_KV_KEY, namespace="_sys")
+    except Exception:  # noqa: BLE001
+        return
+    if val == b"1" and not _enabled:
+        with _lock:
+            _enabled = True
+    elif val == b"0" and _enabled:
+        with _lock:
+            _enabled = False
 
 
 def is_enabled() -> bool:
@@ -86,6 +152,7 @@ def span(name: str, **attributes):
 
 def task_span(spec) -> "contextlib.AbstractContextManager":
     """Span for one task/actor-method execution (worker side)."""
+    _refresh()
     if not _enabled:
         return contextlib.nullcontext()
     return span(
