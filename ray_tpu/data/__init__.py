@@ -3,7 +3,9 @@
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.connectors import (from_huggingface, from_torch,
                                      read_sql, read_webdataset)
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.io_extra import range_tensor, read_tfrecords
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
@@ -11,11 +13,12 @@ from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    read_numpy, read_parquet, read_text)
 
 __all__ = [
-    "Block", "BlockAccessor", "Dataset", "DataIterator", "GroupedData",
-    "range",
+    "Block", "BlockAccessor", "DataContext", "Dataset", "DataIterator",
+    "GroupedData",
+    "range", "range_tensor",
     "from_items", "from_numpy", "from_arrow", "from_pandas",
     "from_torch", "from_huggingface",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
-    "read_webdataset", "read_sql",
+    "read_webdataset", "read_sql", "read_tfrecords",
 ]

@@ -628,23 +628,20 @@ class Dataset:
                 Dataset([ray_tpu.put(table.slice(n_train, n_test))]))
 
     # ------------------------------------------------------------ write
+    # Writers fan out one task per block (reference: datasink write
+    # tasks), so a wide dataset writes in parallel instead of pulling
+    # every block through the driver.
     def write_parquet(self, path: str) -> None:
-        import os
-
-        import pyarrow.parquet as pq
-        os.makedirs(path, exist_ok=True)
-        for i, ref in enumerate(self._execute()):
-            block = ray_tpu.get(ref, timeout=600)
-            pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+        from ray_tpu.data.io_extra import write_parquet
+        write_parquet(self, path)
 
     def write_csv(self, path: str) -> None:
-        import os
+        from ray_tpu.data.io_extra import write_csv
+        write_csv(self, path)
 
-        import pyarrow.csv as pacsv
-        os.makedirs(path, exist_ok=True)
-        for i, ref in enumerate(self._execute()):
-            block = ray_tpu.get(ref, timeout=600)
-            pacsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+    def write_tfrecords(self, path: str) -> None:
+        from ray_tpu.data.io_extra import write_tfrecords
+        write_tfrecords(self, path)
 
     def write_json(self, path: str) -> None:
         from ray_tpu.data.connectors import write_json

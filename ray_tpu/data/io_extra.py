@@ -20,19 +20,6 @@ from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.dataset import Dataset
 
 
-def range(n: int, *, override_num_blocks: Optional[int] = None  # noqa: A001
-          ) -> Dataset:
-    """Integers [0, n) as rows ``{"id": i}`` (parity: ``ray.data.range``)."""
-    import pyarrow as pa
-    blocks = override_num_blocks or min(max(1, n // 50_000), 32)
-    parts = np.array_split(np.arange(n, dtype=np.int64), blocks)
-    refs = [ray_tpu.put(pa.table({"id": pa.array(p)}))
-            for p in parts if len(p)]
-    if not refs:
-        refs = [ray_tpu.put(pa.table({"id": pa.array([], pa.int64())}))]
-    return Dataset(refs)
-
-
 def range_tensor(n: int, *, shape=(1,),
                  override_num_blocks: Optional[int] = None) -> Dataset:
     """Rows ``{"data": ndarray(shape)}`` with arange values (parity:

@@ -245,3 +245,70 @@ def test_joblib_backend(ray_start_regular):
         with joblib.parallel_backend("ray_tpu"):
             joblib.Parallel(n_jobs=2)(
                 joblib.delayed(boom)(i) for i in range(2))
+
+
+def test_workflow_events(ray_start_regular, tmp_path):
+    """Event steps: a TimerListener fires and its payload is durable —
+    resume replays the recorded event instead of waiting again
+    (parity: python/ray/workflow/event_listener.py)."""
+    import time as _time
+
+    import ray_tpu.workflow as workflow
+    workflow.init(str(tmp_path))
+
+    fire_at = _time.time() + 0.3
+    wait_step = ray_start_regular.remote(
+        workflow.wait_for_event(workflow.TimerListener, fire_at))
+
+    @ray_start_regular.remote
+    def after(ts):
+        return ("fired", ts)
+
+    dag = after.bind(wait_step.bind())
+    t0 = _time.time()
+    assert workflow.run(dag, workflow_id="wf_ev")[1] == fire_at
+    assert _time.time() - t0 >= 0.25
+    # resume: the event must replay from storage, not wait again
+    t1 = _time.time()
+    assert workflow.resume("wf_ev")[1] == fire_at
+    assert _time.time() - t1 < 5.0
+
+    # file event
+    path = tmp_path / "evt.txt"
+    fstep = ray_start_regular.remote(
+        workflow.wait_for_event(workflow.FileEventListener, str(path)))
+    import threading
+
+    def later():
+        _time.sleep(0.3)
+        path.write_bytes(b"payload")
+    threading.Thread(target=later, daemon=True).start()
+    assert workflow.run(fstep.bind(), workflow_id="wf_ev2") == b"payload"
+
+
+def test_workflow_cloud_storage_backend(ray_start_regular):
+    """Workflow storage over an fsspec URI (memory://) — steps persist
+    and replay through the filesystem abstraction, standing in for
+    gs://bucket paths (parity: cloud workflow_storage.py)."""
+    import ray_tpu.workflow as workflow
+    workflow.init("memory://wfstore")
+    try:
+        assert workflow._remote_fs is not None
+
+        @ray_start_regular.remote
+        def a():
+            return 4
+
+        @ray_start_regular.remote
+        def b(x):
+            return x + 1
+
+        dag = b.bind(a.bind())
+        assert workflow.run(dag, workflow_id="cloud1") == 5
+        assert workflow.get_status("cloud1") == "SUCCESSFUL"
+        assert workflow.resume("cloud1") == 5
+        assert "cloud1" in workflow.list_all()
+        workflow.delete("cloud1")
+        assert workflow.get_status("cloud1") == "NOT_FOUND"
+    finally:
+        workflow.init()   # restore local default for other tests
