@@ -354,6 +354,13 @@ class NodeManager:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="nm-heartbeat", daemon=True)
         self._hb_thread.start()
+        # warm the fork template NOW (without waiting): its import cost
+        # overlaps cluster setup instead of the first spawn burst
+        try:
+            with self._forksrv_lock:
+                self._launch_forkserver_proc()
+        except Exception:  # noqa: BLE001 — cold spawn still works
+            pass
         for _ in range(GLOBAL_CONFIG.worker_pool_min_workers):
             self._spawn_worker()
 
@@ -1299,6 +1306,34 @@ class NodeManager:
         })
         return env
 
+    def _forksrv_sock_path(self) -> str:
+        return os.path.join(
+            self.session_dir, "sockets",
+            f"forksrv_{self.node_id.hex()[:12]}.sock")
+
+    def _launch_forkserver_proc(self) -> None:
+        """Start the template process WITHOUT waiting for it.
+
+        Called at NM boot so the template's import cost overlaps with
+        cluster setup instead of landing inside the first actor/task
+        spawn burst (on a 1-core host, N nodes lazily booting N
+        templates serializes ~N x seconds into the creation window)."""
+        sock_path = self._forksrv_sock_path()
+        if self._forksrv_proc is not None and \
+                self._forksrv_proc.poll() is None:
+            return
+        env = self._worker_env(b"\0" * 16, tpu=False)
+        env["RAY_TPU_FORKSRV_SOCK"] = sock_path
+        os.makedirs(os.path.dirname(sock_path), exist_ok=True)
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, "forkserver.log"), "ab")
+        self._forksrv_proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "ray_tpu._private.worker_forkserver"],
+            env=env, stdout=out, stderr=subprocess.STDOUT)
+        out.close()
+
     def _ensure_forkserver(self) -> Optional[protocol.RpcClient]:
         """Start (once) and connect to the pre-warmed worker forkserver.
 
@@ -1309,22 +1344,10 @@ class NodeManager:
                 return self._forksrv_sock
             if self._forksrv_failed:
                 return None
-            sock_path = os.path.join(
-                self.session_dir, "sockets",
-                f"forksrv_{self.node_id.hex()[:12]}.sock")
+            sock_path = self._forksrv_sock_path()
             if self._forksrv_proc is None or \
                     self._forksrv_proc.poll() is not None:
-                env = self._worker_env(b"\0" * 16, tpu=False)
-                env["RAY_TPU_FORKSRV_SOCK"] = sock_path
-                os.makedirs(os.path.dirname(sock_path), exist_ok=True)
-                log_dir = os.path.join(self.session_dir, "logs")
-                os.makedirs(log_dir, exist_ok=True)
-                out = open(os.path.join(log_dir, "forkserver.log"), "ab")
-                self._forksrv_proc = subprocess.Popen(
-                    [sys.executable, "-m",
-                     "ray_tpu._private.worker_forkserver"],
-                    env=env, stdout=out, stderr=subprocess.STDOUT)
-                out.close()
+                self._launch_forkserver_proc()
             deadline = time.time() + 30.0
             while time.time() < deadline:
                 try:
