@@ -73,6 +73,25 @@ class CoreWorker:
         # (which preserves per-caller order) until the actor publishes
         # a *different* addr — mixing paths could reorder calls
         self._actor_direct_failed: Dict[bytes, str] = {}
+        # direct-channel result push-back: return oid -> pending entry
+        # {event, payload, error, actor}.  Filled by per-actor reader
+        # threads; get() consumes entries instead of 3 CP round trips.
+        # Pure latency cache: results also commit at the CP, so a lost
+        # push (conn death wakes the entry with payload=None) just
+        # means the normal location/wait/fetch flow.
+        self._direct_pending: Dict[bytes, Dict[str, Any]] = {}
+        self._direct_pending_lock = threading.Lock()
+        # actors whose result-stream reader thread is alive: pending
+        # entries are only registered while the reader is — an entry
+        # nobody will ever fill must not exist, or a get() with no
+        # timeout would park on it forever
+        self._direct_readers_ok: set = set()
+        # actor liveness cache: (state, num_restarts) per actor.  The
+        # submit hot path was paying TWO get_actor_info round trips per
+        # call (route + inflight bookkeeping); stale entries are safe —
+        # a failed direct dial or the inflight watcher invalidates, and
+        # the at-least-once + dedup machinery absorbs a spurious resend.
+        self._actor_state_cache: Dict[bytes, Tuple[str, int]] = {}
         self._seq_lock = threading.Lock()
         self._actor_seq: Dict[bytes, int] = {}
         # Client-side buffering for calls to not-yet-ALIVE actors
@@ -139,22 +158,26 @@ class CoreWorker:
 
     def put_object(self, oid: bytes, value: Any,
                    is_error: bool = False,
-                   owner_addr: Optional[str] = None) -> None:
+                   owner_addr: Optional[str] = None) -> Optional[bytes]:
         """Commit a value under ``oid``.  ``owner_addr`` is the node
         manager owning the object's lifetime (the caller's NM for task
         returns, ours for puts); empty/None commits a CP-governed object
-        (centralized refcounting fallback)."""
+        (centralized refcounting fallback).  Returns the serialized
+        payload when it committed inline (the direct-channel result
+        push reuses it), else None."""
         sobj = serialization.serialize(value)
         owner = self.worker_id.binary()
         if sobj.total_bytes <= GLOBAL_CONFIG.inline_object_max_bytes:
-            self.cp.put_inline(oid, sobj.to_bytes(), is_error=is_error,
+            data = sobj.to_bytes()
+            self.cp.put_inline(oid, data, is_error=is_error,
                                owner=owner, owner_addr=owner_addr or "")
-        else:
-            self.store.put_serialized(oid, sobj)
-            self.cp.commit_shm(oid, sobj.total_bytes,
-                               node_id=self.commit_node_id,
-                               is_error=is_error, owner=owner,
-                               owner_addr=owner_addr or "")
+            return data
+        self.store.put_serialized(oid, sobj)
+        self.cp.commit_shm(oid, sobj.total_bytes,
+                           node_id=self.commit_node_id,
+                           is_error=is_error, owner=owner,
+                           owner_addr=owner_addr or "")
+        return None
 
     def _fetch_committed(self, oid: bytes, loc: Dict[str, Any],
                          preloaded: Optional[bytes] = None) -> Any:
@@ -382,16 +405,46 @@ class CoreWorker:
                 raise TypeError(
                     f"get() expects ObjectRef(s), got {type(r).__name__}")
         ids = [r.binary() for r in ref_list]
+        # ONE deadline across the direct-push wait and the CP flow: a
+        # fallback after a consumed wait must not restart the budget
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        # direct-channel fast path: results pushed by the actor worker
+        # resolve with zero control-plane round trips
+        direct_vals: Dict[bytes, Any] = {}
+        direct_errs: Dict[bytes, bool] = {}
+        pending = []
+        with self._direct_pending_lock:
+            for o in ids:
+                e = self._direct_pending.get(o)
+                if e is not None:
+                    pending.append((o, e))
+        if pending:
+            self._notify_blocked(True)
+            try:
+                for o, e in pending:
+                    t = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+                    if e["event"].wait(t):
+                        with self._direct_pending_lock:
+                            self._direct_pending.pop(o, None)
+                        if e["payload"] is not None:
+                            direct_vals[o] = serialization.loads(
+                                e["payload"])
+                            direct_errs[o] = e["error"]
+                    # payload None (big result / conn died) or timeout:
+                    # the CP flow below handles it
+            finally:
+                self._notify_blocked(False)
+        rest = [o for o in ids if o not in direct_vals]
         # one bulk location RPC; blocked waits use the combined
         # wait+fetch so a small result costs one round trip total
-        locs = self.cp.get_locations(ids)
+        locs = self.cp.get_locations(rest) if rest else {}
         preloaded: Dict[bytes, bytes] = {}
-        unready = [o for o in ids if locs.get(o) is None]
+        unready = [o for o in rest if locs.get(o) is None]
         if unready:
             self._notify_blocked(True)
             try:
-                deadline = (None if timeout is None
-                            else time.monotonic() + timeout)
                 for o in unready:
                     t = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
@@ -406,6 +459,15 @@ class CoreWorker:
                 self._notify_blocked(False)
         values = []
         for o in ids:
+            if o in direct_vals:
+                value = direct_vals[o]
+                if direct_errs.get(o):
+                    if isinstance(value, TaskError):
+                        raise value.as_instanceof_cause()
+                    if isinstance(value, BaseException):
+                        raise value
+                values.append(value)
+                continue
             loc = locs.get(o)
             if loc is None:
                 raise GetTimeoutError(f"object {o.hex()} not available")
@@ -711,13 +773,38 @@ class CoreWorker:
         if not streaming:
             direct = self._actor_direct(spec.actor_id)
             if direct is not None:
+                rets = spec.return_object_ids()
+                oid = rets[0] if spec.num_returns == 1 and rets else None
+                if oid is not None:
+                    with self._direct_pending_lock:
+                        if spec.actor_id not in self._direct_readers_ok:
+                            oid = None  # no reader: CP flow only
+                        elif oid in self._direct_pending:
+                            pass  # resend: keep the (maybe-filled) entry
+                        else:
+                            # bounded: refs the caller never get()s must
+                            # not pin payloads forever.  Wake evictees —
+                            # a get() already parked on one falls back
+                            # to the CP flow instead of stranding.
+                            while len(self._direct_pending) >= 4096:
+                                old = self._direct_pending.pop(
+                                    next(iter(self._direct_pending)))
+                                old["event"].set()
+                            self._direct_pending[oid] = {
+                                "event": threading.Event(),
+                                "payload": None, "error": False,
+                                "actor": spec.actor_id}
                 try:
                     direct.call("call_actor", spec)
                     self._record_inflight(spec, streaming,
                                           restarts_seen)
                     return
                 except Exception:  # noqa: BLE001 — stale addr: relay
+                    if oid is not None:
+                        with self._direct_pending_lock:
+                            self._direct_pending.pop(oid, None)
                     self._actor_direct_cache.pop(spec.actor_id, None)
+                    self._actor_state_cache.pop(spec.actor_id, None)
                     self._actor_direct_failed[spec.actor_id] = (
                         direct.sock_path)
         nm = self._actor_nm(spec.actor_id, wait=False)
@@ -754,8 +841,53 @@ class CoreWorker:
         self._actor_direct_failed.pop(actor_id, None)
         from ray_tpu._private.protocol import RpcClient
         client = RpcClient(addr, connect_timeout=2.0)
+        self._start_direct_result_reader(actor_id, client)
         self._actor_direct_cache[actor_id] = client
         return client
+
+    def _start_direct_result_reader(self, actor_id: bytes,
+                                    client) -> None:
+        """Open the per-caller result stream on the actor's direct
+        server and drain pushed results into ``_direct_pending``."""
+        from ray_tpu._private import protocol as _proto
+        try:
+            sock = client.hijack("stream_results",
+                                 self.worker_id.binary())
+        except Exception:  # noqa: BLE001 — push-back is optional
+            return
+        with self._direct_pending_lock:
+            self._direct_readers_ok.add(actor_id)
+
+        def reader():
+            try:
+                while True:
+                    msg = _proto.recv_msg(sock)
+                    entry = None
+                    with self._direct_pending_lock:
+                        entry = self._direct_pending.get(msg.get("oid"))
+                    if entry is not None:
+                        entry["payload"] = msg.get("payload")
+                        entry["error"] = bool(msg.get("error"))
+                        entry["event"].set()
+            except Exception:  # noqa: BLE001 — conn died
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # drop the liveness mark FIRST (no new registrations),
+                # then wake every waiter still parked on this actor:
+                # they fall back to the CP flow
+                with self._direct_pending_lock:
+                    self._direct_readers_ok.discard(actor_id)
+                    stale = [e for e in self._direct_pending.values()
+                             if e["actor"] == actor_id]
+                for e in stale:
+                    e["event"].set()
+
+        threading.Thread(target=reader, daemon=True,
+                         name=f"direct-res-{actor_id.hex()[:6]}").start()
 
     # ------------------------------------------------------------------
     # In-flight actor call tracking.  If the hosting node dies, the node
@@ -768,8 +900,15 @@ class CoreWorker:
         if not streaming and not spec.return_object_ids():
             return  # num_returns=0: nothing to watch for
         if restarts_seen is None:
-            info = self.cp.get_actor_info(spec.actor_id) or {}
-            restarts_seen = info.get("num_restarts", 0)
+            cached = self._actor_state_cache.get(spec.actor_id)
+            if cached is not None:
+                restarts_seen = cached[1]
+            else:
+                info = self.cp.get_actor_info(spec.actor_id) or {}
+                restarts_seen = info.get("num_restarts", 0)
+                if info:
+                    self._actor_state_cache[spec.actor_id] = (
+                        info.get("state", "?"), restarts_seen)
         with self._inflight_lock:
             self._inflight_actor.setdefault(spec.actor_id, {})[
                 spec.task_id] = (spec, streaming, restarts_seen)
@@ -816,6 +955,12 @@ class CoreWorker:
                 continue
             info = self.cp.get_actor_info(actor_id)
             state = (info or {}).get("state")
+            # keep the submit-path cache honest while calls are watched
+            if info is None:
+                self._actor_state_cache.pop(actor_id, None)
+            else:
+                self._actor_state_cache[actor_id] = (
+                    state, info.get("num_restarts", 0))
             if info is None or state == "DEAD":
                 for tid, (spec, streaming, _) in tasks.items():
                     if not self._call_committed(spec, streaming):
@@ -885,8 +1030,19 @@ class CoreWorker:
         per actor drains the buffer FIFO once the actor starts.
         """
         actor_id = spec.actor_id
-        info = self.cp.get_actor_info(actor_id)
-        state = info.get("state") if info else None
+        cached = self._actor_state_cache.get(actor_id)
+        if cached is not None and cached[0] == "ALIVE":
+            # hot path: no control-plane round trip.  {} (not None) so
+            # the dead-branch below can't mistake the cache hit for
+            # "actor unknown"
+            info: Optional[Dict[str, Any]] = {}
+            state = "ALIVE"
+        else:
+            info = self.cp.get_actor_info(actor_id)
+            state = info.get("state") if info else None
+            if info:
+                self._actor_state_cache[actor_id] = (
+                    state, info.get("num_restarts", 0))
         self._abtrace("route_or_buffer", spec.name,
                       actor_id.hex()[:8], "state", state)
         with self._actor_buffer_lock:
@@ -915,6 +1071,7 @@ class CoreWorker:
             self._abtrace("fail_direct", spec.name, str(e)[:60])
             self._fail_actor_call(spec, streaming, e)
         except (OSError, ConnectionError):
+            self._actor_state_cache.pop(actor_id, None)
             # The actor's node manager is unreachable (its node just
             # died); buffer the call — the health loop will transition
             # the actor to RESTARTING (new address) or DEAD shortly.
@@ -981,6 +1138,8 @@ class CoreWorker:
                     timeout=max(0.0, deadline - time.monotonic()))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self._actor_state_cache.pop(actor_id, None)
+        self._actor_direct_cache.pop(actor_id, None)
         try:
             nm = self._actor_nm(actor_id, wait=True)
         except ActorDiedError:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import socket
 import os
 import sys
 import threading
@@ -42,6 +43,10 @@ class WorkerProcess:
         self.stream = self.nm_client.hijack(
             "stream_worker", self.worker_id.binary())
         self._send_lock = threading.Lock()
+        # direct-channel result push-back: caller worker_id -> stream
+        self._direct_res_lock = threading.Lock()
+        self._direct_result_conns: Dict[bytes, socket.socket] = {}
+        self._direct_res_send_locks: Dict[bytes, threading.Lock] = {}
         from ray_tpu.util.tracing import maybe_enable_from_cluster
         maybe_enable_from_cluster(self.cp)
         self.core = CoreWorker(
@@ -157,30 +162,32 @@ class WorkerProcess:
             return
         oids = spec.return_object_ids()
         if spec.num_returns == 1:
-            self.core.put_object(oids[0], result,
-                                 owner_addr=spec.owner_addr)
-        else:
-            values = list(result)
-            if len(values) != spec.num_returns:
-                raise ValueError(
-                    f"task {spec.name} declared num_returns="
-                    f"{spec.num_returns} but returned {len(values)} values")
-            for oid, v in zip(oids, values):
-                self.core.put_object(oid, v, owner_addr=spec.owner_addr)
+            return self.core.put_object(oids[0], result,
+                                        owner_addr=spec.owner_addr)
+        values = list(result)
+        if len(values) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns="
+                f"{spec.num_returns} but returned {len(values)} values")
+        for oid, v in zip(oids, values):
+            self.core.put_object(oid, v, owner_addr=spec.owner_addr)
+        return None
 
     def _commit_error(self, spec: TaskSpec, exc: BaseException):
         err = TaskError(exc, format_remote_traceback(exc),
                         spec.task_id.hex())
+        inline = None
         try:
             for oid in spec.return_object_ids():
-                self.core.put_object(oid, err, is_error=True,
-                                     owner_addr=spec.owner_addr)
+                inline = self.core.put_object(oid, err, is_error=True,
+                                              owner_addr=spec.owner_addr)
             if spec.is_generator:
                 self.core.commit_generator_item(spec.task_id, 0, err,
                                                 is_error=True)
                 self.core.commit_generator_done(spec.task_id, 1)
         except Exception:  # noqa: BLE001
             traceback.print_exc()
+        return inline
 
     # ------------------------------------------------------------------
     def _execute_task(self, spec: TaskSpec, chips):
@@ -350,10 +357,12 @@ class WorkerProcess:
         return True
 
     def _finish_actor_task(self, spec: TaskSpec, notify_nm: bool,
-                           error: bool) -> None:
+                           error: bool,
+                           inline: "Optional[bytes]" = None) -> None:
         """Completion bookkeeping shared by the sync and async runners:
         record the outcome for duplicate deliveries, notify the NM when
-        either the original delivery or a relayed duplicate needs it."""
+        either the original delivery or a relayed duplicate needs it,
+        and push inline results back to direct-channel callers."""
         with self._seen_lock:
             if spec.task_id in self._seen_tasks:
                 self._seen_tasks[spec.task_id] = ("done", error)
@@ -363,7 +372,31 @@ class WorkerProcess:
             self._send({"type": "done", "task_id": spec.task_id,
                         "error": error})
         if not notify_nm:
+            self._push_direct_result(spec, error, inline)
             self._purge_direct_pins(spec)
+
+    def _push_direct_result(self, spec: TaskSpec, error: bool,
+                            inline: "Optional[bytes]") -> None:
+        """Send the result straight back over the caller's result
+        stream (reference: the direct transport replies in-band).  The
+        result is ALSO committed to the CP as usual — this push is a
+        latency cache, dropping 3 control-plane round trips from the
+        sync call+get hot path; a lost push just means the caller falls
+        back to the normal location/wait/fetch flow."""
+        caller = spec.owner_id
+        with self._direct_res_lock:
+            conn = self._direct_result_conns.get(caller)
+            lock = self._direct_res_send_locks.get(caller)
+        if conn is None or lock is None:
+            return
+        oids = spec.return_object_ids()
+        msg = {"oid": oids[0] if oids else b"",
+               "payload": inline, "error": error}
+        try:
+            with lock:
+                protocol.send_msg(conn, msg)
+        except (OSError, BrokenPipeError):  # caller gone: CP path holds
+            pass
 
     def _dispatch_actor_task(self, spec: TaskSpec,
                              notify_nm: bool = True):
@@ -396,6 +429,29 @@ class WorkerProcess:
             self._proc._dispatch_actor_task(spec, notify_nm=False)
             return True
 
+        def stream_results(self, conn: socket.socket,
+                           caller_id: bytes) -> None:
+            """Hijacked per-caller channel for inline result push-back.
+
+            The caller never sends after the handshake; this thread
+            parks on recv to notice the peer closing, then drops the
+            registration so pushes stop."""
+            proc = self._proc
+            with proc._direct_res_lock:
+                proc._direct_result_conns[caller_id] = conn
+                proc._direct_res_send_locks[caller_id] = threading.Lock()
+            try:
+                while True:
+                    if not conn.recv(4096):
+                        break
+            except OSError:
+                pass
+            finally:
+                with proc._direct_res_lock:
+                    if proc._direct_result_conns.get(caller_id) is conn:
+                        proc._direct_result_conns.pop(caller_id, None)
+                        proc._direct_res_send_locks.pop(caller_id, None)
+
     def _start_direct_server(self, actor_id: bytes) -> None:
         from ray_tpu._private.protocol import is_tcp_address, \
             parse_tcp_address
@@ -421,6 +477,7 @@ class WorkerProcess:
     def _run_actor_task(self, spec: TaskSpec, notify_nm: bool = True):
         from ray_tpu.util.tracing import task_span
         self.core.current_task_id = spec.task_id
+        inline = None
         try:
             method = self._lookup_method(spec)
             args, kwargs = self._resolve_args(spec)
@@ -428,14 +485,14 @@ class WorkerProcess:
                 result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            self._commit_results(spec, result)
+            inline = self._commit_results(spec, result)
             error = False
         except BaseException as e:  # noqa: BLE001
-            self._commit_error(spec, e)
+            inline = self._commit_error(spec, e)
             error = True
         finally:
             self.core.current_task_id = None
-        self._finish_actor_task(spec, notify_nm, error)
+        self._finish_actor_task(spec, notify_nm, error, inline)
         if spec.actor_method == "__ray_terminate__":
             os._exit(0)
 
@@ -454,6 +511,7 @@ class WorkerProcess:
     async def _run_actor_task_async(self, spec: TaskSpec,
                                     notify_nm: bool = True):
         self.core.current_task_id = spec.task_id
+        inline = None
         try:
             method = self._lookup_method(spec)
             args, kwargs = self._resolve_args(spec)
@@ -463,12 +521,12 @@ class WorkerProcess:
             if spec.is_generator and inspect.isasyncgen(result):
                 await self._commit_async_generator(spec, result)
             else:
-                self._commit_results(spec, result)
+                inline = self._commit_results(spec, result)
             error = False
         except BaseException as e:  # noqa: BLE001
-            self._commit_error(spec, e)
+            inline = self._commit_error(spec, e)
             error = True
-        self._finish_actor_task(spec, notify_nm, error)
+        self._finish_actor_task(spec, notify_nm, error, inline)
         if spec.actor_method == "__ray_terminate__":
             os._exit(0)
 
