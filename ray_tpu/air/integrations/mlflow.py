@@ -1,13 +1,21 @@
 """MLflow tracking (parity: ``python/ray/air/integrations/mlflow.py``
 MLflowLoggerCallback).
 
-One MLflow run per trial; reports become metrics, trial config becomes
-params.  The ``mlflow`` client is not part of the TPU image —
-construction raises a clear ImportError when absent."""
+One MLflow run per trial, driven through ``MlflowClient`` with explicit
+run ids — the fluent module-level API binds to a single global "active
+run", which cross-writes metrics/artifacts between concurrently
+reporting trials.  Config becomes params, reports become step-indexed
+metrics, persisted checkpoints optionally upload as run artifacts
+(off-thread; the hook runs in the Tuner's controller loop), and the
+terminal status lands on completion.  The ``mlflow`` client is not part
+of the TPU image — construction raises a clear ImportError when
+absent."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Dict, Optional
 
 from ray_tpu.tune.callbacks import LoggerCallback
 
@@ -15,7 +23,8 @@ from ray_tpu.tune.callbacks import LoggerCallback
 class MLflowLoggerCallback(LoggerCallback):
     def __init__(self, tracking_uri: Optional[str] = None,
                  experiment_name: Optional[str] = None,
-                 tags: Optional[Dict[str, str]] = None):
+                 tags: Optional[Dict[str, str]] = None,
+                 save_artifact: bool = False):
         try:
             import mlflow
         except ImportError as e:  # pragma: no cover - env-dependent
@@ -24,30 +33,64 @@ class MLflowLoggerCallback(LoggerCallback):
                 "the image (TPU pods run without runtime pip installs)"
             ) from e
         self._mlflow = mlflow
-        if tracking_uri:
-            mlflow.set_tracking_uri(tracking_uri)
+        self._client = mlflow.tracking.MlflowClient(
+            tracking_uri=tracking_uri)
+        self._experiment_id = "0"
         if experiment_name:
-            mlflow.set_experiment(experiment_name)
+            exp = self._client.get_experiment_by_name(experiment_name)
+            if exp is None:
+                self._experiment_id = self._client.create_experiment(
+                    experiment_name)
+            else:
+                self._experiment_id = exp.experiment_id
         self.tags = tags or {}
-        self._runs: Dict[str, Any] = {}
+        self.save_artifact = save_artifact
+        self._run_ids: Dict[str, str] = {}
 
-    def log_trial_result(self, trial, result: Dict[str, Any]) -> None:
+    def _run_id(self, trial) -> str:
         tid = trial.trial_id
-        if tid not in self._runs:
-            run = self._mlflow.start_run(run_name=tid, nested=True,
-                                         tags=self.tags)
-            self._runs[tid] = run
+        rid = self._run_ids.get(tid)
+        if rid is None:
+            run = self._client.create_run(
+                self._experiment_id,
+                tags={**self.tags, "mlflow.runName": tid})
+            rid = run.info.run_id
+            self._run_ids[tid] = rid
             for k, v in (getattr(trial, "config", {}) or {}).items():
                 try:
-                    self._mlflow.log_param(k, v)
+                    self._client.log_param(rid, k, v)
                 except Exception:  # noqa: BLE001 - non-loggable param
                     pass
+        return rid
+
+    def log_trial_result(self, trial, result: Dict) -> None:
+        rid = self._run_id(trial)
         step = int(result.get("training_iteration", 0))
-        self._mlflow.log_metrics(
-            {k: float(v) for k, v in result.items()
-             if isinstance(v, (int, float)) and not isinstance(v, bool)},
-            step=step)
+        ts = int(time.time() * 1000)
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._client.log_metric(rid, k, float(v),
+                                        timestamp=ts, step=step)
+
+    def log_trial_save(self, trial, checkpoint_path: str) -> None:
+        """Persisted checkpoint -> MLflow run artifacts (off-thread)."""
+        if not self.save_artifact:
+            return
+        rid = self._run_id(trial)
+
+        def upload():
+            try:
+                self._client.log_artifacts(
+                    rid, checkpoint_path,
+                    artifact_path=f"checkpoints/{trial.trial_id}")
+            except Exception:  # noqa: BLE001 — upload is best-effort
+                pass
+
+        threading.Thread(target=upload, daemon=True,
+                         name="mlflow-ckpt-upload").start()
 
     def log_trial_end(self, trial, failed: bool) -> None:
-        if self._runs.pop(trial.trial_id, None) is not None:
-            self._mlflow.end_run("FAILED" if failed else "FINISHED")
+        rid = self._run_ids.pop(trial.trial_id, None)
+        if rid is not None:
+            self._client.set_terminated(
+                rid, "FAILED" if failed else "FINISHED")

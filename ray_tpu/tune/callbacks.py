@@ -39,6 +39,9 @@ class Callback:
     def on_trial_error(self, trial, error: BaseException) -> None:
         pass
 
+    def on_checkpoint(self, trial, checkpoint_path: str) -> None:
+        """A trial checkpoint was persisted to ``checkpoint_path``."""
+
     def on_experiment_end(self, results) -> None:
         pass
 
@@ -59,6 +62,12 @@ class LoggerCallback(Callback):
 
     def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
         self.log_trial_result(trial, result)
+
+    def on_checkpoint(self, trial, checkpoint_path: str) -> None:
+        self.log_trial_save(trial, checkpoint_path)
+
+    def log_trial_save(self, trial, checkpoint_path: str) -> None:
+        """Optional: persist/upload the trial's checkpoint artifact."""
 
     def on_trial_complete(self, trial) -> None:
         self.log_trial_end(trial, failed=False)

@@ -411,6 +411,8 @@ class Tuner:
                             # copy is durable, drop the per-step source
                             shutil.rmtree(checkpoint.path,
                                           ignore_errors=True)
+                        cb_mod.invoke(callbacks, "on_checkpoint", trial,
+                                      trial.checkpoint.path)
                         save_state(throttled=True)
                     decision = scheduler.on_result(trial.trial_id,
                                                    metrics)

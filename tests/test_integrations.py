@@ -182,3 +182,126 @@ def test_dashboard_serves_spa(ray_start_2_cpus):
         f"http://127.0.0.1:{port}/api/nodes", timeout=10).read())
     assert nodes and nodes[0]["state"] == "ALIVE"
     assert "load" in nodes[0]
+
+
+def test_wandb_mlflow_full_lifecycle(ray_start_2_cpus, tmp_path,
+                                     monkeypatch):
+    """Run-lifecycle adapters: config capture, step metrics, checkpoint
+    artifact upload, summary + exit status — driven against faked
+    wandb/mlflow clients (the real ones are not in the TPU image)."""
+    import sys
+    import types
+
+    events = []
+
+    class _FakeRun:
+        def __init__(self):
+            self.summary = {}
+
+        def log(self, metrics, step=None):
+            events.append(("wandb.log", dict(metrics), step))
+
+        def log_artifact(self, art):
+            events.append(("wandb.artifact", art.name, art.dirs))
+
+        def finish(self, exit_code=0):
+            events.append(("wandb.finish", exit_code))
+
+    class _FakeArtifact:
+        def __init__(self, name, type):
+            self.name, self.dirs = name, []
+
+        def add_dir(self, d):
+            self.dirs.append(d)
+
+    fake_wandb = types.SimpleNamespace(
+        init=lambda **kw: events.append(
+            ("wandb.init", kw.get("name"), kw.get("config"),
+             kw.get("tags"))) or _FakeRun(),
+        Artifact=_FakeArtifact,
+        login=lambda key=None: None)
+    monkeypatch.setitem(sys.modules, "wandb", fake_wandb)
+
+    class _FakeMlflowClient:
+        def __init__(self, tracking_uri=None):
+            pass
+
+        def get_experiment_by_name(self, name):
+            return None
+
+        def create_experiment(self, name):
+            return "exp1"
+
+        def create_run(self, experiment_id, tags=None):
+            events.append(("mlflow.start", experiment_id,
+                           (tags or {}).get("mlflow.runName")))
+            return types.SimpleNamespace(
+                info=types.SimpleNamespace(run_id="rid1"))
+
+        def log_param(self, rid, k, v):
+            events.append(("mlflow.param", k, v))
+
+        def log_metric(self, rid, k, v, timestamp=None, step=None):
+            events.append(("mlflow.metric", rid, k, v, step))
+
+        def log_artifacts(self, rid, d, artifact_path=None):
+            events.append(("mlflow.artifacts", rid, artifact_path))
+
+        def set_terminated(self, rid, status):
+            events.append(("mlflow.end", rid, status))
+
+    fake_mlflow = types.SimpleNamespace(
+        tracking=types.SimpleNamespace(MlflowClient=_FakeMlflowClient))
+    monkeypatch.setitem(sys.modules, "mlflow", fake_mlflow)
+
+    from ray_tpu import tune
+    from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+    from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+    from ray_tpu.train import RunConfig
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    def trainable(config):
+        import json
+        import os
+        import tempfile
+        for i in range(2):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "w.json"), "w") as f:
+                json.dump({"step": i}, f)
+            tune.report({"score": config["x"] * (i + 1)},
+                        checkpoint=Checkpoint.from_directory(d))
+
+    tuner = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([3])},
+        run_config=RunConfig(
+            name="intg", storage_path=str(tmp_path),
+            callbacks=[WandbLoggerCallback(
+                           project="p", tags=["user-tag"],
+                           upload_checkpoints=True),
+                       MLflowLoggerCallback(
+                           experiment_name="exp",
+                           save_artifact=True)]))
+    grid = tuner.fit()
+    assert not grid.errors
+    # artifact uploads run off-thread; give them a beat
+    import time as _t
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        kinds = [e[0] for e in events]
+        if kinds.count("wandb.artifact") >= 2 \
+                and kinds.count("mlflow.artifacts") >= 2:
+            break
+        _t.sleep(0.1)
+    kinds = [e[0] for e in events]
+    assert "wandb.init" in kinds and "wandb.finish" in kinds
+    init_ev = next(e for e in events if e[0] == "wandb.init")
+    assert init_ev[2] == {"x": 3}          # full config captured
+    # user tags merged with the generated trial tag, not clobbered
+    assert "user-tag" in init_ev[3] and any(
+        t.startswith("trial:") for t in init_ev[3])
+    assert kinds.count("wandb.artifact") == 2   # one per checkpoint
+    assert kinds.count("mlflow.artifacts") == 2
+    assert ("mlflow.param", "x", 3) in events
+    assert ("mlflow.end", "rid1", "FINISHED") in events
+    fin = next(e for e in events if e[0] == "wandb.finish")
+    assert fin[1] == 0
