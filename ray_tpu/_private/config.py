@@ -102,6 +102,10 @@ _d("object_gc_period_s", 1.0, "Control-plane GC sweep period.")
 
 # --- scheduler -------------------------------------------------------------
 _d("worker_pool_min_workers", 0, "Prestarted workers per node.")
+_d("forksrv_warm_delay_s", 3.0,
+   "Seconds after node-manager boot before the fork template warms "
+   "(0 = immediately); deferred so N simultaneous node adds don't "
+   "starve registration heartbeats on small hosts.")
 _d("worker_max_concurrent_starts", 16,
    "Worker processes allowed to be starting (forked, not yet "
    "registered) at once.  Startup cost is the child's imports, which "

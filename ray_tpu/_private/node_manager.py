@@ -354,13 +354,25 @@ class NodeManager:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="nm-heartbeat", daemon=True)
         self._hb_thread.start()
-        # warm the fork template NOW (without waiting): its import cost
-        # overlaps cluster setup instead of the first spawn burst
-        try:
-            with self._forksrv_lock:
-                self._launch_forkserver_proc()
-        except Exception:  # noqa: BLE001 — cold spawn still works
-            pass
+        # Warm the fork template shortly after boot (without waiting):
+        # its import cost overlaps cluster setup instead of the first
+        # spawn burst.  Deferred a beat — N nodes added together each
+        # booting a template AT registration starves the very
+        # heartbeats that prove the nodes alive on small hosts.
+        def _warm():
+            try:
+                if self._stopped.is_set():
+                    return  # NM shut down before the warm fired
+                with self._forksrv_lock:
+                    if self._forksrv_sock is None \
+                            and not self._forksrv_failed:
+                        self._launch_forkserver_proc()
+            except Exception:  # noqa: BLE001 — cold spawn still works
+                pass
+        self._forksrv_warm_timer = threading.Timer(
+            GLOBAL_CONFIG.forksrv_warm_delay_s, _warm)
+        self._forksrv_warm_timer.daemon = True
+        self._forksrv_warm_timer.start()
         for _ in range(GLOBAL_CONFIG.worker_pool_min_workers):
             self._spawn_worker()
 
@@ -1776,6 +1788,9 @@ class NodeManager:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        timer = getattr(self, "_forksrv_warm_timer", None)
+        if timer is not None:
+            timer.cancel()
         self._wake.set()
         with self._lock:
             workers = list(self._workers.values())

@@ -173,35 +173,43 @@ def main() -> Dict[str, Any]:
     import ray_tpu
     from ray_tpu._private import ray_perf
 
-    ray_tpu.init(num_cpus=16)
     results: Dict[str, Any] = {"host_cpus": os.cpu_count()}
     t_all = time.perf_counter()
-    # actors last: on a 1-core host the 100+-process actor storm starves
-    # other node heartbeats, and the death watcher (correctly) reaps them
+    # Each probe gets a FRESH cluster (the reference's release
+    # benchmarks are separate jobs too): on a 1-core host the residue
+    # of one probe — 500k task events, the worker storm's process
+    # churn — otherwise degrades the next by up to 8x, measuring
+    # contamination instead of the subsystem.
+    def perf_all():
+        return {r["name"]: round(r["rate"], 2)
+                for r in ray_perf.main(duration=1.0)}
+
     for name, fn in (
         ("wait_10k_refs", probe_wait_many_refs),
         ("broadcast_1gib_8_nodes", probe_broadcast),
         ("queue_500k_noop_tasks", lambda: probe_queue_tasks(500_000)),
         ("actors_1024", lambda: probe_actors(1024)),
+        ("ray_perf", perf_all),
     ):
         t0 = time.perf_counter()
         try:
+            ray_tpu.init(num_cpus=16)
             results[name] = fn()
-            results[name]["probe_s"] = round(time.perf_counter() - t0, 2)
+            if isinstance(results[name], dict) and name != "ray_perf":
+                results[name]["probe_s"] = round(
+                    time.perf_counter() - t0, 2)
         except Exception as e:  # noqa: BLE001
             import traceback
             traceback.print_exc()
             results[name] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            try:
+                ray_tpu.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
         print(f"[scale_probe] {name}: {json.dumps(results[name])}",
               flush=True)
-    try:
-        perf = ray_perf.main(duration=1.0)
-        results["ray_perf"] = {r["name"]: round(r["rate"], 2)
-                               for r in perf}
-    except Exception as e:  # noqa: BLE001
-        results["ray_perf"] = {"error": str(e)}
     results["total_s"] = round(time.perf_counter() - t_all, 1)
-    ray_tpu.shutdown()
     print(json.dumps(results))
     return results
 
