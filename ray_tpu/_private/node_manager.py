@@ -793,7 +793,10 @@ class NodeManager:
             pass
         return out
 
-    def tail_log(self, name: str, nbytes: int = 65536) -> bytes:
+    def tail_log(self, name: str,
+                 nbytes: int = 65536) -> Optional[bytes]:
+        """Last ``nbytes`` of a session log, or None when this node
+        doesn't have that file (callers probe several nodes)."""
         if os.sep in name or name.startswith("."):
             raise ValueError(f"bad log name {name!r}")
         path = os.path.join(self.session_dir, "logs", name)
@@ -803,7 +806,7 @@ class NodeManager:
                 f.seek(max(0, size - nbytes))
                 return f.read(nbytes)
         except OSError:
-            return b""
+            return None
 
     def delete_objects(self, object_ids: List[bytes]) -> int:
         """GC fan-out target: drop local shm copies of freed objects."""

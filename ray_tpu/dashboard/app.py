@@ -230,8 +230,8 @@ class Dashboard:
 
             data = await loop.run_in_executor(None, blocking)
             if data is None:
-                return web.json_response({"error": "node not found"},
-                                         status=404)
+                return web.json_response(
+                    {"error": "node or log file not found"}, status=404)
             return web.Response(text=data.decode("utf-8", "replace"),
                                 content_type="text/plain")
 

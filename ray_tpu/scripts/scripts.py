@@ -74,6 +74,15 @@ def cmd_status(args):
             print(f"  {k}: {v}")
 
 
+def _parse_filter(expr: str):
+    """``key<op>value`` -> (key, op, value); ops: != >= <= = > <``."""
+    for op in ("!=", ">=", "<=", "=", ">", "<"):
+        if op in expr:
+            key, val = expr.split(op, 1)
+            return (key.strip(), op, val.strip())
+    raise SystemExit(f"bad --filter {expr!r} (want key=value)")
+
+
 def cmd_list(args):
     cp = _connect_cp()
     kind = args.kind
@@ -102,8 +111,54 @@ def cmd_list(args):
     else:
         print(f"unknown kind {kind}", file=sys.stderr)
         sys.exit(1)
+    if getattr(args, "filter", None):
+        from ray_tpu.util.state import _match
+        for expr in args.filter:
+            key, op, val = _parse_filter(expr)
+            rows = [r for r in rows if _match(r, key, op, val)]
+    limit = getattr(args, "limit", None)
+    if limit is not None:
+        rows = rows[:limit]
     for row in rows:
         print(json.dumps(row, default=str))
+
+
+def cmd_logs(args):
+    """``ray-tpu logs`` — list worker/daemon log files across nodes;
+    ``ray-tpu logs <name>`` tails one (parity: ``ray logs``,
+    ``util/state/state_cli.py`` logs subcommand)."""
+    from ray_tpu._private.protocol import RpcClient
+    cp = _connect_cp()
+    nodes = [n for n in cp.call("list_nodes")
+             if n.get("state") == "ALIVE"]
+    if args.node:
+        nodes = [n for n in nodes
+                 if n["node_id"].hex().startswith(args.node)]
+        if not nodes:
+            raise SystemExit(f"no alive node matches {args.node!r}")
+    if not args.name:
+        for n in nodes:
+            nid = n["node_id"].hex()
+            try:
+                logs = RpcClient(n["sock_path"]).call("list_logs")
+            except (OSError, ConnectionError) as e:
+                print(f"[{nid[:12]}] unreachable: {e}", file=sys.stderr)
+                continue
+            for entry in logs:
+                print(f"{nid[:12]}  {entry['size']:>10}  "
+                      f"{entry['name']}")
+        return
+    for n in nodes:
+        try:
+            data = RpcClient(n["sock_path"]).call(
+                "tail_log", args.name, args.tail)
+        except (OSError, ConnectionError):
+            continue  # node unreachable: try the rest
+        if data is None:
+            continue  # this node doesn't have the file
+        sys.stdout.write(data.decode(errors="replace"))
+        return
+    raise SystemExit(f"log {args.name!r} not found on any node")
 
 
 def cmd_summary(args):
@@ -404,6 +459,17 @@ def main(argv=None):
     p_list = sub.add_parser("list")
     p_list.add_argument("kind", choices=["nodes", "actors", "tasks",
                                          "objects", "placement-groups"])
+    p_list.add_argument("--filter", action="append", default=[],
+                        help="key<op>value predicate (= != < <= > >=); "
+                             "repeatable, ANDed")
+    p_list.add_argument("--limit", type=int, default=None)
+    p_logs = sub.add_parser("logs")
+    p_logs.add_argument("name", nargs="?", default=None,
+                        help="log file to tail (omit to list)")
+    p_logs.add_argument("--node", default=None,
+                        help="node id prefix to restrict to")
+    p_logs.add_argument("--tail", type=int, default=65536,
+                        help="bytes from the end to print")
     sub.add_parser("summary")
     p_tl = sub.add_parser("timeline")
     p_tl.add_argument("--output", "-o", default=None)
@@ -437,7 +503,7 @@ def main(argv=None):
     args = parser.parse_args(argv)
     {"status": cmd_status, "list": cmd_list, "summary": cmd_summary,
      "timeline": cmd_timeline, "memory": cmd_memory,
-     "stack": cmd_stack,
+     "stack": cmd_stack, "logs": cmd_logs,
      "microbenchmark": cmd_microbenchmark,
      "dashboard": cmd_dashboard, "jobs": cmd_jobs,
      "start": cmd_start, "stop": cmd_stop}[args.command](args)

@@ -49,6 +49,10 @@ elif VARIANT == "b32_nochunk":
     batch = 32
 elif VARIANT == "b16":
     batch = 16
+elif VARIANT == "b20":
+    batch = 20
+elif VARIANT == "b28":
+    batch = 28
 elif VARIANT == "ce8192":
     kw["ce_chunk"] = 8192
 

@@ -138,6 +138,44 @@ def test_cli_status_and_list(ray_start_regular):
         [sys.executable, "-m", "ray_tpu.scripts", "list", "actors"],
         capture_output=True, text=True, timeout=60)
     assert "cli_actor" in out2.stdout
+    # predicate filters narrow server-side rows (ray list parity)
+    out3 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "list", "actors",
+         "--filter", "state=DEAD"],
+        capture_output=True, text=True, timeout=60)
+    assert out3.returncode == 0 and "cli_actor" not in out3.stdout
+    out4 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "list", "actors",
+         "--filter", "state=ALIVE", "--limit", "1"],
+        capture_output=True, text=True, timeout=60)
+    assert out4.returncode == 0 and len(
+        out4.stdout.strip().splitlines()) == 1
+
+
+def test_cli_logs_list_and_tail(ray_start_regular):
+    """``ray-tpu logs`` lists per-node worker logs and tails one
+    (parity: ``ray logs``)."""
+    ray = ray_start_regular
+
+    @ray.remote
+    def noisy():
+        print("marker-from-worker-log")
+        return 1
+
+    ray.get(noisy.remote())
+    listing = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "logs"],
+        capture_output=True, text=True, timeout=60)
+    assert listing.returncode == 0
+    names = [line.split()[-1]
+             for line in listing.stdout.strip().splitlines() if line]
+    worker_logs = [n for n in names if n.startswith("worker-")]
+    assert worker_logs, listing.stdout
+    tail = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "logs",
+         worker_logs[0]],
+        capture_output=True, text=True, timeout=60)
+    assert tail.returncode == 0
 
 
 def test_native_store_stats_exposed(ray_start_regular):
