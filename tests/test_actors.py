@@ -235,3 +235,60 @@ def test_detached_lifetime_named_get(ray_start_regular):
 
     D.options(name="d1", lifetime="detached").remote()
     assert ray.get(ray.get_actor("d1").hi.remote()) == "hi"
+
+
+def test_direct_result_push_edge_cases(ray_start_regular):
+    """Direct-channel result push: big results fall back to the CP
+    flow, error results raise through the push, and entries never
+    strand a get() (docs/PROTOCOL.md result push-back)."""
+    import numpy as np
+
+    ray = ray_start_regular
+
+    @ray.remote
+    class A:
+        def small(self, x):
+            return x * 2
+
+        def big(self):
+            # over inline_object_max_bytes: push sends the big marker
+            return np.zeros(400_000, np.uint8)
+
+        def boom(self):
+            raise RuntimeError("pushed-error")
+
+    a = A.remote()
+    assert ray.get(a.small.remote(21), timeout=30) == 42
+    arr = ray.get(a.big.remote(), timeout=30)
+    assert arr.nbytes == 400_000
+    with pytest.raises(RuntimeError, match="pushed-error"):
+        ray.get(a.boom.remote(), timeout=30)
+    # interleaving small/big/error keeps per-call results straight
+    refs = [a.small.remote(i) for i in range(20)]
+    assert ray.get(refs, timeout=30) == [i * 2 for i in range(20)]
+
+
+def test_direct_push_survives_actor_kill(ray_start_regular):
+    """A call in flight when the actor dies fails cleanly (the result
+    stream drops; the waiter falls back to the CP flow and the death
+    path resolves it)."""
+    ray = ray_start_regular
+    from ray_tpu.exceptions import ActorDiedError, TaskError
+
+    @ray.remote(max_restarts=0)
+    class Slow:
+        def nap(self, s):
+            time.sleep(s)
+            return "done"
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+    s = Slow.remote()
+    assert ray.get(s.pid.remote(), timeout=30) > 0
+    ref = s.nap.remote(30)
+    time.sleep(0.3)
+    ray.kill(s)
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray.get(ref, timeout=60)
