@@ -89,7 +89,8 @@ class StandardAutoscaler:
     """Monitor thread: scale the provider between min and max workers."""
 
     def __init__(self, provider: NodeProvider,
-                 config: Optional[AutoscalerConfig] = None):
+                 config: Optional[AutoscalerConfig] = None,
+                 engine: str = "v1"):
         self.provider = provider
         self.config = config or AutoscalerConfig()
         self._stop = threading.Event()
@@ -97,6 +98,14 @@ class StandardAutoscaler:
         self._idle_since: Dict[bytes, float] = {}
         self._thread: Optional[threading.Thread] = None
         self.events: List[str] = []  # human-readable scaling decisions
+        # engine="v2": demand decisions stay here, but launches and
+        # terminations flow through the instance reconciler, whose
+        # state machine heals stuck/failed launches across ticks
+        # (reference: autoscaler/v2/instance_manager/reconciler.py)
+        self.reconciler = None
+        if engine == "v2":
+            from ray_tpu.autoscaler.v2 import InstanceReconciler
+            self.reconciler = InstanceReconciler(provider)
 
     # -- cluster state -------------------------------------------------
     @staticmethod
@@ -118,8 +127,12 @@ class StandardAutoscaler:
                 namespace="_autoscaler", overwrite=True)
         except Exception:  # noqa: BLE001 - registry is best-effort
             pass
-        for _ in range(self.config.min_workers):
-            self.provider.create_node()
+        if self.reconciler is not None:
+            self.reconciler.set_target("default", self.config.min_workers)
+            self.reconciler.start()
+        else:
+            for _ in range(self.config.min_workers):
+                self.provider.create_node()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="autoscaler")
         self._thread.start()
@@ -139,9 +152,14 @@ class StandardAutoscaler:
                       for n in nodes.values())
         # the max_workers bound counts every provider node, including
         # ones still booting (not ALIVE yet) — otherwise slow startup
-        # lets sustained demand overshoot the cap
+        # lets sustained demand overshoot the cap.  In v2 mode the
+        # launch is async, so instances the reconciler is still
+        # materializing count too (they aren't provider-visible yet).
         provisioned = self.provider.non_terminated_nodes()
         managed = [nid for nid in provisioned if nid in nodes]
+        in_flight = (self.reconciler.live_count()
+                     if self.reconciler is not None
+                     else len(provisioned))
 
         # ---- scale up: sustained unservable demand, matched by SHAPE
         # (reference: resource_demand_scheduler.py — bin-pack pending
@@ -151,7 +169,8 @@ class StandardAutoscaler:
                 self._pending_since = now
             elif (now - self._pending_since >=
                   self.config.upscale_delay_s
-                  and len(provisioned) < self.config.max_workers):
+                  and max(len(provisioned), in_flight)
+                  < self.config.max_workers):
                 node_type = self._pick_node_type(nodes.values())
                 if node_type is not None:
                     # record the decision before the (blocking) launch —
@@ -160,9 +179,15 @@ class StandardAutoscaler:
                     self.events.append(
                         f"up: +{node_type} (pending={pending})")
                     self._pending_since = None
-                    node_id = self.provider.create_node(node_type)
-                    self.events.append(
-                        f"up: node {node_id.hex()[:8]} ready")
+                    if self.reconciler is not None:
+                        # async: the reconciler launches, retries a
+                        # stuck/failed create, and reports RAY_RUNNING
+                        # once the node joins
+                        self.reconciler.bump_target(node_type, +1)
+                    else:
+                        node_id = self.provider.create_node(node_type)
+                        self.events.append(
+                            f"up: node {node_id.hex()[:8]} ready")
         else:
             self._pending_since = None
 
@@ -236,7 +261,13 @@ class StandardAutoscaler:
                 if (now - self._idle_since[nid] >=
                         self.config.idle_timeout_s
                         and alive_count > self.config.min_workers):
-                    self.provider.terminate_node(nid)
+                    if self.reconciler is not None:
+                        if not self.reconciler.release_node(nid):
+                            # instance not releasable yet (reconciler
+                            # hasn't observed the node): retry next tick
+                            continue
+                    else:
+                        self.provider.terminate_node(nid)
                     self.events.append(f"down: -node {nid.hex()[:8]}")
                     self._idle_since.pop(nid, None)
                     alive_count -= 1
@@ -245,6 +276,8 @@ class StandardAutoscaler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.reconciler is not None:
+            self.reconciler.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
         # withdraw the shape registry: with no autoscaler to provision

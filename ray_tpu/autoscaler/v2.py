@@ -84,6 +84,38 @@ class InstanceReconciler:
         with self._lock:
             self.targets[node_type] = count
 
+    def bump_target(self, node_type: str, delta: int) -> None:
+        with self._lock:
+            self.targets[node_type] = max(
+                0, self.targets.get(node_type, 0) + delta)
+
+    def live_count(self) -> int:
+        """Instances being launched or running — callers enforcing a
+        max-nodes cap must count these, not just provider-visible
+        nodes, or demand overshoots the cap during a slow launch."""
+        live = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+        with self._lock:
+            return sum(1 for i in self.instances.values()
+                       if i.state in live)
+
+    def release_node(self, node_id: bytes) -> bool:
+        """Terminate the SPECIFIC instance running ``node_id`` (idle
+        scale-down chooses its victim; a bare target decrement would
+        let the reconciler pick an arbitrary one).  False when no
+        releasable instance matches (caller must not record a
+        termination that did not happen)."""
+        with self._lock:
+            for inst in self.instances.values():
+                if inst.node_id == node_id \
+                        and inst.state in (RAY_RUNNING, ALLOCATED):
+                    inst.to(TERMINATING)
+                    self.targets[inst.node_type] = max(
+                        0, self.targets.get(inst.node_type, 1) - 1)
+                    self._log(f"{inst.instance_id[:8]} released "
+                              f"({node_id.hex()[:8]} idle)")
+                    return True
+        return False
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="autoscaler-v2")
@@ -177,6 +209,11 @@ class InstanceReconciler:
         inst.retries += 1
         if inst.retries > self.config.max_retries:
             inst.to(FAILED)
+            # surrender the demand slot: leaving the target in place
+            # would queue a fresh instance every tick against a
+            # provider that keeps failing (quota, bad type)
+            self.targets[inst.node_type] = max(
+                0, self.targets.get(inst.node_type, 1) - 1)
             self._log(f"{inst.instance_id[:8]} FAILED: {why}")
         else:
             inst.node_id = None

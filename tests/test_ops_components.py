@@ -325,3 +325,47 @@ def test_autoscaler_unprovisionable_shape_fails_fast(ray_start_cluster):
         assert not sc.provider.non_terminated_nodes()
     finally:
         sc.stop()
+
+
+def test_autoscaler_v2_engine_up_and_down(ray_start_cluster):
+    """engine="v2": scale decisions flow through the instance
+    reconciler — launch lands via QUEUED->...->RAY_RUNNING, idle
+    scale-down releases the specific instance, and the table converges
+    (reference: autoscaler/v2/instance_manager/reconciler.py)."""
+    import ray_tpu
+    from ray_tpu.autoscaler import (AutoscalerConfig, LocalNodeProvider,
+                                    StandardAutoscaler)
+
+    sc = StandardAutoscaler(
+        LocalNodeProvider({"CPU": 2.0}),
+        AutoscalerConfig(max_workers=1, upscale_delay_s=0.3,
+                         idle_timeout_s=2.0, tick_s=0.2),
+        engine="v2")
+    sc.start()
+    try:
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(1.0)
+            return i
+
+        out = ray_tpu.get([work.remote(i) for i in range(6)],
+                          timeout=120)
+        assert sorted(out) == list(range(6))
+        deadline = time.time() + 40
+        while time.time() < deadline and not any(
+                "RAY_RUNNING" in e for e in sc.reconciler.events):
+            time.sleep(0.3)
+        assert any("RAY_RUNNING" in e for e in sc.reconciler.events), \
+            sc.reconciler.events
+        # idle reaping goes through release_node -> TERMINATED
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                sc.provider.non_terminated_nodes():
+            time.sleep(0.3)
+        assert not sc.provider.non_terminated_nodes(), \
+            sc.reconciler.events
+        assert any("released" in e for e in sc.reconciler.events)
+        summ = sc.reconciler.summary()
+        assert summ["instances"].get("TERMINATED", 0) >= 1
+    finally:
+        sc.stop()
