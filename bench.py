@@ -13,6 +13,8 @@ smoke runs scales the model down).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -33,6 +35,33 @@ def _chip_peak(device) -> float:
         if key in kind:
             return peak
     return 197.0
+
+
+def _kernel_smoke():
+    """Run the kernel numerics tests (CPU interpret mode) before paying
+    for a chip run: a broken kernel should fail loudly here, not show
+    up as a silent perf/loss regression.  Skips when pytest or the test
+    tree is absent (wheel installs); ``RAY_TPU_BENCH_SMOKE=0`` opts out.
+    """
+    if os.environ.get("RAY_TPU_BENCH_SMOKE", "1") == "0":
+        return
+    try:
+        import pytest  # noqa: F401
+    except ImportError:
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    target = os.path.join(here, "tests", "test_ops.py")
+    if not os.path.exists(target):
+        return
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         target],
+        cwd=here, env=env)
+    if proc.returncode:
+        print(json.dumps({"metric": "gpt2_train_tokens_per_sec_per_chip",
+                          "error": "kernel smoke tests failed"}))
+        sys.exit(proc.returncode)
 
 
 def main():
@@ -64,17 +93,39 @@ def main():
                              unroll_layers=True, ce_chunk=-1)
         batch, seq, steps = 24, 1024, 40
 
+    if not quick:
+        _kernel_smoke()
+
+    from ray_tpu.ops.attention import uses_pack2
     mesh = make_mesh(dp=len(devices), devices=devices)
-    fns = training.build_gpt_train(cfg, mesh)
+    # mirror of the kernel's own dispatch gate (head_dim/even heads/
+    # tileability), so the reported field matches what actually runs
+    attn_pack2 = uses_pack2(seq, seq, cfg.n_heads, cfg.head_dim)
+    fns = training.build_gpt_train(cfg, mesh, attn_pack2=attn_pack2)
     state = fns["init_fn"](jax.random.PRNGKey(0))
     batch_data = training.synthetic_lm_batch(
         jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
 
     # warmup / compile (float() forces a device round-trip: the axon
-    # tunnel's block_until_ready does not actually block)
-    for _ in range(2):
-        state, metrics = fns["step_fn"](state, batch_data)
-        float(metrics["loss"])
+    # tunnel's block_until_ready does not actually block).  The packed
+    # attention schedule is interpret-mode-tested by the preamble, but
+    # a Mosaic compile failure on new hardware must degrade to the
+    # single-head schedule loudly, not kill the headline number.
+    try:
+        for _ in range(2):
+            state, metrics = fns["step_fn"](state, batch_data)
+            float(metrics["loss"])
+    except Exception as e:
+        if not attn_pack2:
+            raise
+        print(f"pack2 schedule failed to compile/run ({e!r}); "
+              f"falling back to single-head kernels", file=sys.stderr)
+        attn_pack2 = False
+        fns = training.build_gpt_train(cfg, mesh, attn_pack2=False)
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        for _ in range(2):
+            state, metrics = fns["step_fn"](state, batch_data)
+            float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -105,8 +156,26 @@ def main():
         "chip_peak_tflops": peak,
         "mfu": round(tflops / peak, 4),
         "final_loss": round(float(metrics["loss"]), 4),
+        # which attention schedule the step actually ran (two-head lane
+        # packing engages at head_dim 64 / even heads; false also if
+        # the packed compile fell back above)
+        "attn_pack2": attn_pack2,
     }
     print(json.dumps(result))
+
+    if "--components" in sys.argv and not quick:
+        # step-component view: attention fwd+bwd in isolation, packed
+        # vs single-head, so a kernel A/B needs no xplane trace.  Skip
+        # the packed arm when the step itself fell back (its compile
+        # failure would re-raise here and eat the headline exit code).
+        from ray_tpu._private.ray_perf import attention_perf
+        arms = (True, False) if attn_pack2 else (False,)
+        for pack2 in arms:
+            comp = attention_perf(batch=batch, seq=seq,
+                                  heads=cfg.n_heads,
+                                  head_dim=cfg.head_dim, pack2=pack2)
+            comp["metric"] = "attention_fwd_bwd"
+            print(json.dumps(comp))
 
 
 if __name__ == "__main__":

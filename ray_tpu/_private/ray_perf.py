@@ -2,12 +2,17 @@
 
 Parity: reference ``python/ray/_private/ray_perf.py`` — same metric names
 so numbers are comparable line-for-line (`ray microbenchmark`).
+
+``attention_perf`` (``python -m ray_tpu._private.ray_perf --attn``) is
+the kernel-level entry: isolated flash-attention fwd+bwd throughput, so
+kernel A/Bs (e.g. pack2 on/off) no longer need a full xplane trace.
 """
 
 from __future__ import annotations
 
+import sys
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -27,6 +32,71 @@ def timeit(name: str, fn: Callable, multiplier: int = 1,
     rate = count * multiplier / dt
     print(f"{name} per second {rate:.2f}")
     return {"name": name, "rate": rate}
+
+
+def attention_perf(batch: int = 8, seq: int = 1024, heads: int = 12,
+                   head_dim: int = 64, steps: int = 30,
+                   causal: bool = True,
+                   pack2: Optional[bool] = None,
+                   rope: bool = True) -> Dict[str, float]:
+    """Isolated flash-attention fwd+bwd microbenchmark.
+
+    Times ``steps`` jitted grad evaluations of the flash kernel at the
+    bench shape and reports tokens/s plus *effective* TFLOPs — real
+    attention matmul FLOPs (2 fwd + 5 bwd score-shaped matmuls, halved
+    under the causal mask) over wall-clock, the figure the MXU-width
+    argument in ``docs/PERF.md`` is about.  ``pack2=None`` uses the
+    process config; pass True/False for an A/B without env games.
+
+    On CPU the kernels run in Pallas interpret mode — numbers are only
+    meaningful on a real chip, but the entry stays runnable anywhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kw = jax.random.split(key, 4)
+    shape = (batch, seq, heads, head_dim)
+    q = jax.random.normal(kq, shape, dtype)
+    k = jax.random.normal(kk, shape, dtype)
+    v = jax.random.normal(kv, shape, dtype)
+    w = jax.random.normal(kw, shape, dtype)   # fixed cotangent
+    positions = jnp.arange(seq) if rope else None
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, positions=positions,
+                            pack2=pack2)
+        return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    g = grad_fn(q, k, v)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = grad_fn(q, k, v)
+    jax.block_until_ready(g)
+    dt = (time.perf_counter() - t0) / steps
+
+    # 2 score-shaped matmuls fwd (s, o) + 5 bwd (s recompute, dp, dq,
+    # dk, dv), each 2*B*H*S^2*D flops; causal halves the live blocks
+    flops = 7 * 2 * batch * heads * seq * seq * head_dim
+    if causal:
+        flops /= 2
+    tok_s = batch * seq / dt
+    result = {
+        "name": f"attention fwd+bwd pack2={pack2}",
+        "ms_per_step": dt * 1e3,
+        "tokens_per_sec": tok_s,
+        "effective_tflops": flops / dt / 1e12,
+    }
+    print(f"{result['name']}: {result['ms_per_step']:.2f} ms  "
+          f"{tok_s:,.0f} tok/s  "
+          f"{result['effective_tflops']:.1f} eff TFLOPs")
+    return result
 
 
 def main(duration: float = 2.0) -> List[Dict[str, float]]:
@@ -135,8 +205,13 @@ def main(duration: float = 2.0) -> List[Dict[str, float]]:
 
 
 if __name__ == "__main__":
-    ray_tpu.init()
-    try:
-        main()
-    finally:
-        ray_tpu.shutdown()
+    if "--attn" in sys.argv:
+        # kernel A/B: packed vs single-head schedule, no cluster needed
+        attention_perf(pack2=True)
+        attention_perf(pack2=False)
+    else:
+        ray_tpu.init()
+        try:
+            main()
+        finally:
+            ray_tpu.shutdown()

@@ -46,7 +46,11 @@ def _state_shardings(init, param_sh, mesh) -> TrainState:
     replicate."""
     example = jax.eval_shape(init, jax.random.PRNGKey(0))
     shape_to_sh = {}
-    for (path, leaf), sh in zip(jax.tree.leaves_with_path(example.params),
+    # jax.tree.leaves_with_path appeared in 0.5; tree_util spelling works
+    # on the 0.4.x the container may pin
+    leaves_with_path = getattr(jax.tree, "leaves_with_path",
+                               jax.tree_util.tree_leaves_with_path)
+    for (path, leaf), sh in zip(leaves_with_path(example.params),
                                 jax.tree.leaves(param_sh)):
         shape_to_sh[leaf.shape] = sh
     replicated = NamedSharding(mesh, P())
@@ -63,13 +67,16 @@ def _batch_sharding(mesh):
 
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     optimizer=None,
-                    sp_impl: str = "ring") -> Dict[str, Callable]:
+                    sp_impl: str = "ring",
+                    attn_pack2: Optional[bool] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
     (state, metrics); batch = dict(tokens, targets) [B, S] int32.
     ``sp_impl``: how sequence parallelism moves data on sp>1 meshes —
     "ring" (ring attention) or "ulysses" (all-to-all head resharding).
+    ``attn_pack2`` pins the two-head lane-packed attention schedule for
+    A/B drivers (default: ``ray_tpu.ops.attention.attention_config``).
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
 
@@ -88,7 +95,8 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     else:
         attn_fn = make_flash_attention_fn(
             mesh, causal=True,
-            rope_theta=cfg.rope_theta if cfg.pos == "rope" else None)
+            rope_theta=cfg.rope_theta if cfg.pos == "rope" else None,
+            pack2=attn_pack2)
     batch_sh = _batch_sharding(mesh)
 
     def loss(params, batch):

@@ -10,14 +10,31 @@ O(B*S*H*D) instead of O(B*H*S^2).
 Layouts: public API takes ``[B, S, H, D]`` (model layout, matches
 ``ray_tpu.parallel.ring_attention``); kernels run over ``[B, H, S, D]``.
 
+Two-head lane packing (``pack2``): at head_dim 64 the score and
+probability·V matmuls drive the 128-wide MXU at half rate (the
+contraction or output dimension fills only 64 of 128 lanes).  When
+head_dim == 64 and the head count is even, pairs of heads are
+concatenated along the lane dimension — ``[B, H, S, 64]`` becomes
+``[B, H/2, S, 128]``, a pure reshape in the model layout — and the
+packed kernels keep the two heads' scores from mixing with a
+block-diagonal K/V arrangement: every MXU op is then
+``[block, 128] x [128, block]``-shaped (full-width contraction or
+full-width output) and the op *count* halves.  Controlled by
+``attention_config()`` (env ``RAY_TPU_ATTN_PACK2=0`` to disable); odd
+head counts, head_dim 128 and shapes the packed grid cannot tile fall
+back to the single-head schedule unchanged.
+
 Numerics: scores/stats in f32 regardless of input dtype; probability
-blocks are cast back to the value dtype for the MXU matmuls.  A
-numerics test vs the einsum path lives in ``tests/test_ops.py``.
+blocks are cast back to the value dtype for the MXU matmuls.  Numerics
+tests vs the einsum path (packed and unpacked) live in
+``tests/test_ops.py``.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -28,17 +45,53 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 STATS_LANES = 8   # lse/delta stored [B, H, num_q, bq, 8] for tiling
 
-# default causal-backward blocking (profiled on v5e at GPT-2 shapes;
-# env-overridable for per-shape A/B on new hardware)
-import os as _os  # noqa: E402
-_BWD_BQ = int(_os.environ.get("RAY_TPU_ATTN_BWD_BQ", "512"))
-_BWD_BK = int(_os.environ.get("RAY_TPU_ATTN_BWD_BK", "512"))
-# base-2 softmax: exp2 is the VPU-native transcendental; scores carry a
-# log2(e) factor so p = exp2(s2 - m2) == exp(s - m) exactly, one fewer
-# per-element multiply inside the hottest loop.  lse is stored in
-# base-2 units (fwd and bwd agree; nothing outside the kernels reads it)
-_EXP2 = _os.environ.get("RAY_TPU_ATTN_EXP2", "0") == "1"
-_LOG2E = 1.4426950408889634
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; support both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
+@dataclasses.dataclass(frozen=True)
+class AttentionConfig:
+    """Kernel-schedule knobs, resolved once from the environment.
+
+    The single home for attention env flags (scattered module-level
+    ``os.environ`` reads grew dead ends in round 5 — ``RAY_TPU_ATTN_EXP2``
+    was removed after A/B showed VPU exp is not the bottleneck):
+
+    - ``RAY_TPU_ATTN_BWD_BQ`` / ``RAY_TPU_ATTN_BWD_BK`` (default 512):
+      causal-backward blocking, profiled on v5e at GPT-2 shapes.
+    - ``RAY_TPU_ATTN_PACK2`` (default on; ``0`` disables): two-head lane
+      packing for head_dim-64 even-head attention (see module docstring).
+    - ``RAY_TPU_ATTN_PACK2_BQ`` / ``RAY_TPU_ATTN_PACK2_BK`` (default 512):
+      packed-kernel blocking — scores are [bq, 2*bk] so the packed
+      forward wants smaller blocks than the unpacked 1024 default.
+    """
+    bwd_block_q: int = 512
+    bwd_block_k: int = 512
+    pack2: bool = True
+    pack2_block_q: int = 512
+    pack2_block_k: int = 512
+
+
+_CONFIG: Optional[AttentionConfig] = None
+
+
+def attention_config(refresh: bool = False) -> AttentionConfig:
+    """The process-wide :class:`AttentionConfig` (env read once, cached).
+
+    ``refresh=True`` re-reads the environment — for tests and A/B
+    drivers that flip flags after import."""
+    global _CONFIG
+    if _CONFIG is None or refresh:
+        env = os.environ.get
+        _CONFIG = AttentionConfig(
+            bwd_block_q=int(env("RAY_TPU_ATTN_BWD_BQ", "512")),
+            bwd_block_k=int(env("RAY_TPU_ATTN_BWD_BK", "512")),
+            pack2=env("RAY_TPU_ATTN_PACK2", "1") != "0",
+            pack2_block_q=int(env("RAY_TPU_ATTN_PACK2_BQ", "512")),
+            pack2_block_k=int(env("RAY_TPU_ATTN_PACK2_BK", "512")),
+        )
+    return _CONFIG
 
 
 def _use_interpret() -> bool:
@@ -104,20 +157,9 @@ def _rot_t(g, cos2, sinm, D: int):
     return out.astype(g.dtype)
 
 
-def _exp(x):
-    return jnp.exp2(x) if _EXP2 else jnp.exp(x)
-
-
-def _log(x):
-    return jnp.log2(x) if _EXP2 else jnp.log(x)
-
-
 def _masked_scores(q, k, i, j, *, scale: float, causal: bool,
                    block_q: int, block_k: int):
-    """f32 scaled q@k^T for blocks (i, j) with the causal mask applied
-    (scores in base-2 units when _EXP2: scale carries the log2e)."""
-    if _EXP2:
-        scale = scale * _LOG2E
+    """f32 scaled q@k^T for blocks (i, j) with the causal mask applied."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale       # [bq, bk]
@@ -146,12 +188,106 @@ def _grad_blocks(q, k, v, do, lse, delta, i, j, *, scale: float,
     caller (which differ per kernel in what they accumulate)."""
     s = _masked_scores(q, k, i, j, scale=scale, causal=causal,
                        block_q=block_q, block_k=block_k)
-    p = _exp(s - lse)
+    p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)              # [bq, bk]
     ds = p * (dp - delta) * scale
     return p, ds
+
+
+# ---------------------------------------------------------------------------
+# two-head lane packing helpers
+#
+# Packed blocks are [rows, 2*Ds] with head A on lanes :Ds and head B on
+# lanes Ds: (Ds = 64, so 2*Ds = 128 = the MXU/VPU lane width).  The
+# block-diagonal arrangement
+#     kd = [[kA, 0], [0, kB]]        ([2*rows, 128])
+# makes one full-width matmul compute both heads without mixing:
+#     qp @ kd^T = [sA | sB]          ([bq, 2*bk], lanes annihilate the
+#                                     other head's q half)
+#     [pA | pB] @ vd = [pA@vA | pB@vB]  (packed output, one matmul)
+# ---------------------------------------------------------------------------
+
+def _lane_ids(rows: int, lanes: int = 128):
+    return jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1)
+
+
+def _half_mask(rows: int, sub_d: int):
+    """bool [rows, 2*sub_d]: True on the first head's lanes."""
+    return _lane_ids(rows, 2 * sub_d) < sub_d
+
+
+def _blockdiag2(x, sub_d: int):
+    """Packed rows [r, 2*sub_d] -> block-diagonal [2r, 2*sub_d]."""
+    m = _half_mask(x.shape[0], sub_d)
+    z = jnp.zeros_like(x)
+    return jnp.concatenate([jnp.where(m, x, z), jnp.where(m, z, x)], 0)
+
+
+def _fold2(t, bk: int, sub_d: int):
+    """Inverse of the block-diagonal output: [2*bk, 128] -> [bk, 128].
+
+    Row r of the top half carries head A's useful lanes :sub_d (the rest
+    is the cross-head product the packing must discard); row r of the
+    bottom half carries head B's lanes sub_d:."""
+    return jnp.where(_half_mask(bk, sub_d), t[:bk], t[bk:])
+
+
+def _roll_sub(x, sub_d: int):
+    """Lane roll by sub_d//2 *within* each sub_d-lane group of a packed
+    [rows, 2*sub_d] block (the per-sub-head RoPE half-swap).
+
+    A plain 128-lane rotate crosses the head boundary; two full rotates
+    select-combined per quarter implement the grouped rotate:
+    destination lane l wants source (l - sub_d/2) mod sub_d within its
+    group, which is roll(sub_d/2) for the upper half-group and
+    roll(sub_d/2 + sub_d) for the lower half-group."""
+    if _use_interpret():
+        r = x.shape[0]
+        return jnp.roll(x.reshape(r, 2, sub_d), sub_d // 2,
+                        axis=-1).reshape(r, 2 * sub_d)
+    lo = pltpu.roll(x, sub_d // 2, 1)
+    hi = pltpu.roll(x, sub_d // 2 + sub_d, 1)
+    return jnp.where(_lane_ids(x.shape[0]) % sub_d < sub_d // 2, hi, lo)
+
+
+def _rot2(x, cos2, sinm, sub_d: int):
+    """Per-sub-head RoPE on a packed [rows, 2*sub_d] block (tables are
+    the D=sub_d tables duplicated along lanes)."""
+    xf = x.astype(jnp.float32)
+    out = (xf * cos2.astype(jnp.float32)
+           + _roll_sub(xf, sub_d) * sinm.astype(jnp.float32))
+    return out.astype(x.dtype)
+
+
+def _rot2_t(g, cos2, sinm, sub_d: int):
+    gf = g.astype(jnp.float32)
+    out = (gf * cos2.astype(jnp.float32)
+           - _roll_sub(gf, sub_d) * sinm.astype(jnp.float32))
+    return out.astype(g.dtype)
+
+
+def _masked_scores2(qp, kd, i, j, *, scale: float, causal: bool,
+                    block_q: int, block_k: int):
+    """Packed scores [bq, 2*bk] for blocks (i, j): head A on columns
+    :bk, head B on columns bk:.  One [bq, 128] x [128, 2*bk] matmul —
+    the zeros in the block-diagonal ``kd`` annihilate the other head's
+    q lanes, so no separation mask is needed; the causal mask applies
+    per half (both heads sit at the same positions)."""
+    s = jax.lax.dot_general(
+        qp, kd, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [bq, 2*bk]
+    if causal:
+        q_idx = (i * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, 2 * block_k), 0))
+        k_idx = (j * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, 2 * block_k), 1)
+                 % block_k)
+        s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+    return s
 
 
 # ---------------------------------------------------------------------------
@@ -189,8 +325,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         m_prev = m_sc[:]                      # [bq, 128] (col-bcast)
         m_cur = jnp.max(s, axis=1, keepdims=True)          # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
-        alpha = _exp(m_prev - m_new)
-        p = _exp(s - m_new[:, :1])                         # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])                      # [bq, bk]
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, 1, keepdims=True)
         acc_sc[:] = (acc_sc[:] * alpha[:, :1]
                      + jax.lax.dot_general(
@@ -203,7 +339,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         l = l_sc[:, :1]
         o_ref[0, 0] = (acc_sc[:]
                        / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse = m_sc[:, :1] + _log(jnp.maximum(l, 1e-30))   # [bq, 1]
+        lse = m_sc[:, :1] + jnp.log(jnp.maximum(l, 1e-30))  # [bq, 1]
         lse_ref[0, 0, 0] = jnp.broadcast_to(lse, lse_ref.shape[3:])
 
 
@@ -236,7 +372,7 @@ def _fwd(q, k, v, *, scale: float, causal: bool,
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         in_specs=[
@@ -267,6 +403,133 @@ def _fwd(q, k, v, *, scale: float, causal: bool,
         interpret=_use_interpret(),
     )(q, k, v, *rope_args)
     return o, lse
+
+
+def _fwd_pack2_kernel(q_ref, k_ref, v_ref, *rest, scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      num_kv: int, has_rope: bool, sub_d: int):
+    """Packed forward: blocks are [bq, 128] head pairs; scores/stats run
+    per half while both matmuls go through the MXU at full lane width
+    (one [bq, 128] x [128, 2*bk] score op, one [bq, 2*bk] x [2*bk, 128]
+    accumulate op — half the op count of the unpacked pair)."""
+    if has_rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse0_ref, lse1_ref, acc_sc, m_sc, l_sc) = rest
+    else:
+        o_ref, lse0_ref, lse1_ref, acc_sc, m_sc, l_sc = rest
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k))
+    def _compute():
+        qp = q_ref[0, 0]                     # [bq, 128] packed pair
+        kp = k_ref[0, 0]                     # [bk, 128]
+        vp = v_ref[0, 0]
+        if has_rope:
+            qp = _rot2(qp, cq_ref[...], sq_ref[...], sub_d)
+            kp = _rot2(kp, ck_ref[...], sk_ref[...], sub_d)
+        kd = _blockdiag2(kp, sub_d)          # [2*bk, 128]
+        s = _masked_scores2(qp, kd, i, j, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+        s0, s1 = s[:, :block_k], s[:, block_k:]
+        m0_prev, m1_prev = m_sc[0], m_sc[1]  # [bq, 128] (col-bcast)
+        m0 = jnp.maximum(m0_prev, jnp.max(s0, axis=1, keepdims=True))
+        m1 = jnp.maximum(m1_prev, jnp.max(s1, axis=1, keepdims=True))
+        a0 = jnp.exp(m0_prev - m0)
+        a1 = jnp.exp(m1_prev - m1)
+        p0 = jnp.exp(s0 - m0[:, :1])
+        p1 = jnp.exp(s1 - m1[:, :1])
+        l_sc[0] = l_sc[0] * a0 + jnp.sum(p0, 1, keepdims=True)
+        l_sc[1] = l_sc[1] * a1 + jnp.sum(p1, 1, keepdims=True)
+        pd = jnp.concatenate([p0, p1], 1).astype(vp.dtype)
+        vd = _blockdiag2(vp, sub_d)          # [2*bk, 128]
+        alpha = jnp.where(_half_mask(block_q, sub_d), a0, a1)
+        acc_sc[:] = (acc_sc[:] * alpha
+                     + jax.lax.dot_general(
+                         pd, vd, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32))
+        m_sc[0] = m0
+        m_sc[1] = m1
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l0 = jnp.maximum(l_sc[0][:, :1], 1e-30)
+        l1 = jnp.maximum(l_sc[1][:, :1], 1e-30)
+        den = jnp.where(_half_mask(block_q, sub_d), l0, l1)
+        o_ref[0, 0] = (acc_sc[:] / den).astype(o_ref.dtype)
+        lse0 = m_sc[0][:, :1] + jnp.log(l0)               # [bq, 1]
+        lse1 = m_sc[1][:, :1] + jnp.log(l1)
+        lse0_ref[0, 0, 0] = jnp.broadcast_to(lse0, lse0_ref.shape[3:])
+        lse1_ref[0, 0, 0] = jnp.broadcast_to(lse1, lse1_ref.shape[3:])
+
+
+def _fwd_pack2(q, k, v, *, scale: float, causal: bool, block_q: int,
+               block_k: int, rope=None, sub_d: int = 64):
+    """Packed q,k,v: [B, Hp, S, 2*sub_d] -> (o packed, lse0, lse1 each
+    [B, Hp, S // bq, bq, STATS_LANES] f32 — per-sub-head row stats).
+
+    ``rope``: optional packed tables (cos2 [S, 128], sinm [S, 128] —
+    the D=sub_d tables duplicated along lanes)."""
+    B, Hp, S, Dp = q.shape
+    Sk = k.shape[2]
+    bq, bk = min(block_q, S), min(block_k, Sk)
+    grid = (B, Hp, S // bq, Sk // bk)
+    num_kv = grid[3]
+
+    kernel = functools.partial(
+        _fwd_pack2_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, num_kv=num_kv, has_rope=rope is not None,
+        sub_d=sub_d)
+    rope_args, rope_specs = (), []
+    if rope is not None:
+        cos2, sinm = rope
+        rope_args = (cos2, sinm, cos2, sinm)
+        rope_specs = [
+            pl.BlockSpec((bq, Dp), lambda b, h, i, j: (i, 0)),
+            pl.BlockSpec((bq, Dp), lambda b, h, i, j: (i, 0)),
+            pl.BlockSpec((bk, Dp), lambda b, h, i, j: (j, 0)),
+            pl.BlockSpec((bk, Dp), lambda b, h, i, j: (j, 0)),
+        ]
+    stats_spec = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                              lambda b, h, i, j: (b, h, i, 0, 0))
+    stats_shape = jax.ShapeDtypeStruct((B, Hp, S // bq, bq, STATS_LANES),
+                                       jnp.float32)
+    o, lse0, lse1 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, Dp), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, 0)),
+            *rope_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, Dp), lambda b, h, i, j: (b, h, i, 0)),
+            stats_spec,
+            stats_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hp, S, Dp), q.dtype),
+            stats_shape,
+            stats_shape,
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, Dp), jnp.float32),
+            pltpu.VMEM((2, bq, 128), jnp.float32),
+            pltpu.VMEM((2, bq, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, *rope_args)
+    return o, lse0, lse1
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +674,114 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
 
 
+def _bwd_pack2_kernel(q_ref, k_ref, v_ref, do_ref, lse0_ref, lse1_ref,
+                      delta0_ref, delta1_ref, *rest, scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      num_q: int, num_kv: int, has_rope: bool,
+                      sub_d: int):
+    """Packed strip-mined fused backward: the packed analogue of
+    `_bwd_fused_kernel` (same grid, same dead-strip skipping, same
+    rope-at-the-boundary structure), with every matmul full-width:
+
+        s  = qp @ kd^T          [bq, 128] x [128, 2*bk]
+        dp = do @ vd^T          [bq, 128] x [128, 2*bk]
+        dv = fold(pd^T @ do)    [2*bk, bq] x [bq, 128]
+        dk = fold(dsd^T @ qp)   [2*bk, bq] x [bq, 128]
+        dq = dsd @ kd           [bq, 2*bk] x [2*bk, 128]
+
+    — 5 ops per strip for a head *pair* vs 10 half-width ops on the
+    unpacked schedule.  ``fold`` keeps each half's own lanes and drops
+    the cross-head lanes the widened transpose matmuls produce."""
+    if has_rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc, krot_sc) = rest
+    else:
+        dq_ref, dk_ref, dv_ref, dq_sc, dk_sc, dv_sc = rest
+    i = pl.program_id(2)                        # q block index
+
+    @pl.when(i == 0)
+    def _init_kv():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+        if has_rope and num_kv > 1:
+            krot_sc[:] = _rot2(k_ref[0, 0], ck_ref[...], sk_ref[...],
+                               sub_d)
+
+    qp = q_ref[0, 0]                             # [bq, 128]
+    do = do_ref[0, 0]
+    if has_rope:
+        qp = _rot2(qp, cq_ref[...], sq_ref[...], sub_d)
+    lse0 = lse0_ref[0, 0, 0][:, 0:1]
+    lse1 = lse1_ref[0, 0, 0][:, 0:1]
+    delta0 = delta0_ref[0, 0, 0][:, 0:1]
+    delta1 = delta1_ref[0, 0, 0][:, 0:1]
+
+    def _strip_math(kp, vp, j):
+        kd = _blockdiag2(kp, sub_d)              # [2*bk, 128]
+        vd = _blockdiag2(vp, sub_d)
+        s = _masked_scores2(qp, kd, i, j, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+        p0 = jnp.exp(s[:, :block_k] - lse0)
+        p1 = jnp.exp(s[:, block_k:] - lse1)
+        dp = jax.lax.dot_general(
+            do, vd, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, 2*bk]
+        ds0 = p0 * (dp[:, :block_k] - delta0) * scale
+        ds1 = p1 * (dp[:, block_k:] - delta1) * scale
+        pd = jnp.concatenate([p0, p1], 1)
+        dsd = jnp.concatenate([ds0, ds1], 1)
+        return kd, pd, dsd
+
+    if num_kv == 1:
+        kp = k_ref[0, 0]
+        if has_rope:
+            kp = _rot2(kp, ck_ref[...], sk_ref[...], sub_d)
+        kd, pd, dsd = _strip_math(kp, v_ref[0, 0], 0)
+        dv_sc[:] += _fold2(jax.lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32), block_k, sub_d)
+        dk_sc[:] += _fold2(jax.lax.dot_general(
+            dsd.astype(qp.dtype), qp, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32), block_k, sub_d)
+        dq = jax.lax.dot_general(
+            dsd.astype(kd.dtype), kd, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+        for j in range(num_kv):
+            lo, hi = j * block_k, (j + 1) * block_k
+
+            @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                                 block_k=block_k))
+            def _strip(j=j, lo=lo, hi=hi):
+                if has_rope:
+                    kp = krot_sc[lo:hi, :]
+                else:
+                    kp = k_ref[0, 0, lo:hi, :]
+                kd, pd, dsd = _strip_math(kp, v_ref[0, 0, lo:hi, :], j)
+                dv_sc[lo:hi, :] += _fold2(jax.lax.dot_general(
+                    pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32), block_k, sub_d)
+                dk_sc[lo:hi, :] += _fold2(jax.lax.dot_general(
+                    dsd.astype(qp.dtype), qp, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32), block_k, sub_d)
+                dq_sc[:] += jax.lax.dot_general(
+                    dsd.astype(kd.dtype), kd, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        dq = dq_sc[:]
+    if has_rope:
+        dq = _rot2_t(dq, cq_ref[...], sq_ref[...], sub_d)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk = dk_sc[:]
+        if has_rope:
+            dk = _rot2_t(dk, ck_ref[...], sk_ref[...], sub_d)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_sc, dv_sc, *, scale: float,
                     causal: bool, block_q: int, block_k: int,
@@ -484,7 +855,7 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
                               num_q=num_q, num_kv=num_kv,
                               has_rope=rope is not None),
             grid=(B, H, num_q),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary")),
             in_specs=[qs, ks, ks, qs, rs, rs, *rope_specs],
@@ -513,7 +884,7 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, num_kv=num_kv),
         grid=(B, H, num_q, num_kv),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
@@ -532,7 +903,7 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, num_q=num_q),
         grid=(B, H, num_kv, num_q),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
@@ -543,6 +914,68 @@ def _bwd(q, k, v, o, lse, do, *, scale: float, causal: bool,
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _bwd_pack2(q, k, v, o, lse0, lse1, do, *, scale: float, causal: bool,
+               block_q: int, block_k: int, rope=None, sub_d: int = 64):
+    """Packed backward dispatcher (strip-mined fused path only — the
+    `flash_attention` gate keeps pack2 off for kv sequences whose
+    [Sk, 128] f32 dk/dv scratch would not fit VMEM)."""
+    B, Hp, S, Dp = q.shape
+    Sk = k.shape[2]
+    bq, bk = min(block_q, S), min(block_k, Sk)
+    num_q, num_kv = S // bq, Sk // bk
+    assert Sk * Dp * 4 * 2 <= 8 * 1024 * 1024, \
+        "packed backward needs the strip-mined fused path (moderate Sk)"
+    if lse0.shape[3] != bq:
+        lse0 = lse0.reshape(B, Hp, num_q, bq, STATS_LANES)
+        lse1 = lse1.reshape(B, Hp, num_q, bq, STATS_LANES)
+    # per-sub-head delta = sum(do * o) over each head's own lanes
+    prod = (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        B, Hp, S, 2, sub_d).sum(-1)                      # [B, Hp, S, 2]
+    delta0 = jnp.broadcast_to(
+        prod[..., 0].reshape(B, Hp, num_q, bq, 1),
+        (B, Hp, num_q, bq, STATS_LANES))
+    delta1 = jnp.broadcast_to(
+        prod[..., 1].reshape(B, Hp, num_q, bq, 1),
+        (B, Hp, num_q, bq, STATS_LANES))
+
+    qs = pl.BlockSpec((1, 1, bq, Dp), lambda b, h, i: (b, h, i, 0))
+    ks = pl.BlockSpec((1, 1, Sk, Dp), lambda b, h, i: (b, h, 0, 0))
+    rs = pl.BlockSpec((1, 1, 1, bq, STATS_LANES),
+                      lambda b, h, i: (b, h, i, 0, 0))
+    rope_args, rope_specs = (), []
+    if rope is not None:
+        cos2, sinm = rope
+        rope_args = (cos2, sinm, cos2, sinm)
+        rope_specs = [
+            pl.BlockSpec((bq, Dp), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((bq, Dp), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((Sk, Dp), lambda b, h, i: (0, 0)),
+            pl.BlockSpec((Sk, Dp), lambda b, h, i: (0, 0)),
+        ]
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_pack2_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, num_q=num_q,
+                          num_kv=num_kv, has_rope=rope is not None,
+                          sub_d=sub_d),
+        grid=(B, Hp, num_q),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        in_specs=[qs, ks, ks, qs, rs, rs, rs, rs, *rope_specs],
+        out_specs=[qs, ks, ks],
+        out_shape=[jax.ShapeDtypeStruct((B, Hp, S, Dp), q.dtype),
+                   jax.ShapeDtypeStruct((B, Hp, Sk, Dp), k.dtype),
+                   jax.ShapeDtypeStruct((B, Hp, Sk, Dp), v.dtype)],
+        scratch_shapes=(
+            [pltpu.VMEM((bq, Dp), jnp.float32),
+             pltpu.VMEM((Sk, Dp), jnp.float32),
+             pltpu.VMEM((Sk, Dp), jnp.float32)]
+            + ([pltpu.VMEM((Sk, Dp), q.dtype)]
+               if rope is not None else [])),
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse0, lse1, delta0, delta1, *rope_args)
     return dq, dk, dv
 
 
@@ -603,6 +1036,62 @@ def _flash_bhsd_rope_bwd(scale, causal, block_q, block_k, bwd_block_q,
 _flash_bhsd_rope.defvjp(_flash_bhsd_rope_fwd, _flash_bhsd_rope_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_pack2(q, k, v, scale, causal, block_q, block_k,
+                 bwd_block_q, bwd_block_k):
+    o, _, _ = _fwd_pack2(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k)
+    return o
+
+
+def _flash_pack2_fwd(q, k, v, scale, causal, block_q, block_k,
+                     bwd_block_q, bwd_block_k):
+    o, lse0, lse1 = _fwd_pack2(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return o, (q, k, v, o, lse0, lse1)
+
+
+def _flash_pack2_bwd(scale, causal, block_q, block_k, bwd_block_q,
+                     bwd_block_k, res, do):
+    q, k, v, o, lse0, lse1 = res
+    dq, dk, dv = _bwd_pack2(q, k, v, o, lse0, lse1, do, scale=scale,
+                            causal=causal, block_q=bwd_block_q,
+                            block_k=bwd_block_k)
+    return dq, dk, dv
+
+
+_flash_pack2.defvjp(_flash_pack2_fwd, _flash_pack2_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_pack2_rope(q, k, v, cos2, sinm, scale, causal, block_q,
+                      block_k, bwd_block_q, bwd_block_k):
+    o, _, _ = _fwd_pack2(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         rope=(cos2, sinm))
+    return o
+
+
+def _flash_pack2_rope_fwd(q, k, v, cos2, sinm, scale, causal, block_q,
+                          block_k, bwd_block_q, bwd_block_k):
+    o, lse0, lse1 = _fwd_pack2(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               rope=(cos2, sinm))
+    return o, (q, k, v, cos2, sinm, o, lse0, lse1)
+
+
+def _flash_pack2_rope_bwd(scale, causal, block_q, block_k, bwd_block_q,
+                          bwd_block_k, res, do):
+    q, k, v, cos2, sinm, o, lse0, lse1 = res
+    dq, dk, dv = _bwd_pack2(q, k, v, o, lse0, lse1, do, scale=scale,
+                            causal=causal, block_q=bwd_block_q,
+                            block_k=bwd_block_k, rope=(cos2, sinm))
+    return dq, dk, dv, None, None
+
+
+_flash_pack2_rope.defvjp(_flash_pack2_rope_fwd, _flash_pack2_rope_bwd)
+
+
 def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
              block_k: int = 1024) -> bool:
     """Shapes the kernel grid can tile (fallback to einsum otherwise)."""
@@ -611,13 +1100,51 @@ def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
             and bq % 8 == 0 and bk % 128 == 0)
 
 
+def _pack2_plan(S, Sk, H, D, causal, block_q, block_k, bwd_block_q,
+                bwd_block_k, pack2):
+    """(pbq, pbk, pbwq, pbwk) if the packed schedule applies, else None.
+
+    The single source of the pack2 dispatch decision — shared by
+    ``flash_attention`` and the reporting helper ``uses_pack2`` so the
+    bench can't claim a schedule the kernel silently declined."""
+    cfg = attention_config()
+    if pack2 is None:
+        pack2 = cfg.pack2
+    if not (pack2 and D == 64 and H % 2 == 0 and H > 0):
+        return None
+    Dp = 2 * D
+    pbq = min(block_q, cfg.pack2_block_q)
+    pbk = min(block_k, cfg.pack2_block_k)
+    pbwq = bwd_block_q if bwd_block_q is not None else \
+        (cfg.bwd_block_q if causal else pbq)
+    pbwk = bwd_block_k if bwd_block_k is not None else \
+        (cfg.bwd_block_k if causal else pbk)
+    pbwq, pbwk = min(pbq, pbwq), min(pbk, pbwk)
+    # packed backward only has the strip-mined fused path: dk/dv ride
+    # in [Sk, 128] f32 VMEM scratch
+    ok = (supports(S, Sk, Dp, block_q=pbq, block_k=pbk)
+          and supports(S, Sk, Dp, block_q=pbwq, block_k=pbwk)
+          and Sk * Dp * 4 * 2 <= 8 * 1024 * 1024)
+    return (pbq, pbk, pbwq, pbwk) if ok else None
+
+
+def uses_pack2(S: int, Sk: int, H: int, D: int, *, causal: bool = True,
+               block_q: int = 1024, block_k: int = 1024,
+               pack2: Optional[bool] = None) -> bool:
+    """Whether :func:`flash_attention` takes the packed schedule for
+    this shape under the current :func:`attention_config`."""
+    return _pack2_plan(S, Sk, H, D, causal, block_q, block_k, None,
+                       None, pack2) is not None
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 1024,
                     block_k: int = 1024,
                     bwd_block_q: Optional[int] = None,
                     bwd_block_k: Optional[int] = None,
                     positions=None,
-                    rope_theta: float = 10000.0):
+                    rope_theta: float = 10000.0,
+                    pack2: Optional[bool] = None):
     """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
 
     Drop-in for ``ray_tpu.parallel.ring_attention.local_attention``;
@@ -634,16 +1161,52 @@ def flash_attention(q, k, v, *, causal: bool = True,
     kernels (zero extra HBM passes) when the kv sequence fits one
     block; otherwise the rotation is applied here before dispatch
     (same math as ``ray_tpu.models.gpt._rope``).
+
+    ``pack2`` (default: :func:`attention_config`) selects the two-head
+    lane-packed schedule for head_dim 64 / even head counts; odd head
+    counts, other head dims and untileable shapes use the single-head
+    schedule regardless.
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
+    cfg = attention_config()
     if scale is None:
         scale = D ** -0.5
+    if positions is not None and S != Sk:
+        raise ValueError(f"rope needs q and kv positions to match: "
+                         f"S={S} vs Sk={Sk}")
+
+    plan = _pack2_plan(S, Sk, H, D, causal, block_q, block_k,
+                       bwd_block_q, bwd_block_k, pack2)
+    if plan is not None:
+        pbq, pbk, pbwq, pbwk = plan
+        Dp = 2 * D
+        fuse_rope = (positions is not None and S == Sk
+                     and Sk * Dp * 8 <= 8 * 1024 * 1024)
+        if positions is not None and not fuse_rope:
+            q = rope_rotate(q, positions, rope_theta)
+            k = rope_rotate(k, positions, rope_theta)
+        # pairing heads (2h, 2h+1) along lanes is a pure reshape in
+        # the [B, S, H, D] model layout
+        qp = jnp.swapaxes(q.reshape(B, S, H // 2, Dp), 1, 2)
+        kp = jnp.swapaxes(k.reshape(B, Sk, H // 2, Dp), 1, 2)
+        vp = jnp.swapaxes(v.reshape(B, Sk, H // 2, Dp), 1, 2)
+        if fuse_rope:
+            cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
+            cos2 = jnp.concatenate([cos2, cos2], -1)      # [S, 128]
+            sinm = jnp.concatenate([sinm, sinm], -1)
+            op = _flash_pack2_rope(qp, kp, vp, cos2, sinm, scale,
+                                   causal, pbq, pbk, pbwq, pbwk)
+        else:
+            op = _flash_pack2(qp, kp, vp, scale, causal, pbq, pbk,
+                              pbwq, pbwk)
+        return jnp.swapaxes(op, 1, 2).reshape(B, S, H, D)
+
     if bwd_block_q is None:
-        bwd_block_q = _BWD_BQ if causal else block_q
+        bwd_block_q = cfg.bwd_block_q if causal else block_q
         bwd_block_q = min(block_q, bwd_block_q)
     if bwd_block_k is None:
-        bwd_block_k = _BWD_BK if causal else block_k
+        bwd_block_k = cfg.bwd_block_k if causal else block_k
         bwd_block_k = min(block_k, bwd_block_k)
     kernel_ok = (supports(S, Sk, D, block_q=block_q, block_k=block_k)
                  and supports(S, Sk, D, block_q=bwd_block_q,
@@ -652,9 +1215,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # one block; bound matches _bwd's VMEM-scratch budget)
     fuse_rope = (positions is not None and kernel_ok
                  and S == Sk and Sk * D * 8 <= 8 * 1024 * 1024)
-    if positions is not None and S != Sk:
-        raise ValueError(f"rope needs q and kv positions to match: "
-                         f"S={S} vs Sk={Sk}")
     if positions is not None and not fuse_rope:
         q = rope_rotate(q, positions, rope_theta)
         k = rope_rotate(k, positions, rope_theta)
@@ -676,7 +1236,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
 
 def make_flash_attention_fn(mesh=None, *, causal: bool = True,
                             block_q: int = 1024, block_k: int = 1024,
-                            rope_theta: Optional[float] = None):
+                            rope_theta: Optional[float] = None,
+                            pack2: Optional[bool] = None):
     """Mesh-aware flash attention (drop-in for ``make_ring_attention_fn``).
 
     A ``pallas_call`` has no SPMD partitioning rule, so on a >1-device
@@ -687,9 +1248,15 @@ def make_flash_attention_fn(mesh=None, *, causal: bool = True,
     With ``rope_theta`` the returned fn accepts ``positions`` and
     applies RoPE inside the kernels (``fn.fused_rope`` marks this so
     the model skips its own rotation).
+
+    ``pack2`` pins the two-head lane-packing choice (default: the
+    process-wide :func:`attention_config`); note a tp-sharded mesh
+    hands each device its *local* head count, which is what the
+    even-head gate sees.
     """
     fn = functools.partial(flash_attention, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           pack2=pack2)
     if rope_theta is not None:
         fn = functools.partial(fn, rope_theta=rope_theta)
     if mesh is None or getattr(mesh, "size", 1) <= 1:

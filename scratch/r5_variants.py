@@ -11,16 +11,14 @@ import time
 VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
 
 # env knobs must land before ray_tpu imports read them
-if VARIANT == "exp2":
-    os.environ["RAY_TPU_ATTN_EXP2"] = "1"
-elif VARIANT == "ce_bf16":
+# (the r5 "exp2"/"exp2_ce" variants are gone: RAY_TPU_ATTN_EXP2 was a
+# measured dead end — +0.0 ms, VPU exp is not the bottleneck — and the
+# flag was removed from ops/attention.py in round 6)
+if VARIANT == "ce_bf16":
     os.environ["RAY_TPU_CE_BF16_RESID"] = "1"
 elif VARIANT == "bwd1024":
     os.environ["RAY_TPU_ATTN_BWD_BQ"] = "1024"
     os.environ["RAY_TPU_ATTN_BWD_BK"] = "1024"
-elif VARIANT == "exp2_ce":
-    os.environ["RAY_TPU_ATTN_EXP2"] = "1"
-    os.environ["RAY_TPU_CE_BF16_RESID"] = "1"
 elif VARIANT == "pnorm":
     os.environ["RAY_TPU_PALLAS_NORM"] = "1"
 elif VARIANT == "fqkv":

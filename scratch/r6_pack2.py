@@ -1,0 +1,68 @@
+"""Round-6 A/B: two-head lane-packed flash attention on the real chip.
+
+Usage: python scratch/r6_pack2.py <variant>
+
+Variants (one per process so env/config land before tracing):
+  pack2     — packed schedule, default blocks (the round-6 candidate)
+  nopack    — single-head schedule (the r05 recipe, control arm)
+  attn      — isolated attention fwd+bwd microbench, both schedules
+  pk256/pk1024 — packed-block sweep (RAY_TPU_ATTN_PACK2_BQ/BK)
+
+`pack2`/`nopack` time the full jitted train step at the bench shape
+(batch 24 x 1024, GPT-2 recipe from bench.py) — the number that decides
+whether the packed default stays on.  `attn` is the kernel-level view:
+if the full-step delta disagrees with the kernel-level delta, the
+difference is scheduling/fusion at the custom-call boundary, not MXU
+width (see docs/PERF.md round-5 lessons).
+"""
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "pack2"
+
+import os  # noqa: E402
+
+# block-sweep knobs must land before ray_tpu imports read the config
+if VARIANT == "pk256":
+    os.environ["RAY_TPU_ATTN_PACK2_BQ"] = "256"
+    os.environ["RAY_TPU_ATTN_PACK2_BK"] = "256"
+elif VARIANT == "pk1024":
+    os.environ["RAY_TPU_ATTN_PACK2_BQ"] = "1024"
+    os.environ["RAY_TPU_ATTN_PACK2_BK"] = "1024"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if VARIANT == "attn":
+    from ray_tpu._private.ray_perf import attention_perf
+    attention_perf(batch=24, seq=1024, heads=12, head_dim=64,
+                   pack2=True)
+    attention_perf(batch=24, seq=1024, heads=12, head_dim=64,
+                   pack2=False)
+    sys.exit(0)
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+pack2 = VARIANT != "nopack"
+batch, seq, steps = 24, 1024, 30
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+fns = training.build_gpt_train(cfg, mesh, attn_pack2=pack2)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for _ in range(2):
+    state, m = fns["step_fn"](state, bd)
+    float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(steps):
+    state, m = fns["step_fn"](state, bd)
+loss = float(m["loss"])
+dt = (time.perf_counter() - t0) / steps
+tok = batch * seq / dt
+print(f"{VARIANT} (pack2={pack2}): {dt*1e3:7.1f} ms/step  "
+      f"{tok:,.0f} tok/s  (vs_baseline {tok/255000:.3f})  "
+      f"loss {loss:.3f}", flush=True)

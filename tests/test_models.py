@@ -24,8 +24,10 @@ def test_gpt_logical_axes_match_params():
     cfg = GPTConfig.tiny(n_experts=2)
     params = init_params(cfg, jax.random.PRNGKey(0))
     axes = param_logical_axes(cfg)
-    pl = jax.tree.leaves_with_path(params)
-    al = jax.tree.leaves_with_path(
+    leaves_with_path = getattr(jax.tree, "leaves_with_path",
+                               jax.tree_util.tree_leaves_with_path)
+    pl = leaves_with_path(params)
+    al = leaves_with_path(
         axes, is_leaf=lambda x: isinstance(x, tuple))
     assert len(pl) == len(al)
     for (ppath, leaf), (apath, ax) in zip(pl, al):
