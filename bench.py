@@ -38,10 +38,14 @@ def _chip_peak(device) -> float:
 
 
 def _kernel_smoke():
-    """Run the kernel numerics tests (CPU interpret mode) before paying
-    for a chip run: a broken kernel should fail loudly here, not show
-    up as a silent perf/loss regression.  Skips when pytest or the test
-    tree is absent (wheel installs); ``RAY_TPU_BENCH_SMOKE=0`` opts out.
+    """Run the kernel numerics smoke subset (CPU interpret mode) before
+    paying for a chip run: a broken kernel should fail loudly here, not
+    show up as a silent perf/loss regression.  Scoped to the
+    ``kernel_smoke`` marker — the fast parity core of tests/test_ops.py
+    — so growing the full parity suite (e.g. the heavyweight flash-CE
+    V=50304 cases) does not inflate the paid preamble.  Skips when
+    pytest or the test tree is absent (wheel installs);
+    ``RAY_TPU_BENCH_SMOKE=0`` opts out.
     """
     if os.environ.get("RAY_TPU_BENCH_SMOKE", "1") == "0":
         return
@@ -56,7 +60,7 @@ def _kernel_smoke():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
-         target],
+         "-m", "kernel_smoke", target],
         cwd=here, env=env)
     if proc.returncode:
         print(json.dumps({"metric": "gpt2_train_tokens_per_sec_per_chip",
@@ -96,36 +100,83 @@ def main():
     if not quick:
         _kernel_smoke()
 
+    import dataclasses
+
     from ray_tpu.ops.attention import uses_pack2
+    from ray_tpu.ops.flash_ce import uses_flash_ce
     mesh = make_mesh(dp=len(devices), devices=devices)
-    # mirror of the kernel's own dispatch gate (head_dim/even heads/
-    # tileability), so the reported field matches what actually runs
+    # mirrors of the kernels' own dispatch gates (head_dim/even heads/
+    # tileability for pack2; mode/model-dim for flash-CE), so the
+    # reported fields match what actually runs.  flash-CE only engages
+    # on a single-device mesh (pallas_call has no SPMD rule).
     attn_pack2 = uses_pack2(seq, seq, cfg.n_heads, cfg.head_dim)
-    fns = training.build_gpt_train(cfg, mesh, attn_pack2=attn_pack2)
-    state = fns["init_fn"](jax.random.PRNGKey(0))
+    ce_flash = (not quick
+                and uses_flash_ce(batch * seq, cfg.d_model,
+                                  cfg.vocab_size,
+                                  n_devices=len(devices)))
+    # pin "flash" so a fallback can turn it off ("xla") without env
+    # games; None respects the env-resolved config (e.g. RAY_TPU_CE=
+    # fused stays measurable through the bench).  Quick mode pins
+    # "xla" outright — its small shapes pass supports(), and an
+    # unreported interpret-mode flash run would falsify the `ce` field.
+    ce_pin = "flash" if ce_flash else ("xla" if quick else None)
+
+    def ce_name(cfg, pin):
+        from ray_tpu.ops.flash_ce import ce_config
+        if pin == "flash":
+            return "flash"
+        # fused is plain XLA and dispatches on any mesh (no device gate
+        # — mirror of gpt._chunked_ce)
+        if (pin is None and ce_config().mode == "fused"
+                and cfg.ce_chunk < 0):
+            return "fused"
+        return "noremat" if cfg.ce_chunk < 0 else "chunked"
+
+    def build(cfg, pack2, ce_pin):
+        fns = training.build_gpt_train(cfg, mesh, attn_pack2=pack2,
+                                       ce_mode=ce_pin)
+        return fns, fns["init_fn"](jax.random.PRNGKey(0))
+
+    fns, state = build(cfg, attn_pack2, ce_pin)
     batch_data = training.synthetic_lm_batch(
         jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
 
     # warmup / compile (float() forces a device round-trip: the axon
-    # tunnel's block_until_ready does not actually block).  The packed
-    # attention schedule is interpret-mode-tested by the preamble, but
-    # a Mosaic compile failure on new hardware must degrade to the
-    # single-head schedule loudly, not kill the headline number.
-    try:
-        for _ in range(2):
-            state, metrics = fns["step_fn"](state, batch_data)
-            float(metrics["loss"])
-    except Exception as e:
-        if not attn_pack2:
-            raise
-        print(f"pack2 schedule failed to compile/run ({e!r}); "
-              f"falling back to single-head kernels", file=sys.stderr)
-        attn_pack2 = False
-        fns = training.build_gpt_train(cfg, mesh, attn_pack2=False)
-        state = fns["init_fn"](jax.random.PRNGKey(0))
-        for _ in range(2):
-            state, metrics = fns["step_fn"](state, batch_data)
-            float(metrics["loss"])
+    # tunnel's block_until_ready does not actually block).  Both Pallas
+    # schedules are interpret-mode-tested by the preamble, but a Mosaic
+    # compile failure on new hardware must degrade loudly, not kill the
+    # headline number.  Fallback ladder, most-capable first — each rung
+    # isolates one suspect, so a pack2-only failure still measures with
+    # flash-CE restored rather than riding the CE degradation down:
+    # flash-CE off -> pack2 off (flash back) -> both off -> chunked CE.
+    fallbacks = []
+    if ce_flash:
+        fallbacks.append(("flash-CE -> no-remat CE",
+                          (cfg, attn_pack2, "xla")))
+    if attn_pack2:
+        if ce_flash:
+            fallbacks.append(
+                ("single-head attention kernels, flash-CE restored",
+                 (cfg, False, "flash")))
+        fallbacks.append(("single-head attention kernels, no flash-CE",
+                          (cfg, False, "xla" if ce_flash else ce_pin)))
+    if cfg.ce_chunk < 0:
+        fallbacks.append(("chunked CE (last resort)",
+                          (dataclasses.replace(cfg, ce_chunk=4096),
+                           False, "xla")))
+    while True:
+        try:
+            for _ in range(2):
+                state, metrics = fns["step_fn"](state, batch_data)
+                float(metrics["loss"])
+            break
+        except Exception as e:
+            if not fallbacks:
+                raise
+            what, (cfg, attn_pack2, ce_pin) = fallbacks.pop(0)
+            print(f"step failed to compile/run ({e!r}); "
+                  f"falling back: {what}", file=sys.stderr)
+            fns, state = build(cfg, attn_pack2, ce_pin)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -156,25 +207,34 @@ def main():
         "chip_peak_tflops": peak,
         "mfu": round(tflops / peak, 4),
         "final_loss": round(float(metrics["loss"]), 4),
-        # which attention schedule the step actually ran (two-head lane
-        # packing engages at head_dim 64 / even heads; false also if
-        # the packed compile fell back above)
+        # which schedules the step actually ran (false/"noremat" also
+        # if a Pallas compile fell back above): two-head lane-packed
+        # attention, and the CE path (flash/noremat/chunked)
         "attn_pack2": attn_pack2,
+        "ce": ce_name(cfg, ce_pin),
     }
     print(json.dumps(result))
 
     if "--components" in sys.argv and not quick:
-        # step-component view: attention fwd+bwd in isolation, packed
-        # vs single-head, so a kernel A/B needs no xplane trace.  Skip
-        # the packed arm when the step itself fell back (its compile
-        # failure would re-raise here and eat the headline exit code).
-        from ray_tpu._private.ray_perf import attention_perf
+        # step-component view: attention fwd+bwd and the CE loss head
+        # in isolation, custom schedule vs control, so a kernel A/B
+        # needs no xplane trace.  Skip a custom arm when the step
+        # itself fell back (its compile failure would re-raise here and
+        # eat the headline exit code).
+        from ray_tpu._private.ray_perf import attention_perf, ce_perf
         arms = (True, False) if attn_pack2 else (False,)
         for pack2 in arms:
             comp = attention_perf(batch=batch, seq=seq,
                                   heads=cfg.n_heads,
                                   head_dim=cfg.head_dim, pack2=pack2)
             comp["metric"] = "attention_fwd_bwd"
+            print(json.dumps(comp))
+        ce_arms = ("flash", "noremat") if ce_pin == "flash" \
+            else ("noremat",)
+        for mode in ce_arms:
+            comp = ce_perf(n_tokens=batch * seq, d_model=cfg.d_model,
+                           vocab=cfg.vocab_size, mode=mode)
+            comp["metric"] = "ce_fwd_bwd"
             print(json.dumps(comp))
 
 

@@ -6,6 +6,9 @@ so numbers are comparable line-for-line (`ray microbenchmark`).
 ``attention_perf`` (``python -m ray_tpu._private.ray_perf --attn``) is
 the kernel-level entry: isolated flash-attention fwd+bwd throughput, so
 kernel A/Bs (e.g. pack2 on/off) no longer need a full xplane trace.
+``ce_perf`` (``--ce``) is the same for the loss head: isolated CE
+fwd+bwd at the bench shape, flash-CE (streamed-logits Pallas kernel)
+vs the no-remat XLA control.
 """
 
 from __future__ import annotations
@@ -96,6 +99,80 @@ def attention_perf(batch: int = 8, seq: int = 1024, heads: int = 12,
     print(f"{result['name']}: {result['ms_per_step']:.2f} ms  "
           f"{tok_s:,.0f} tok/s  "
           f"{result['effective_tflops']:.1f} eff TFLOPs")
+    return result
+
+
+def ce_perf(n_tokens: int = 24576, d_model: int = 768,
+            vocab: int = 50304, steps: int = 20,
+            mode: str = "flash") -> Dict[str, float]:
+    """Isolated cross-entropy loss-head fwd+bwd microbenchmark.
+
+    Times ``steps`` jitted grad evaluations of ``(sum_nll / n)`` w.r.t.
+    (x, head) at the bench shape and reports ms plus *effective* MXU
+    TFLOPs — each arm's real vocab-matmul count (flash: 4 = fwd +
+    recompute + dX + dHead; no-remat: 3 = fwd + dX + dHead) over
+    wall-clock.  This is the "is the Pallas matmul competitive with
+    XLA's 150+ TFLOPs" number ``docs/PERF.md`` gates the flash-CE
+    default on; note the no-remat arm *also* pays ~17 ms of HBM-rate
+    reduce passes the FLOP figure does not credit, so compare
+    ``ms_per_step``, not TFLOPs, for the end decision.
+
+    ``mode``: "flash" (Pallas kernel, pinned via explicit call) or
+    "noremat" (dense XLA formulation, logits resident between passes).
+    On CPU the kernel runs in Pallas interpret mode — numbers are only
+    meaningful on a real chip, but the entry stays runnable anywhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    kx, kh, kt = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (n_tokens, d_model), dtype)
+    head = (jax.random.normal(kh, (d_model, vocab), jnp.float32)
+            * 0.02).astype(dtype)
+    targets = jax.random.randint(kt, (n_tokens,), 0, vocab)
+
+    # the control arm goes through the model's own CE glue
+    # (gpt._chunked_ce pinned to mode="xla", chunk=-1), so the
+    # microbench control is the literal no-remat path the dispatch
+    # would run, not a lookalike that could drift
+    if mode == "flash":
+        def ce(x, head):
+            return flash_ce_sum(x, head, targets)
+    else:
+        from ray_tpu.models.gpt import _chunked_ce
+
+        def ce(x, head):
+            return _chunked_ce(x, head, targets, chunk=-1, mode="xla")
+
+    def loss(x, head):
+        s, n = ce(x, head)
+        return s / n
+
+    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+    out = grad_fn(x, head)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = grad_fn(x, head)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / steps
+
+    matmuls = 4 if mode == "flash" else 3
+    flops = matmuls * 2 * n_tokens * d_model * vocab
+    result = {
+        "name": f"ce fwd+bwd mode={mode}",
+        "ms_per_step": dt * 1e3,
+        "tokens_per_sec": n_tokens / dt,
+        "effective_tflops": flops / dt / 1e12,
+    }
+    print(f"{result['name']}: {result['ms_per_step']:.2f} ms  "
+          f"{result['tokens_per_sec']:,.0f} tok/s  "
+          f"{result['effective_tflops']:.1f} eff TFLOPs "
+          f"({matmuls} vocab matmuls)")
     return result
 
 
@@ -209,6 +286,10 @@ if __name__ == "__main__":
         # kernel A/B: packed vs single-head schedule, no cluster needed
         attention_perf(pack2=True)
         attention_perf(pack2=False)
+    elif "--ce" in sys.argv:
+        # loss-head A/B: streamed-logits Pallas CE vs no-remat XLA
+        ce_perf(mode="flash")
+        ce_perf(mode="noremat")
     else:
         ray_tpu.init()
         try:

@@ -196,14 +196,16 @@ def param_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     return axes
 
 
-# env-gated alternate paths for per-shape A/B (both step-neutral or
-# slightly negative at the v5e GPT-2 bench shape — XLA's scheduler
-# already overlaps the traffic they remove — but they cut resident/
-# streamed bytes, which matters in memory-bound regimes):
+# env-gated alternate norm path for per-shape A/B (step-neutral at the
+# v5e GPT-2 bench shape — XLA's scheduler already overlaps the traffic
+# it removes — but it cuts streamed bytes, which matters in
+# memory-bound regimes):
 #   PALLAS_NORM — fused rmsnorm fwd/bwd kernel (ops/rmsnorm.py)
-#   FUSED_CE — bf16-resident logits via ops/fused_ce.py custom vjp
+# The CE path knobs live in ray_tpu.ops.flash_ce.ce_config() (env
+# RAY_TPU_CE; the r05 RAY_TPU_CE_BF16_RESID astype round-trip was
+# measured dead (+2.5 ms) and removed, RAY_TPU_FUSED_CE folded in as
+# RAY_TPU_CE=fused — same consolidation as r06's attention_config).
 _PALLAS_NORM = os.environ.get("RAY_TPU_PALLAS_NORM", "0") == "1"
-_FUSED_CE = os.environ.get("RAY_TPU_FUSED_CE", "0") == "1"
 
 
 def _norm(x, scale, kind: str, bias=None, eps: float = 1e-6):
@@ -342,13 +344,21 @@ def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     return constrain(x, ("batch", "seq", None))
 
 
-def loss_from_hidden(params, x, targets, cfg: GPTConfig):
+def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
+                     ce_mode: Optional[str] = None):
     """(final *normed* hidden [B,S,d], targets [B,S]) -> mean NLL
-    (chunked-CE glue shared by the dense and pipeline-parallel trainers)."""
+    (CE glue shared by the dense and pipeline-parallel trainers).
+
+    ``ce_mode`` pins the CE schedule for A/B drivers (default: the
+    process-wide ``ray_tpu.ops.flash_ce.ce_config``); ``mesh`` gates
+    the Pallas paths to single-device meshes (a ``pallas_call`` has no
+    SPMD rule, so on a sharded mesh the XLA formulations run instead —
+    lifting that with a shard_map wrapper is an open item)."""
     B, S, d = x.shape
     s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
                        targets.reshape(B * S),
-                       chunk=getattr(cfg, "ce_chunk", _CE_CHUNK))
+                       chunk=getattr(cfg, "ce_chunk", _CE_CHUNK),
+                       mesh=mesh, mode=ce_mode)
     return s / jnp.maximum(n, 1.0)
 
 
@@ -411,20 +421,40 @@ def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
 # from (x, head) — one extra matmul per chunk for O(chunk * V) transient
 # memory instead of O(B * S * V) resident.
 _CE_CHUNK = 4096
-# bf16 logit residuals for the no-remat CE (env-gated for perf A/B)
-_CE_BF16_RESID = os.environ.get("RAY_TPU_CE_BF16_RESID", "0") == "1"
 
 
-def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
+def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK, mesh=None,
+                mode: Optional[str] = None):
     """x [N, d] (bf16 ok), head [d, V], targets [N] -> (sum_nll, n_valid).
 
-    Chunks are a *python* loop (static N): a lax.scan here stashes its
-    residuals with dynamic-update-slice, which profiles slower than the
-    unrolled chunks whose remat boundaries XLA schedules freely.
+    Dispatch order (``mode`` defaults to ``flash_ce.ce_config().mode``):
+
+    - ``flash``: streamed-logits Pallas CE (``ops/flash_ce.py``) — the
+      [N, V] logits exist only as VMEM tiles in both passes; engages
+      for supported shapes on single-device meshes regardless of
+      ``chunk`` (it strictly dominates both XLA formulations on
+      memory).
+    - ``fused``: bf16-resident-logit custom vjp (``ops/fused_ce.py``),
+      no-remat (``chunk < 0``) only.
+    - ``xla`` (or any decline above): the ``chunk``-driven XLA paths —
+      ``chunk < 0`` no-remat (backward reuses saved f32 logits),
+      ``chunk > 0`` row-chunked remat.  Chunks are a *python* loop
+      (static N): a lax.scan here stashes its residuals with
+      dynamic-update-slice, which profiles slower than the unrolled
+      chunks whose remat boundaries XLA schedules freely.
     """
+    from ray_tpu.ops import flash_ce
     N, d = x.shape
+    if mode is None:
+        mode = flash_ce.ce_config().mode
+    single_dev = mesh is None or getattr(mesh, "size", 1) <= 1
+    if (mode == "flash" and single_dev
+            and flash_ce.supports(N, d, head.shape[1])):
+        return flash_ce.flash_ce_sum(x, head.astype(x.dtype), targets)
     remat = chunk >= 0
-    if not remat and _FUSED_CE:
+    # fused is plain XLA (no pallas_call), so unlike flash it needs no
+    # single-device gate — it shards like the formulations below
+    if not remat and mode == "fused":
         from ray_tpu.ops.fused_ce import ce_sum_bf16
         return ce_sum_bf16(x, head.astype(x.dtype), targets)
     if chunk <= 0:
@@ -433,13 +463,6 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
     def chunk_loss(xc, tc):
         logits = jnp.einsum("nd,dv->nv", xc, head,
                             preferred_element_type=jnp.float32)
-        if not remat and _CE_BF16_RESID:
-            # no-remat: the [N, V] logits live between fwd and bwd.
-            # Storing them bf16 halves that residual's HBM traffic
-            # (~2.4 GB at the bench shape); lse/loss still accumulate
-            # in f32 from the rounded values, and the bwd softmax from
-            # bf16 logits is well within grad noise.
-            logits = logits.astype(jnp.bfloat16).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         true = jnp.take_along_axis(
             logits, jnp.maximum(tc, 0)[:, None], axis=-1)[:, 0]
@@ -459,11 +482,12 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK):
 
 
 def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
-            aux_weight: float = 0.01):
+            aux_weight: float = 0.01, ce_mode: Optional[str] = None):
     """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss."""
     x, aux = forward_hidden(params, batch["tokens"], cfg, attn_fn=attn_fn,
                             mesh=mesh)
-    loss = loss_from_hidden(params, x, batch["targets"], cfg)
+    loss = loss_from_hidden(params, x, batch["targets"], cfg, mesh=mesh,
+                            ce_mode=ce_mode)
     return loss + aux_weight * aux
 
 

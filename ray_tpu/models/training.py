@@ -68,7 +68,8 @@ def _batch_sharding(mesh):
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     optimizer=None,
                     sp_impl: str = "ring",
-                    attn_pack2: Optional[bool] = None) -> Dict[str, Callable]:
+                    attn_pack2: Optional[bool] = None,
+                    ce_mode: Optional[str] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
@@ -76,7 +77,9 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     ``sp_impl``: how sequence parallelism moves data on sp>1 meshes —
     "ring" (ring attention) or "ulysses" (all-to-all head resharding).
     ``attn_pack2`` pins the two-head lane-packed attention schedule for
-    A/B drivers (default: ``ray_tpu.ops.attention.attention_config``).
+    A/B drivers (default: ``ray_tpu.ops.attention.attention_config``);
+    ``ce_mode`` pins the loss-head schedule the same way ("flash" /
+    "fused" / "xla"; default: ``ray_tpu.ops.flash_ce.ce_config``).
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
 
@@ -101,7 +104,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
 
     def loss(params, batch):
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
-                               mesh=mesh)
+                               mesh=mesh, ce_mode=ce_mode)
 
     def init(key) -> TrainState:
         params = gpt_mod.init_params(cfg, key)
@@ -221,7 +224,8 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         h = gpt_mod._norm(h, params["ln_f"], cfg.norm,
                           bias=params.get("ln_f_b"),
                           eps=1e-5 if cfg.use_bias else 1e-6)
-        return gpt_mod.loss_from_hidden(params, h, targets, cfg)
+        return gpt_mod.loss_from_hidden(params, h, targets, cfg,
+                                        mesh=mesh)
 
     st_sh = _state_shardings(init, param_sh, mesh)
     init_jit = jax.jit(init, out_shardings=st_sh)

@@ -1,0 +1,420 @@
+"""Flash-CE: streamed-logits Pallas cross-entropy (fused vocab matmul
++ online logsumexp).
+
+The loss block is the largest serialized chunk of the GPT-2 step after
+the r06 attention rework: the no-remat CE writes a resident 4.9 GB f32
+``[24576, 50304]`` logits tensor, reads it back for the lse/true-logit
+reduces (~17 ms at HBM rate), keeps it alive across the whole backward,
+and reads it a third time for the grad matmuls.  None of those passes
+do MXU work — they only exist because XLA cannot compute a reduction
+*inside* a matmul epilogue.
+
+This kernel can.  Forward is a blocked matmul over the vocab dimension
+whose epilogue maintains flash-attention-style online row statistics:
+
+    for each vocab tile j:                    # [block_n, block_v] VMEM
+        s    = x_blk @ head_blk               # MXU, f32 accumulation
+        m    = max(m, rowmax(s))              # online max
+        l    = l * exp(m_prev - m) + rowsum(exp(s - m))
+        true += s[row, target[row]]           # one-hot dot, VPU select
+
+so the ``[N, V]`` logits exist only as VMEM tiles — forward emits
+``(sum_nll, n_valid)`` with only ``[N]``-sized residuals (lse and the
+inputs), never touching HBM with anything vocab-sized.  Backward is
+strip-mined the same way: each logits tile is recomputed in the input
+dtype (bf16 on chip, f32 accumulation), ``dl = (p - onehot) * g·mask``
+is formed in VMEM and fused straight into *both* grad matmuls:
+
+    dx_blk   += dl @ head_blk^T               # accumulated in VMEM
+    dhead[j] += x_blk^T @ dl                  # per-(row,vocab) partial
+
+dx accumulates across the sequential vocab sweep in VMEM scratch (the
+``ops/attention.py`` strip/accumulator idiom); dhead contributions are
+emitted as per-row-block partials ``[N/block_n, d, V]`` and summed in
+one XLA pass — the only vocab-sized HBM tensor in the whole path, a
+write-once/read-once transient at ~1/13th the traffic of the logits
+residual it replaces (and it vanishes from the *resident* footprint,
+which is what re-opens the batch-32 probe the r05 recipe was capped
+by).  Total matmul work is 4 vocab-matmul-equivalents (fwd, bwd
+recompute, dx, dhead) vs the no-remat path's 3 — the bet recorded in
+``docs/PERF.md`` is that one extra matmul at MXU rate beats 17 ms of
+serialized HBM-rate reduces, *iff* the Pallas matmul is competitive
+with XLA's 150+ TFLOPs at ``[24576, 768] x [768, 50304]``.
+
+Handles: masked ``-1`` targets (excluded from both loss and grads),
+vocab sizes that are not a multiple of the block (lane-aligned padding
+with in-kernel column masking — V=50304 pads to the block grid, padded
+columns contribute exp(-inf)=0), and row counts that are not a multiple
+of ``block_n`` (zero-padded rows with ``-1`` targets).
+
+Dispatch is owned by :func:`ce_config` — the single home for CE env
+knobs (the round-5 ``RAY_TPU_CE_BF16_RESID`` astype round-trip was
+measured dead (+2.5 ms: XLA materializes the f32 tensor anyway) and is
+removed; ``RAY_TPU_FUSED_CE`` folded in as ``RAY_TPU_CE=fused``).
+Unsupported shapes fall back to the dense XLA formulation; a Mosaic
+compile failure on new hardware degrades loudly via ``bench.py``'s
+fallback chain (flash → no-remat → chunked).
+
+Reference role: the loss path of the reference's torch trainers
+(``F.cross_entropy`` in ``train/torch/train_loop_utils.py``); the
+streamed-logits design is TPU-first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# one home for the Pallas infrastructure shims: the jax-version
+# CompilerParams rename shim, interpret-mode policy, and the lane-padded
+# row-stats convention are shared with the attention kernels
+from ray_tpu.ops.attention import (_NEG_INF, STATS_LANES,
+                                   _CompilerParams, _use_interpret)
+
+
+@dataclasses.dataclass(frozen=True)
+class CEConfig:
+    """Loss-head schedule knobs, resolved once from the environment.
+
+    The single home for CE env flags (consolidation precedent: r06's
+    ``attention_config``; the dead ``RAY_TPU_CE_BF16_RESID`` knob was
+    removed and ``RAY_TPU_FUSED_CE`` folded into ``mode``):
+
+    - ``RAY_TPU_CE`` (default ``flash``): which CE custom path the
+      model's loss head dispatches to for supported shapes —
+      ``flash`` (this kernel), ``fused`` (bf16-resident logits,
+      ``ops/fused_ce.py``), or ``xla`` (no custom path: the
+      ``ce_chunk``-driven no-remat / chunked XLA formulations).
+    - ``RAY_TPU_CE_BN`` / ``RAY_TPU_CE_BV`` (default 1024/1024):
+      forward row/vocab blocking.
+    - ``RAY_TPU_CE_BWD_BN`` / ``RAY_TPU_CE_BWD_BV`` (default
+      1024/512): backward blocking — the bwd tile also carries the
+      [bn, d] f32 dx accumulator, so it wants a narrower vocab block.
+    """
+    mode: str = "flash"
+    block_n: int = 1024
+    block_v: int = 1024
+    bwd_block_n: int = 1024
+    bwd_block_v: int = 512
+
+
+_CONFIG: Optional[CEConfig] = None
+
+
+def ce_config(refresh: bool = False) -> CEConfig:
+    """The process-wide :class:`CEConfig` (env read once, cached).
+
+    ``refresh=True`` re-reads the environment — for tests and A/B
+    drivers that flip flags after import."""
+    global _CONFIG
+    if _CONFIG is None or refresh:
+        env = os.environ.get
+        _CONFIG = CEConfig(
+            mode=env("RAY_TPU_CE", "flash"),
+            block_n=int(env("RAY_TPU_CE_BN", "1024")),
+            block_v=int(env("RAY_TPU_CE_BV", "1024")),
+            bwd_block_n=int(env("RAY_TPU_CE_BWD_BN", "1024")),
+            bwd_block_v=int(env("RAY_TPU_CE_BWD_BV", "512")),
+        )
+    return _CONFIG
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def supports(N: int, d: int, V: int) -> bool:
+    """Shapes the kernel grid can handle (callers fall back otherwise).
+
+    N and V are padded to the block grid by the wrappers, so the only
+    hard constraints are on the model dimension: it is the contraction
+    lane dimension of every tile matmul and the dx accumulator width,
+    so it must be lane-aligned and VMEM-sized."""
+    return d % 128 == 0 and 0 < d <= 2048 and N > 0 and V > 1
+
+
+def uses_flash_ce(N: int, d: int, V: int, *,
+                  mode: Optional[str] = None,
+                  n_devices: int = 1) -> bool:
+    """Whether the model loss head takes the flash-CE path for this
+    shape under the current :func:`ce_config` (``mode`` overrides the
+    config, for A/B drivers) — the reporting mirror ``bench.py`` uses
+    so the JSON line can't claim a schedule the dispatch declined.
+    ``n_devices`` is the mesh size the loss head will run under: the
+    dispatch declines sharded meshes (a ``pallas_call`` has no SPMD
+    rule), so pass it for anything but a single-chip run."""
+    if mode is None:
+        mode = ce_config().mode
+    return mode == "flash" and n_devices <= 1 and supports(N, d, V)
+
+
+def _blocks(N: int, V: int, block_n: int, block_v: int):
+    """Resolve (bn, bv, Np, Vp): actual block sizes and padded dims.
+
+    Blocks shrink to the (tile-aligned) problem size for small shapes;
+    otherwise N/V round up to the block grid and the wrappers pad."""
+    bn = min(block_n, _round_up(N, 16))
+    bv = min(block_v, _round_up(V, 128))
+    return bn, bv, _round_up(N, bn), _round_up(V, bv)
+
+
+def _stats_in(a, num_n: int, bn: int):
+    """[Np] -> [num_n, bn, STATS_LANES] lane-broadcast stats layout."""
+    return jnp.broadcast_to(a[:, None], (num_n * bn, STATS_LANES)) \
+        .reshape(num_n, bn, STATS_LANES)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, true_ref,
+                m_sc, l_sc, t_sc, *, block_n: int, block_v: int,
+                num_v: int, v_real: Optional[int]):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        t_sc[:] = jnp.zeros_like(t_sc)
+
+    s = jax.lax.dot_general(
+        x_ref[...], h_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bn, bv]
+    col = (j * block_v
+           + jax.lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1))
+    if v_real is not None:
+        s = jnp.where(col < v_real, s, _NEG_INF)
+    # true-logit gather: exactly one column matches the row's target
+    # (none for masked -1 targets), so a select+rowsum is the gather
+    tgt = tgt_ref[0][:, 0:1]                             # [bn, 1] int32
+    t_sc[:] += jnp.sum(jnp.where(col == tgt, s, 0.0), 1, keepdims=True)
+    m_prev = m_sc[:]                                     # [bn, 128]
+    m_new = jnp.maximum(m_prev, jnp.max(s, 1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    l_sc[:] = l_sc[:] * alpha + jnp.sum(p, 1, keepdims=True)
+    m_sc[:] = m_new
+
+    @pl.when(j == num_v - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, :1], 1e-30)
+        lse = m_sc[:, :1] + jnp.log(l)                   # [bn, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        true_ref[0] = jnp.broadcast_to(t_sc[:, :1], true_ref.shape[1:])
+
+
+def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int):
+    """x [N, d], head [d, V], targets [N] int32 (-1 = masked) ->
+    (lse [N] f32, true_logit [N] f32) with no [N, V] materialization."""
+    N, d = x.shape
+    V = head.shape[1]
+    bn, bv, Np, Vp = _blocks(N, V, block_n, block_v)
+    num_n, num_v = Np // bn, Vp // bv
+    if Np != N:
+        x = jnp.pad(x, ((0, Np - N), (0, 0)))
+        targets = jnp.pad(targets, (0, Np - N), constant_values=-1)
+    if Vp != V:
+        head = jnp.pad(head, ((0, 0), (0, Vp - V)))
+    tstats = _stats_in(targets.astype(jnp.int32), num_n, bn)
+
+    stats_spec = pl.BlockSpec((1, bn, STATS_LANES), lambda i, j: (i, 0, 0))
+    stats_shape = jax.ShapeDtypeStruct((num_n, bn, STATS_LANES),
+                                       jnp.float32)
+    lse, true = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_n=bn, block_v=bv,
+                          num_v=num_v,
+                          v_real=V if Vp != V else None),
+        grid=(num_n, num_v),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            stats_spec,
+        ],
+        out_specs=[stats_spec, stats_spec],
+        out_shape=[stats_shape, stats_shape],
+        scratch_shapes=[
+            pltpu.VMEM((bn, 128), jnp.float32),
+            pltpu.VMEM((bn, 128), jnp.float32),
+            pltpu.VMEM((bn, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x, head, tstats)
+    return (lse[:, :, 0].reshape(Np)[:N],
+            true[:, :, 0].reshape(Np)[:N])
+
+
+# ---------------------------------------------------------------------------
+# backward kernel
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, srow_ref,
+                dx_ref, dhp_ref, dx_sc, *, block_n: int, block_v: int,
+                num_v: int, v_real: Optional[int]):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dx_sc[:] = jnp.zeros_like(dx_sc)
+
+    x = x_ref[...]                                       # [bn, d]
+    h = h_ref[...]                                       # [d, bv]
+    s = jax.lax.dot_general(
+        x, h, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # recompute tile
+    col = (j * block_v
+           + jax.lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1))
+    if v_real is not None:
+        s = jnp.where(col < v_real, s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, 0:1])   # padded cols: exp(-inf) = 0
+    onehot = jnp.where(col == tgt_ref[0][:, 0:1], 1.0, 0.0)
+    # (p - onehot) scaled by the incoming cotangent x row mask, cast to
+    # the input dtype, fused straight into BOTH grad matmuls — the tile
+    # never leaves VMEM
+    dl = ((p - onehot) * srow_ref[0][:, 0:1]).astype(h.dtype)
+    dx_sc[:] += jax.lax.dot_general(
+        dl, h, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bn, d]
+    dhp_ref[0] = jax.lax.dot_general(
+        x, dl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dhp_ref.dtype)
+
+    @pl.when(j == num_v - 1)
+    def _finalize():
+        dx_ref[...] = dx_sc[:].astype(dx_ref.dtype)
+
+
+def _bwd_pallas(x, head, targets, lse, gs, *, block_n: int,
+                block_v: int):
+    """Strip-mined backward: (residuals, d(sum_nll)) -> (dx, dhead).
+
+    dx accumulates across the vocab sweep in VMEM scratch; dhead is
+    emitted as ``[num_n, d, V]`` per-row-block partials (each written
+    exactly once, at matmul rate) and summed in one XLA pass — the
+    write-once/read-once analogue of attention's dk/dv scratch, sized
+    for a head too large to ride along in VMEM."""
+    N, d = x.shape
+    V = head.shape[1]
+    bn, bv, Np, Vp = _blocks(N, V, block_n, block_v)
+    num_n, num_v = Np // bn, Vp // bv
+    if Np != N:
+        x = jnp.pad(x, ((0, Np - N), (0, 0)))
+        targets = jnp.pad(targets, (0, Np - N), constant_values=-1)
+        lse = jnp.pad(lse, (0, Np - N))
+    if Vp != V:
+        head = jnp.pad(head, ((0, 0), (0, Vp - V)))
+    targets = targets.astype(jnp.int32)
+    # per-row scale: the sum_nll cotangent where the target is live
+    srow = jnp.where(targets >= 0, gs.astype(jnp.float32), 0.0)
+    tstats = _stats_in(targets, num_n, bn)
+    lstats = _stats_in(lse.astype(jnp.float32), num_n, bn)
+    sstats = _stats_in(srow, num_n, bn)
+
+    stats_spec = pl.BlockSpec((1, bn, STATS_LANES), lambda i, j: (i, 0, 0))
+    dx, dhp = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_n=bn, block_v=bv,
+                          num_v=num_v,
+                          v_real=V if Vp != V else None),
+        grid=(num_n, num_v),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            stats_spec,
+            stats_spec,
+            stats_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, d, bv), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Np, d), x.dtype),
+            jax.ShapeDtypeStruct((num_n, d, Vp), head.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(x, head, tstats, lstats, sstats)
+    dhead = jnp.sum(dhp.astype(jnp.float32), axis=0)[:, :V]
+    return dx[:N], dhead.astype(head.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom VJP + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_ce(x, head, targets, block_n, block_v, bwd_block_n,
+              bwd_block_v):
+    out, _ = _flash_ce_fwd(x, head, targets, block_n, block_v,
+                           bwd_block_n, bwd_block_v)
+    return out
+
+
+def _flash_ce_fwd(x, head, targets, block_n, block_v, bwd_block_n,
+                  bwd_block_v):
+    lse, true = _fwd_pallas(x, head, targets, block_n=block_n,
+                            block_v=block_v)
+    mask = (targets >= 0).astype(jnp.float32)
+    out = (jnp.sum((lse - true) * mask), jnp.sum(mask))
+    # residuals are [N]-sized (plus the inputs the grads contract
+    # against) — nothing vocab-shaped survives the forward
+    return out, (x, head, targets, lse)
+
+
+def _flash_ce_bwd(block_n, block_v, bwd_block_n, bwd_block_v, res, g):
+    x, head, targets, lse = res
+    gs, _ = g                                  # d/d(sum_nll); n is count
+    dx, dhead = _bwd_pallas(x, head, targets, lse, jnp.asarray(gs),
+                            block_n=bwd_block_n, block_v=bwd_block_v)
+    return dx, dhead, None
+
+
+_flash_ce.defvjp(_flash_ce_fwd, _flash_ce_bwd)
+
+
+def _xla_ce_sum(x, head, targets):
+    """Dense XLA reference (fallback for unsupported shapes; also the
+    parity oracle in tests/test_ops.py)."""
+    logits = jax.lax.dot_general(
+        x, head, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(
+        logits, jnp.maximum(targets, 0)[:, None], axis=-1)[:, 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    return jnp.sum((lse - true) * mask), jnp.sum(mask)
+
+
+def flash_ce_sum(x, head, targets, *, block_n: Optional[int] = None,
+                 block_v: Optional[int] = None,
+                 bwd_block_n: Optional[int] = None,
+                 bwd_block_v: Optional[int] = None):
+    """Streamed-logits cross-entropy: ``(sum_nll, n_valid)``.
+
+    x [N, d] (bf16 ok), head [d, V], targets [N] int32 (-1 = masked).
+    Differentiable in (x, head); the [N, V] logits are never
+    materialized in either pass.  Blocks default to :func:`ce_config`;
+    shapes :func:`supports` declines fall back to the dense XLA
+    formulation (same numerics, no streaming)."""
+    cfg = ce_config()
+    N, d = x.shape
+    V = head.shape[1]
+    if not supports(N, d, V):
+        return _xla_ce_sum(x, head, targets)
+    return _flash_ce(x, head, targets,
+                     block_n or cfg.block_n,
+                     block_v or cfg.block_v,
+                     bwd_block_n or cfg.bwd_block_n,
+                     bwd_block_v or cfg.bwd_block_v)

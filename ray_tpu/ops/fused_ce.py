@@ -21,12 +21,14 @@ of saved logits perturbs gradients well below batch noise (logits are
 O(10); bf16 eps ~ 0.008 relative; softmax differences cancel in
 p - onehot).  Numerics guard: lse and the loss accumulate in f32.
 
-Measured on the GPT-2 v5e bench (env RAY_TPU_FUSED_CE=1): ~-1.5%
-step time — the f32 passes it removes were already overlapped with
-MXU work by XLA's scheduler at that shape, and the custom_vjp
+Measured on the GPT-2 v5e bench (r05, then env RAY_TPU_FUSED_CE=1;
+now ``RAY_TPU_CE=fused`` via ``ray_tpu.ops.flash_ce.ce_config``):
+~-1.5% step time — the f32 passes it removes were already overlapped
+with MXU work by XLA's scheduler at that shape, and the custom_vjp
 boundary costs some fusion freedom.  Kept for memory-bound regimes
 (the resident-logits footprint halves: 2.5 GB vs 4.9 GB at bench
-shape, which is what unlocks larger batches); default off.
+shape, which is what unlocks larger batches); default off — the r07
+streamed-logits ``ops/flash_ce.py`` removes the residual entirely.
 
 Reference role: the loss path of the reference's torch trainers
 (F.cross_entropy); the residual-dtype design is TPU-first.

@@ -1,5 +1,12 @@
 """Sweep train-step variants on the real chip (one variant per run).
 
+ARCHIVAL (r05): the `bf16resid` and `fused*` variants set env knobs
+that r07 removed/renamed (`RAY_TPU_CE_BF16_RESID` is gone,
+`RAY_TPU_FUSED_CE` became `RAY_TPU_CE=fused` via
+`ray_tpu.ops.flash_ce.ce_config`) — rerunning those arms as-is would
+silently measure the r07 default flash-CE path instead.  Use
+`scratch/r7_flash_ce.py` for current CE A/Bs.
+
 Usage: python scratch/r5_variants.py <variant>
 Variants set env knobs BEFORE importing the model code, then time the
 full jitted train step at the bench shape.

@@ -1,5 +1,10 @@
 """Round-6 A/B: two-head lane-packed flash attention on the real chip.
 
+Round 7 was also built off-chip, so this A/B is still pending; the
+first chip session should prefer `scratch/r7_flash_ce.py`, which
+carries these pack2 arms (`pack2ab`) alongside the flash-CE arms and
+fills both docs/PERF.md rows in one go.
+
 Usage: python scratch/r6_pack2.py <variant>
 
 Variants (one per process so env/config land before tracing):

@@ -1,0 +1,90 @@
+"""Round-7 A/B: streamed-logits flash-CE loss head on the real chip.
+
+Usage: python scratch/r7_flash_ce.py <variant>
+
+Variants (one per process so env/config land before tracing):
+  flash     — flash-CE loss head, default blocks (the round-7 candidate)
+  noremat   — no-remat XLA CE (the r05/r06 recipe, control arm)
+  ce        — isolated CE fwd+bwd microbench, both schedules
+  b28/b32   — batch 28/32 re-probe with flash-CE (the r05 recipe fell
+              off a memory cliff at 32 with the resident 4.9 GB logits;
+              flash-CE removes that residual entirely, so the knee may
+              move — run b28x/b32x for the no-remat control)
+  b28x/b32x — batch 28/32 with the no-remat control
+  bv512     — flash-CE with RAY_TPU_CE_BV=512 fwd vocab blocks
+  bn2048    — flash-CE with RAY_TPU_CE_BWD_BN=2048 (fewer dhead
+              partials: [12, d, V] instead of [24, d, V])
+  pack2ab   — the still-pending r06 attention A/B (full step, packed vs
+              single-head), so the first chip session fills both
+              docs/PERF.md rows with one driver
+
+`flash`/`noremat` time the full jitted train step at the bench shape
+(batch 24 x 1024, GPT-2 recipe from bench.py) — the number that decides
+whether the flash-CE default stays on.  `ce` is the kernel-level view:
+if the full-step delta disagrees with the kernel-level delta, the
+difference is scheduling/fusion at the custom-call boundary, not
+matmul throughput (see docs/PERF.md round-5 lessons).  The r05 rule
+applies either way: a win must remove *serialized* work — flash-CE
+deletes ~17 ms of HBM-rate reduce passes but pays one extra vocab
+matmul in backward, so break-even needs the Pallas matmul above ~110
+effective TFLOPs at [24576,768]x[768,50304].
+"""
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "flash"
+
+import os  # noqa: E402
+
+# block-sweep knobs must land before ray_tpu imports read the config
+if VARIANT == "bv512":
+    os.environ["RAY_TPU_CE_BV"] = "512"
+elif VARIANT == "bn2048":
+    os.environ["RAY_TPU_CE_BWD_BN"] = "2048"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if VARIANT == "ce":
+    from ray_tpu._private.ray_perf import ce_perf
+    ce_perf(mode="flash")
+    ce_perf(mode="noremat")
+    sys.exit(0)
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+batch, seq, steps = 24, 1024, 30
+if VARIANT in ("b28", "b28x"):
+    batch = 28
+elif VARIANT in ("b32", "b32x"):
+    batch = 32
+ce_mode = "xla" if VARIANT in ("noremat", "b28x", "b32x") else "flash"
+pack2_arms = [None]
+if VARIANT == "pack2ab":
+    ce_mode = "xla"          # isolate the attention delta (r06 row)
+    pack2_arms = [True, False]
+
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=False, unroll_layers=True, ce_chunk=-1)
+mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+for pack2 in pack2_arms:
+    fns = training.build_gpt_train(cfg, mesh, attn_pack2=pack2,
+                                   ce_mode=ce_mode)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                     cfg.vocab_size)
+    for _ in range(2):
+        state, m = fns["step_fn"](state, bd)
+        float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = fns["step_fn"](state, bd)
+    loss = float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tok = batch * seq / dt
+    tag = VARIANT if pack2 is None else f"{VARIANT}:pack2={pack2}"
+    print(f"{tag} (ce={ce_mode}, batch={batch}): {dt*1e3:7.1f} ms/step  "
+          f"{tok:,.0f} tok/s  (vs_baseline {tok/255000:.3f})  "
+          f"loss {loss:.3f}", flush=True)

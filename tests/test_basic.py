@@ -48,6 +48,7 @@ def test_task_with_object_args(ray_start_regular):
     assert ray.get(c) == 30
 
 
+@pytest.mark.slow
 def test_task_chain_parallel(ray_start_regular):
     ray = ray_start_regular
 

@@ -123,6 +123,7 @@ def test_actor_pool(ray_start_regular):
     assert out == [2, 4, 6, 8]
 
 
+@pytest.mark.slow
 def test_host_ring_ops_world4(ray_start_regular):
     """Ring reduce-scatter/allgather with every reduce op (parity:
     reference nccl_collective_group ring allreduce)."""
@@ -173,6 +174,7 @@ def test_host_ring_ops_world4(ray_start_regular):
     np.testing.assert_allclose(outs[0]["reduce"], np.full(6, 1.0))
 
 
+@pytest.mark.slow
 def test_ici_backend_two_process_world(ray_start_regular):
     """Two actor processes form one jax.distributed world (gloo on CPU;
     ICI/DCN on TPU pods) and run XLA collectives across it."""

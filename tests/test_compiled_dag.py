@@ -29,6 +29,7 @@ def test_channel_capacity_enforced(tmp_path):
     ch.unlink()
 
 
+@pytest.mark.slow
 def test_compiled_dag_pipeline(ray_start_regular):
     """3-stage pipeline over channels: correct, pipelined, and much
     faster than per-call task submission (gate kept conservative here;

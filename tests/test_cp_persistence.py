@@ -48,6 +48,7 @@ def test_journal_roundtrip(tmp_path):
     assert cp2.get_placement_group(b"pg1")["state"] == "CREATED"
 
 
+@pytest.mark.slow
 def test_journal_compaction(tmp_path):
     from ray_tpu._private.control_plane import ControlPlane
     from ray_tpu._private.persistence import Journal, restore_control_plane

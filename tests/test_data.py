@@ -39,6 +39,7 @@ def test_iter_batches_exact_sizes(ray_start_regular):
     assert all(s == 10 for s in sizes[:-1])
 
 
+@pytest.mark.slow
 def test_random_shuffle_preserves_rows(ray_start_regular):
     import ray_tpu.data as data
     ds = data.range(200, override_num_blocks=4).random_shuffle(seed=42)
@@ -171,6 +172,7 @@ def test_actor_pool_map_operator(ray_start_regular):
     assert sorted(r["id"] for r in out) == list(range(100, 164))
 
 
+@pytest.mark.slow
 def test_streaming_overlap_and_budget(ray_start_regular, monkeypatch):
     """Downstream work is dispatched while upstream blocks are still in
     flight, and per-operator in-flight stays within the budget (parity:
@@ -270,6 +272,7 @@ def test_iter_jax_batches_device_and_sharding(ray_start_regular):
         assert total >= 0
 
 
+@pytest.mark.slow
 def test_distributed_sort_global_order(ray_start_regular):
     """Sample sort: partitions sorted in parallel, globally ordered
     across output blocks, driver never materializes the dataset
@@ -293,6 +296,7 @@ def test_distributed_sort_global_order(ray_start_regular):
     assert [r["name"] for r in sds.sort("name").take_all()] ==         sorted(names)
 
 
+@pytest.mark.slow
 def test_shuffle_streams_splits_while_maps_run(ray_start_regular):
     """The shuffle's split stage overlaps with upstream map tasks (no
     materialization barrier): some splits finish before the map stage
@@ -429,6 +433,7 @@ def test_union_streams_lazily(ray_start_regular):
     assert sorted(r["x"] for r in doubled.take_all())[0] == 2
 
 
+@pytest.mark.slow
 def test_limit_stops_upstream_execution(ray_start_regular):
     """limit(n) consumes only the prefix of the stream: upstream map
     tasks for blocks past the limit never run."""
@@ -471,6 +476,7 @@ def test_limit_stops_upstream_execution(ray_start_regular):
     assert touched < 16, f"limit ran {touched}/16 upstream blocks"
 
 
+@pytest.mark.slow
 def test_op_bytes_budget_backpressure(ray_start_regular):
     """With DataContext.op_bytes_budget set, a fat map stage's
     outstanding bytes stay under the cap while the pipeline streams."""

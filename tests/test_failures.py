@@ -175,6 +175,7 @@ def test_chaos_worker_killer_retries_win(ray_start_regular):
     assert killer.kills, "chaos never killed anything"
 
 
+@pytest.mark.slow
 def test_chaos_actor_killer_restarts(ray_start_regular):
     import time
 
@@ -206,6 +207,7 @@ def test_chaos_actor_killer_restarts(ray_start_regular):
     assert killer.kills, "chaos never killed the actor"
 
 
+@pytest.mark.slow
 def test_oom_policy_kills_hog_and_retries(tmp_path):
     """Memory monitor: node usage over threshold kills the newest
     retriable task's worker; the retry succeeds and an unrelated

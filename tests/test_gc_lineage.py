@@ -207,6 +207,7 @@ def _wait_dead(node, node_id, timeout=30):
     raise TimeoutError("node not marked dead")
 
 
+@pytest.mark.slow
 def test_owner_death_put_object_raises(owner_death_cluster):
     """ray.put objects fate-share with their owner: when the owning
     node dies, borrowers get OwnerDiedError (no lineage to recover)."""
@@ -255,6 +256,7 @@ def test_owner_death_task_return_recovers_via_lineage(owner_death_cluster):
     assert int(out[7]) == 7
 
 
+@pytest.mark.slow
 def test_wait_unblocks_on_owner_died_tombstone(owner_death_cluster):
     """ray.wait on an owner-died object reports it ready (the get then
     raises OwnerDiedError) instead of hanging past the tombstone."""
@@ -280,6 +282,7 @@ def test_wait_unblocks_on_owner_died_tombstone(owner_death_cluster):
         ray.get(inner, timeout=30)
 
 
+@pytest.mark.slow
 def test_node_death_purges_borrower_counts(owner_death_cluster):
     """Counts flushed by a dead node's workers to a surviving owner are
     purged by the head's node-death broadcast, so borrowed objects

@@ -77,6 +77,7 @@ class TestPortParity:
 
 
 class TestTransformersTrainer:
+    @pytest.mark.slow
     def test_finetune_tiny_gpt2(self, ray_start_regular):
         """Three-line user path: HF model in, sharded fine-tune out,
         metrics + checkpoint reported (BASELINE.json config 5)."""
@@ -108,6 +109,7 @@ class TestTransformersTrainer:
         assert losses[-1] < losses[0] + 0.5  # training, not diverging
         assert result.checkpoint is not None
 
+    @pytest.mark.slow
     def test_finetune_with_dataset(self, ray_start_regular):
         """datasets= path: ray_tpu.data rows with input_ids shard to the
         workers through streaming_split."""

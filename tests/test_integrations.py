@@ -105,6 +105,7 @@ def test_read_sql(ray_start_2_cpus, tmp_path):
     assert len(rows) == 10 and rows[0]["loss"] == 1.0
 
 
+@pytest.mark.slow
 def test_from_torch(ray_start_2_cpus):
     import torch.utils.data
 

@@ -146,6 +146,7 @@ def test_mlp_trains():
     assert float(m["loss"]) < first
 
 
+@pytest.mark.slow
 def test_resnet18_step():
     from ray_tpu.models.resnet import ResNet18, build_resnet_train
     from ray_tpu.parallel.mesh import make_mesh
@@ -223,6 +224,7 @@ def test_multiprocessing_pool(ray_start_regular):
         p.map(sq, [1])  # closed
 
 
+@pytest.mark.slow
 def test_joblib_backend(ray_start_regular):
     """register_ray() joblib backend runs Parallel over cluster tasks
     and propagates worker exceptions (parity: ray/util/joblib)."""

@@ -88,6 +88,7 @@ def test_ring_vs_local_full_model():
     assert abs(loss_local - loss_ring) < 1e-4
 
 
+@pytest.mark.slow
 def test_graft_entry():
     import importlib.util
     import os

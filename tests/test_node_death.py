@@ -100,6 +100,7 @@ def test_non_restartable_actor_dies_with_node(cluster_fast_health):
         ray.get(a.ping.remote(), timeout=60)
 
 
+@pytest.mark.slow
 def test_task_on_dead_node_reexecutes(cluster_fast_health):
     ray, node = cluster_fast_health
     node_b = node.add_node(num_cpus=1)

@@ -101,6 +101,7 @@ def test_samehost_fastpath_pull(monkeypatch):
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_broadcast_chain_survives_node_death(monkeypatch):
     """Chain-push broadcast (fastpath disabled): pullers chain off each
     other via the CP registry; killing a mid-chain node mid-broadcast

@@ -190,6 +190,7 @@ def test_native_store_stats_exposed(ray_start_regular):
         assert stats["arena"]["num_puts"] >= 1
 
 
+@pytest.mark.slow
 def test_device_profiling_helpers(ray_start_regular, tmp_path):
     """profile_device captures an xplane trace; annotate + memory stats
     work on the active backend."""
@@ -247,6 +248,7 @@ def test_stack_dump_signal(ray_start_regular):
     assert ray_tpu.get(ref, timeout=30) == 3.0   # worker survived USR1
 
 
+@pytest.mark.slow
 def test_async_actor_event_loop_lag_metric(ray_start_regular):
     """A blocking handler inside an async actor surfaces as the
     event-loop lag gauge (SURVEY 5.2 responsiveness sanitizer)."""

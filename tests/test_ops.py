@@ -1,9 +1,14 @@
-"""Numerics tests: Pallas flash attention vs the einsum reference path.
+"""Numerics tests: Pallas kernels vs their XLA reference paths.
 
 Runs in interpret mode on the CPU test mesh (tests/conftest.py); the same
-kernel compiles to Mosaic on a real chip (exercised by bench.py and the
+kernels compile to Mosaic on a real chip (exercised by bench.py and the
 driver's entry check).  Mirrors the reference's kernel-vs-eager parity
 tests (e.g. ``python/ray/train/tests`` numerical checks).
+
+The ``kernel_smoke`` marker scopes the fast representative core that
+``bench.py``'s preamble re-runs before every paid chip measurement —
+one parity test per kernel schedule; the heavier sweep cases (full
+GPT-2 vocab, dispatch/env plumbing) run only in tier-1.
 """
 
 import jax
@@ -15,6 +20,7 @@ from ray_tpu.parallel.ring_attention import local_attention
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.kernel_smoke
 def test_flash_fwd_matches_einsum(causal):
     key = jax.random.PRNGKey(0)
     B, S, H, D = 2, 256, 4, 64
@@ -26,6 +32,7 @@ def test_flash_fwd_matches_einsum(causal):
     assert float(jnp.abs(out - ref).max()) < 2e-5
 
 
+@pytest.mark.kernel_smoke
 def test_flash_grads_match_einsum():
     key = jax.random.PRNGKey(1)
     B, S, H, D = 2, 256, 2, 64
@@ -66,6 +73,7 @@ def test_flash_grads_fused_single_kv_block(causal):
         assert float(jnp.abs(a - b).max()) < 5e-4
 
 
+@pytest.mark.kernel_smoke
 def test_flash_fused_rope_matches_external_rotation():
     # in-kernel rope (fwd + fused bwd) vs rotate-then-attend reference
     from ray_tpu.models.gpt import _rope
@@ -118,6 +126,7 @@ def test_flash_rope_multiblock_falls_back_to_external():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.kernel_smoke
 def test_pack2_fwd_matches_einsum(causal):
     key = jax.random.PRNGKey(20)
     B, S, H, D = 2, 256, 4, 64
@@ -145,6 +154,7 @@ def test_pack2_fwd_bf16():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.kernel_smoke
 def test_pack2_grads_match_einsum_multistrip(causal):
     # bwd_block_k < S: the packed fused backward walks 2 kv strips and
     # (causal) skips the dead one for the first q block
@@ -187,6 +197,7 @@ def test_pack2_grads_single_kv_block():
         assert float(jnp.abs(a - b).max()) < 5e-4
 
 
+@pytest.mark.kernel_smoke
 def test_pack2_fused_rope_matches_external_rotation():
     # packed in-kernel rope rotates per-sub-head (grouped lane roll);
     # multi-strip bwd also exercises the cached packed k rotation
@@ -217,6 +228,7 @@ def test_pack2_fused_rope_matches_external_rotation():
         assert float(jnp.abs(a - b).max()) < 5e-4
 
 
+@pytest.mark.kernel_smoke
 def test_pack2_matches_unpacked_kernel():
     # the packed and single-head schedules are the same math — outputs
     # agree to f32 accumulation noise, not just to the einsum reference
@@ -363,6 +375,7 @@ def test_chunked_ce_matches_dense():
     assert float(jnp.abs(g - g_ref).max()) < 1e-4
 
 
+@pytest.mark.kernel_smoke
 def test_pallas_rmsnorm_matches_reference():
     """Fused rmsnorm fwd/bwd (ops/rmsnorm.py) vs the XLA formulation."""
     import jax
@@ -400,6 +413,7 @@ def test_pallas_rmsnorm_matches_reference():
         assert err / scale < 2e-2, (err, scale)
 
 
+@pytest.mark.kernel_smoke
 def test_fused_ce_matches_reference():
     """bf16-resident-logit CE (ops/fused_ce.py) vs the f32 formulation."""
     import jax
@@ -436,17 +450,23 @@ def test_fused_ce_matches_reference():
 
 
 def test_gpt_env_gated_paths_train(monkeypatch):
-    """PALLAS_NORM + FUSED_CE paths produce a finite training step on
-    the tiny config (8-dev CPU mesh)."""
+    """PALLAS_NORM + RAY_TPU_CE=fused paths produce a finite training
+    step on the tiny config.  The tiny config's d=64 makes flash-CE's
+    ``supports`` decline (and the Pallas path is mesh-gated anyway),
+    so ``fused`` — plain XLA, no device gate — is the rung that
+    actually runs."""
     import importlib
 
     import jax
     import jax.numpy as jnp
 
+    from ray_tpu.ops import flash_ce
+
     monkeypatch.setenv("RAY_TPU_PALLAS_NORM", "1")
-    monkeypatch.setenv("RAY_TPU_FUSED_CE", "1")
+    monkeypatch.setenv("RAY_TPU_CE", "fused")
     from ray_tpu.models import gpt as gpt_mod
-    importlib.reload(gpt_mod)
+    importlib.reload(gpt_mod)          # _PALLAS_NORM is read at import
+    flash_ce.ce_config(refresh=True)   # CE mode is config-cached
     try:
         from ray_tpu.models import training
         from ray_tpu.parallel.mesh import make_mesh
@@ -459,6 +479,191 @@ def test_gpt_env_gated_paths_train(monkeypatch):
         state, m = fns["step_fn"](state, batch)
         assert jnp.isfinite(m["loss"])
     finally:
-        monkeypatch.delenv("RAY_TPU_PALLAS_NORM")
-        monkeypatch.delenv("RAY_TPU_FUSED_CE")
+        monkeypatch.undo()
         importlib.reload(gpt_mod)
+        flash_ce.ce_config(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# flash-CE (ops/flash_ce.py): streamed-logits Pallas cross-entropy vs
+# the dense f32 formulation.  All run in interpret mode on CPU; the
+# kernel_smoke pair is re-run by the bench.py preamble before any chip
+# measurement (ISSUE r07 acceptance: loss within 1e-3 relative, grads
+# within bf16 tolerance of the f32 reference).
+# ---------------------------------------------------------------------------
+
+def _ce_inputs(N, d, V, dtype=jnp.float32, seed=0, head_scale=0.1,
+               n_masked=7):
+    kx, kh, kt = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(kx, (N, d), dtype)
+    head = (jax.random.normal(kh, (d, V), jnp.float32)
+            * head_scale).astype(dtype)
+    tgt = jax.random.randint(kt, (N,), 0, V)
+    if n_masked:
+        tgt = tgt.at[::max(N // n_masked, 1)].set(-1)
+    return x, head, tgt
+
+
+@pytest.mark.kernel_smoke
+def test_flash_ce_fwd_matches_reference():
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+    x, head, tgt = _ce_inputs(256, 128, 512)
+    s, n = flash_ce_sum(x, head, tgt, block_n=128, block_v=128)
+    s_ref, n_ref = _xla_ce_sum(x, head, tgt)
+    assert int(n) == int(n_ref)
+    assert abs(float(s) - float(s_ref)) / abs(float(s_ref)) < 1e-3
+
+
+@pytest.mark.kernel_smoke
+def test_flash_ce_grads_match_reference():
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+    x, head, tgt = _ce_inputs(256, 128, 512, seed=1)
+
+    def ours(x, head):
+        s, n = flash_ce_sum(x, head, tgt, block_n=128, block_v=128,
+                            bwd_block_n=128, bwd_block_v=128)
+        return s / n
+
+    def ref(x, head):
+        s, n = _xla_ce_sum(x, head, tgt)
+        return s / n
+
+    l1, g1 = jax.value_and_grad(ours, argnums=(0, 1))(x, head)
+    l2, g2 = jax.value_and_grad(ref, argnums=(0, 1))(x, head)
+    assert abs(float(l1) - float(l2)) / abs(float(l2)) < 1e-3
+    for a, b in zip(g1, g2):   # dX, dHead
+        err = float(jnp.abs(a - b).max())
+        scale = float(jnp.abs(b).max()) + 1e-9
+        assert err / scale < 1e-4, (err, scale)
+
+
+@pytest.mark.slow
+def test_flash_ce_mismatched_fwd_bwd_blocks():
+    # fwd and bwd re-derive padding from their own blocking; the saved
+    # [N] lse must survive the re-grouping
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+    x, head, tgt = _ce_inputs(200, 128, 300, seed=2)
+
+    def ours(x, head):
+        s, n = flash_ce_sum(x, head, tgt, block_n=128, block_v=128,
+                            bwd_block_n=64, bwd_block_v=256)
+        return s / n
+
+    def ref(x, head):
+        s, n = _xla_ce_sum(x, head, tgt)
+        return s / n
+
+    g1 = jax.grad(ours, argnums=(0, 1))(x, head)
+    g2 = jax.grad(ref, argnums=(0, 1))(x, head)
+    for a, b in zip(g1, g2):
+        err = float(jnp.abs(a - b).max())
+        scale = float(jnp.abs(b).max()) + 1e-9
+        assert err / scale < 1e-4, (err, scale)
+
+
+def test_flash_ce_gpt2_vocab_padding():
+    # V=50304 with 1024-wide vocab blocks pads to 51200: 896 dead
+    # columns masked in-kernel, plus a non-multiple-of-block N
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+    x, head, tgt = _ce_inputs(190, 128, 50304, head_scale=0.02, seed=3)
+
+    def ours(x, head):
+        # one 192-row block (190 pads to it) keeps the interpret-mode
+        # grid at 50 vocab steps per pass
+        s, n = flash_ce_sum(x, head, tgt, block_n=192, block_v=1024,
+                            bwd_block_n=192, bwd_block_v=1024)
+        return s / n
+
+    def ref(x, head):
+        s, n = _xla_ce_sum(x, head, tgt)
+        return s / n
+
+    l1, g1 = jax.value_and_grad(ours, argnums=(0, 1))(x, head)
+    l2, g2 = jax.value_and_grad(ref, argnums=(0, 1))(x, head)
+    assert abs(float(l1) - float(l2)) / abs(float(l2)) < 1e-3
+    for a, b in zip(g1, g2):
+        err = float(jnp.abs(a - b).max())
+        scale = float(jnp.abs(b).max()) + 1e-9
+        assert err / scale < 1e-4, (err, scale)
+    # padded dhead columns must not leak gradient
+    assert g1[1].shape == head.shape
+
+
+def test_flash_ce_bf16_inputs():
+    # bf16 x/head: tiles recomputed in bf16 with f32 accumulation; the
+    # comparison is against the same-dtype dense formulation, so the
+    # tolerance is bf16 rounding of the grad matmuls, not the inputs
+    from ray_tpu.ops.flash_ce import _xla_ce_sum, flash_ce_sum
+    x, head, tgt = _ce_inputs(256, 128, 512, dtype=jnp.bfloat16, seed=4)
+
+    def ours(x, head):
+        s, n = flash_ce_sum(x, head, tgt, block_n=128, block_v=128)
+        return s / n
+
+    def ref(x, head):
+        s, n = _xla_ce_sum(x, head, tgt)
+        return s / n
+
+    l1, g1 = jax.value_and_grad(ours, argnums=(0, 1))(x, head)
+    l2, g2 = jax.value_and_grad(ref, argnums=(0, 1))(x, head)
+    assert abs(float(l1) - float(l2)) / abs(float(l2)) < 1e-2
+    for a, b in zip(g1, g2):
+        err = float(jnp.abs(a.astype(jnp.float32)
+                            - b.astype(jnp.float32)).max())
+        scale = float(jnp.abs(b.astype(jnp.float32)).max()) + 1e-9
+        assert err / scale < 2e-2, (err, scale)
+
+
+@pytest.mark.slow
+def test_flash_ce_all_masked():
+    # every target -1: zero loss, zero count, zero grads (no NaN from
+    # the 0-valid-row normalization path)
+    from ray_tpu.ops.flash_ce import flash_ce_sum
+    x, head, _ = _ce_inputs(128, 128, 384, seed=5)
+    tgt = jnp.full((128,), -1, jnp.int32)
+    s, n = flash_ce_sum(x, head, tgt, block_n=128, block_v=128)
+    assert float(s) == 0.0 and float(n) == 0.0
+    g = jax.grad(
+        lambda x: flash_ce_sum(x, head, tgt, block_n=128,
+                               block_v=128)[0])(x)
+    assert float(jnp.abs(g).max()) == 0.0
+
+
+def test_flash_ce_fallback_and_dispatch(monkeypatch):
+    """supports() declines lane-misaligned d (XLA fallback, same
+    numerics); RAY_TPU_CE gates the model dispatch via ce_config
+    (cached, refresh=True re-resolves)."""
+    from ray_tpu.models.gpt import _chunked_ce
+    from ray_tpu.ops import flash_ce as FC
+
+    # d % 128 != 0 -> dense XLA fallback inside flash_ce_sum
+    x, head, tgt = _ce_inputs(64, 96, 256, seed=6)
+    assert not FC.supports(64, 96, 256)
+    s, n = FC.flash_ce_sum(x, head, tgt)
+    s_ref, n_ref = FC._xla_ce_sum(x, head, tgt)
+    assert float(s) == pytest.approx(float(s_ref), rel=1e-6)
+    assert int(n) == int(n_ref)
+
+    try:
+        monkeypatch.delenv("RAY_TPU_CE", raising=False)
+        base = FC.ce_config(refresh=True)
+        assert base.mode == "flash"    # default on
+        assert FC.uses_flash_ce(512, 128, 50304)
+        monkeypatch.setenv("RAY_TPU_CE", "xla")
+        monkeypatch.setenv("RAY_TPU_CE_BWD_BV", "256")
+        cfg = FC.ce_config(refresh=True)
+        assert cfg.mode == "xla" and cfg.bwd_block_v == 256
+        # config off: the dispatch gate declines...
+        assert not FC.uses_flash_ce(512, 128, 50304)
+        # ...but the mode override still reports the flash path
+        assert FC.uses_flash_ce(512, 128, 50304, mode="flash")
+        # the model glue honours the env: xla mode + supported shape
+        # must match the flash path it declined
+        x2, head2, tgt2 = _ce_inputs(128, 128, 384, seed=7)
+        s_xla, n_xla = _chunked_ce(x2, head2, tgt2, chunk=0)
+        s_fl, n_fl = _chunked_ce(x2, head2, tgt2, chunk=0, mode="flash")
+        assert float(s_xla) == pytest.approx(float(s_fl), rel=1e-5)
+        assert int(n_xla) == int(n_fl)
+    finally:
+        monkeypatch.undo()
+        FC.ce_config(refresh=True)

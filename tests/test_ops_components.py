@@ -217,6 +217,7 @@ def test_job_entrypoint_uses_cluster(ray_start_regular):
     assert "cluster result: 42" in c.get_job_logs(jid)
 
 
+@pytest.mark.slow
 def test_cli_start_stop_standalone_cluster(tmp_path):
     """ray-tpu start --head --tcp + start --address joins a worker over
     TCP; an external driver attaches and runs tasks; stop reaps all
@@ -327,6 +328,7 @@ def test_autoscaler_unprovisionable_shape_fails_fast(ray_start_cluster):
         sc.stop()
 
 
+@pytest.mark.slow
 def test_autoscaler_v2_engine_up_and_down(ray_start_cluster):
     """engine="v2": scale decisions flow through the instance
     reconciler — launch lands via QUEUED->...->RAY_RUNNING, idle

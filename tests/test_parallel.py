@@ -114,6 +114,7 @@ def test_moe_capacity_drops_tokens():
     assert zero_rows > 0
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_parallel_matches_dense():
     """build_gpt_train_pp over {pp,dp,tp} matches the non-pp loss exactly
     and trains (parity target: reference's DeepSpeed pipeline delegation,

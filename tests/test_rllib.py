@@ -38,6 +38,7 @@ def test_ppo_learns_cartpole(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_ppo_in_tune(ray_start_regular, tmp_path):
     import ray_tpu.tune as tune
     from ray_tpu.rllib.algorithms.ppo import PPO
@@ -54,6 +55,7 @@ def test_ppo_in_tune(ray_start_regular, tmp_path):
     assert results.get_best_result().metrics["training_iteration"] == 2
 
 
+@pytest.mark.slow
 def test_ppo_learner_group_ddp(ray_start_regular):
     """num_learners=2: gradients ring-allreduced across learner actors,
     params stay identical, and PPO still improves on CartPole (parity:
@@ -181,6 +183,7 @@ def test_vtrace_matches_numpy_reference():
         np.testing.assert_allclose(np.asarray(pg)[b], pg_ref, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole(ray_start_regular):
     """Async actor-learner: sampling never blocks on learning; CartPole
     return improves (parity: rllib/algorithms/impala)."""
@@ -209,6 +212,7 @@ def test_impala_learns_cartpole(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_impala_multi_learner_ici(ray_start_regular):
     """BASELINE config 4 shape: 2 learners + 4 env-runners, gradients
     over the ici (jax.distributed device-world) collective group."""
@@ -238,6 +242,7 @@ def test_impala_multi_learner_ici(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_sac_learns_pendulum(ray_start_regular):
     """SAC solves (improves substantially on) Pendulum-v1 — twin-Q +
     squashed Gaussian + auto-alpha (parity: rllib/algorithms/sac)."""
@@ -269,6 +274,7 @@ def test_sac_learns_pendulum(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_two_agent_cartpole(ray_start_regular):
     """Two-agent CartPole learns under per-agent policies (parity:
     MultiAgentEnv + policy mapping, rllib/env/multi_agent_env.py:29)."""

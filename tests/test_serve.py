@@ -236,6 +236,7 @@ def test_user_config_push_without_restart(ray_start_regular):
     serve.delete("cfg")
 
 
+@pytest.mark.slow
 def test_downscale_drains_inflight_requests(ray_start_regular):
     """Scale-down removes replicas from routing, waits for their
     in-flight requests, then kills — no dropped requests (parity:
@@ -490,6 +491,7 @@ def test_asgi_ingress(ray_start_regular):
     serve.shutdown()
 
 
+@pytest.mark.slow
 def test_async_proxy_500_concurrent(ray_start_regular):
     """The async dispatch path holds >=500 in-flight requests without a
     thread per request (the old run_in_executor dispatch capped

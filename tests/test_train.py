@@ -140,6 +140,7 @@ def test_jax_trainer_dp_allreduce(ray_start_regular, tmp_path):
 @pytest.mark.skipif(
     os.environ.get("RAY_TPU_SKIP_TORCH") == "1",
     reason="torch distributed not available")
+@pytest.mark.slow
 def test_torch_trainer_ddp(ray_start_regular, tmp_path):
     import ray_tpu.train as train
     from ray_tpu.train import RunConfig, ScalingConfig
@@ -218,6 +219,7 @@ def test_trainer_restore_resumes_from_checkpoint(ray_start_regular,
     assert r2.metrics["step"] == 3  # resumed at 2, not from scratch
 
 
+@pytest.mark.slow
 def test_ulysses_sp_trains(ray_start_regular):
     """build_gpt_train(sp_impl='ulysses') on an sp mesh matches the ring
     implementation's loss."""

@@ -330,6 +330,7 @@ def test_hyperband_scheduler_halves_brackets():
         == STOP
 
 
+@pytest.mark.slow
 def test_tuner_with_search_alg(ray_start_regular, tmp_path):
     import ray_tpu.tune as tune
     from ray_tpu.tune.search.tpe import TPESearcher
@@ -353,6 +354,7 @@ def test_tuner_with_search_alg(ray_start_regular, tmp_path):
     assert abs(best.metrics["config"]["x"] - 0.25) < 0.4
 
 
+@pytest.mark.slow
 def test_pb2_beats_pbt_on_continuous_objective(ray_start_regular,
                                                tmp_path):
     """PB2's GP-bandit explore finds a continuous optimum random
