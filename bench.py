@@ -68,6 +68,142 @@ def _kernel_smoke():
         sys.exit(proc.returncode)
 
 
+def _collective_bytes(cfg, mesh, batch, seq, comm_mode):
+    from ray_tpu.parallel import overlap as ovl
+    return ovl.collective_bytes_per_step(cfg, mesh, batch=batch,
+                                         seq=seq, comm_mode=comm_mode)
+
+
+def _mesh_arg():
+    if "--mesh" not in sys.argv:
+        return None
+    idx = sys.argv.index("--mesh")
+    if idx + 1 >= len(sys.argv):
+        raise SystemExit("--mesh needs an argument, e.g. "
+                         "--mesh fsdp=4,tp=2")
+    return sys.argv[idx + 1]
+
+
+def bench_mesh(arg: str):
+    """Multichip bench: the sharded GPT step on an explicit mesh, one
+    JSON line per comm schedule (gspmd vs overlap) with the logical
+    collective bytes/step, so ``MULTICHIP_r*.json`` rows are comparable
+    across rounds.
+
+    ``python bench.py --mesh fsdp=4,tp=2``.  If this process can't see
+    enough devices (one real chip, or plain CPU) the bench re-execs
+    itself on a host-simulated CPU mesh and says so loudly — those
+    numbers exercise the schedule, not the hardware.
+    """
+    import math
+    import re
+
+    from ray_tpu.parallel.mesh import MeshSpec, parse_mesh_axes
+
+    axes = parse_mesh_axes(arg)
+    import jax
+    if any(v == -1 for v in axes.values()):
+        # wildcard adapts to whatever is visible — resolve it here and
+        # never re-exec (there is no "insufficient" for -1)
+        spec = MeshSpec.create(**axes).resolve(len(jax.devices()))
+        axes = dict(spec.axes)
+        need = spec.size
+    else:
+        need = math.prod(v for v in axes.values())
+    if need <= 0:
+        raise SystemExit(f"--mesh {arg!r}: axes must be positive "
+                         "(or one -1 wildcard)")
+    if len(jax.devices()) < need:
+        print(f"only {len(jax.devices())} device(s) visible; re-running "
+              f"--mesh {arg} on a host-simulated {need}-device CPU mesh "
+              "(schedule check, NOT a hardware measurement)",
+              file=sys.stderr)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={need}"
+        ).strip()
+        proc = subprocess.run([sys.executable, __file__, "--mesh", arg],
+                              env=env)
+        sys.exit(proc.returncode)
+    _bench_mesh_body(axes)
+
+
+def _bench_mesh_body(axes):
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel import overlap as ovl
+    from ray_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    mesh = make_mesh(devices=devices, **axes)
+    host_sim = (devices[0].platform == "cpu")
+    data_par = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    if host_sim:
+        cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=4,
+                        n_heads=4, max_seq=128, dtype=jnp.float32)
+        batch, seq, steps = 4 * data_par, 128, 4
+    else:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=True)
+        batch, seq, steps = 8 * data_par, 1024, 20
+
+    batch_data = training.synthetic_lm_batch(
+        jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+    for want in ("gspmd", "overlap"):
+        fallback = None
+        fns = training.build_gpt_train(cfg, mesh, comm_mode=want)
+        mode = fns["comm_mode"]
+        try:
+            state = fns["init_fn"](jax.random.PRNGKey(0))
+            for _ in range(2):
+                state, metrics = fns["step_fn"](state, batch_data)
+                float(metrics["loss"])
+        except Exception as e:
+            # extend the headline bench's loud fallback ladder: an
+            # overlap compile/run failure degrades to gspmd, visibly
+            if mode == "gspmd":
+                raise
+            print(f"comm_mode=overlap step failed ({e!r}); "
+                  "falling back: gspmd schedule", file=sys.stderr)
+            fallback, mode = want, "gspmd"
+            fns = training.build_gpt_train(cfg, mesh, comm_mode="gspmd")
+            state = fns["init_fn"](jax.random.PRNGKey(0))
+            for _ in range(2):
+                state, metrics = fns["step_fn"](state, batch_data)
+                float(metrics["loss"])
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            state, metrics = fns["step_fn"](state, batch_data)
+        float(metrics["loss"])
+        dt = _time.perf_counter() - t0
+        tok_s = steps * batch * seq / dt
+        record = {
+            "metric": "gpt2_train_tokens_per_sec_multichip",
+            "value": round(tok_s, 1),
+            "unit": "tokens/s",
+            "tokens_per_sec_per_chip": round(tok_s / mesh.size, 1),
+            "platform": devices[0].platform,
+            "host_simulated": host_sim,
+            "mesh": dict(mesh.shape),
+            "comm_mode": mode,
+            "requested_comm_mode": want,
+            "collective_bytes_per_step": ovl.collective_bytes_per_step(
+                cfg, mesh, batch=batch, seq=seq, comm_mode=mode),
+            "final_loss": round(float(metrics["loss"]), 4),
+        }
+        if fallback:
+            record["fallback_from"] = fallback
+        print(json.dumps(record))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -75,6 +211,11 @@ def main():
     from ray_tpu.models import training
     from ray_tpu.models.gpt import GPTConfig
     from ray_tpu.parallel.mesh import make_mesh
+
+    mesh_arg = _mesh_arg()
+    if mesh_arg is not None:
+        bench_mesh(mesh_arg)
+        return
 
     devices = jax.devices()
     platform = devices[0].platform
@@ -212,6 +353,13 @@ def main():
         # attention, and the CE path (flash/noremat/chunked)
         "attn_pack2": attn_pack2,
         "ce": ce_name(cfg, ce_pin),
+        # comm-schedule fields, so headline and --mesh records stay
+        # comparable (headline is a dp-mesh GSPMD run; the overlap
+        # schedule is --mesh territory)
+        "mesh": dict(mesh.shape),
+        "comm_mode": fns["comm_mode"],
+        "collective_bytes_per_step": _collective_bytes(
+            cfg, mesh, batch, seq, fns["comm_mode"]),
     }
     print(json.dumps(result))
 

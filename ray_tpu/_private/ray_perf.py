@@ -8,7 +8,10 @@ the kernel-level entry: isolated flash-attention fwd+bwd throughput, so
 kernel A/Bs (e.g. pack2 on/off) no longer need a full xplane trace.
 ``ce_perf`` (``--ce``) is the same for the loss head: isolated CE
 fwd+bwd at the bench shape, flash-CE (streamed-logits Pallas kernel)
-vs the no-remat XLA control.
+vs the no-remat XLA control.  ``collective_perf`` (``--collective``)
+is the comm-schedule analogue: ring all-gather-matmul
+(``parallel/overlap.py``) vs the barrier all-gather-then-matmul on a
+tp ring.
 """
 
 from __future__ import annotations
@@ -176,6 +179,74 @@ def ce_perf(n_tokens: int = 24576, d_model: int = 768,
     return result
 
 
+def collective_perf(tokens: int = 4096, d_model: int = 512,
+                    d_out: int = 2048, steps: int = 20,
+                    n_devices: Optional[int] = None) -> List[Dict[str,
+                                                                  float]]:
+    """Isolated TP-collective microbenchmark: ring all-gather-matmul
+    (``parallel/overlap.py``) vs the barrier schedule (all_gather, then
+    matmul) on a tp ring over the visible devices.
+
+    This is the kernel-level view of the r08 overlap bet: the ring
+    version pays the same ICI bytes but hides each hop behind one
+    matmul chunk, so the delta here bounds what the full-step schedule
+    can recover.  On CPU the ring runs but measures nothing real —
+    numbers are only meaningful on a chip (the entry stays runnable
+    anywhere, same policy as ``--attn``/``--ce``).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.compat import shard_map
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.parallel.overlap import ring_allgather_matmul
+
+    n = n_devices or len(jax.devices())
+    mesh = make_mesh(tp=n, devices=jax.devices()[:n])
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.device_put(
+        jax.random.normal(kx, (tokens, d_model), dtype),
+        NamedSharding(mesh, P("tp", None)))
+    w = jax.device_put(
+        jax.random.normal(kw, (d_model, d_out), dtype) * 0.02,
+        NamedSharding(mesh, P(None, "tp")))
+
+    def ring(xs, ws):
+        return ring_allgather_matmul(xs, ws, "tp" if n > 1 else None)
+
+    def barrier(xs, ws):
+        full = (jax.lax.all_gather(xs, "tp", axis=0, tiled=True)
+                if n > 1 else xs)
+        return jnp.einsum("tk,km->tm", full, ws)
+
+    results = []
+    for name, body in (("ring all-gather-matmul", ring),
+                       ("all-gather then matmul", barrier)):
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=(P("tp", None), P(None, "tp")),
+                               out_specs=P(None, "tp")))
+        out = fn(x, w)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(x, w)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / steps
+        flops = 2 * tokens * d_model * (d_out // max(n, 1)) * n
+        gathered = tokens * d_model * x.dtype.itemsize * (n - 1) / max(n, 1)
+        r = {"name": name, "ms_per_step": dt * 1e3,
+             "effective_tflops": flops / dt / 1e12,
+             "gathered_bytes_per_device": gathered}
+        print(f"{r['name']}: {r['ms_per_step']:.3f} ms  "
+              f"{r['effective_tflops']:.2f} eff TFLOPs  "
+              f"({gathered/2**20:.2f} MiB gathered/device)")
+        results.append(r)
+    return results
+
+
 def main(duration: float = 2.0) -> List[Dict[str, float]]:
     results = []
     value = np.zeros(16 * 1024, dtype=np.uint8)  # small object
@@ -290,6 +361,9 @@ if __name__ == "__main__":
         # loss-head A/B: streamed-logits Pallas CE vs no-remat XLA
         ce_perf(mode="flash")
         ce_perf(mode="noremat")
+    elif "--collective" in sys.argv:
+        # TP-schedule A/B: ring all-gather-matmul vs barrier gather
+        collective_perf()
     else:
         ray_tpu.init()
         try:

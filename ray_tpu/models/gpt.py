@@ -208,6 +208,11 @@ def param_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
 _PALLAS_NORM = os.environ.get("RAY_TPU_PALLAS_NORM", "0") == "1"
 
 
+def norm_eps(cfg: "GPTConfig") -> float:
+    """Norm epsilon: HF GPT-2 (exact-architecture mode) uses 1e-5."""
+    return 1e-5 if cfg.use_bias else 1e-6
+
+
 def _norm(x, scale, kind: str, bias=None, eps: float = 1e-6):
     if kind == "rmsnorm" and bias is None and _PALLAS_NORM:
         from ray_tpu.ops.rmsnorm import rmsnorm
@@ -285,7 +290,7 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     and the per-stage scan in the pipeline-parallel trainer
     (``models/training.py`` build_gpt_train_pp)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
-    eps = 1e-5 if cfg.use_bias else 1e-6  # HF GPT-2 uses eps=1e-5
+    eps = norm_eps(cfg)
     h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
     # (a fused [d, 3Hk] qkv projection was A/B'd on the v5e bench and
     # lost ~5%: the runtime weight concat serializes against the
@@ -389,13 +394,12 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
             x, aux = layer_body(x, lp)
             aux_total = aux_total + aux
         x = _norm(x, params["ln_f"], cfg.norm,
-                  bias=params.get("ln_f_b"),
-                  eps=1e-5 if cfg.use_bias else 1e-6)
+                  bias=params.get("ln_f_b"), eps=norm_eps(cfg))
         return x, aux_total
     x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
                         params["layers"])
     x = _norm(x, params["ln_f"], cfg.norm, bias=params.get("ln_f_b"),
-              eps=1e-5 if cfg.use_bias else 1e-6)
+              eps=norm_eps(cfg))
     return x, jnp.sum(auxes)
 
 

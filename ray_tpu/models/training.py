@@ -69,7 +69,8 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     optimizer=None,
                     sp_impl: str = "ring",
                     attn_pack2: Optional[bool] = None,
-                    ce_mode: Optional[str] = None) -> Dict[str, Callable]:
+                    ce_mode: Optional[str] = None,
+                    comm_mode: Optional[str] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
@@ -80,10 +81,36 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     A/B drivers (default: ``ray_tpu.ops.attention.attention_config``);
     ``ce_mode`` pins the loss-head schedule the same way ("flash" /
     "fused" / "xla"; default: ``ray_tpu.ops.flash_ce.ce_config``).
+    ``comm_mode`` pins the multi-chip collective schedule ("gspmd" /
+    "overlap"; default: ``ray_tpu.parallel.overlap.comm_config``) —
+    "overlap" runs the explicit shard_map schedule (prefetched
+    per-block FSDP gathers, as-you-go grad reduce-scatters, ring
+    all-gather-matmul TP) and falls back to "gspmd" loudly when the
+    (cfg, mesh) is outside its dp/fsdp/tp dense coverage; the chosen
+    mode is returned as ``fns["comm_mode"]``.  The overlap step/loss
+    use their own block formulation (einsum attention, vocab-parallel
+    CE), so ``attn_pack2``/``ce_mode`` only affect the GSPMD-side
+    ``forward_fn`` there.
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
+    from ray_tpu.parallel import overlap as ovl
 
     tx = optimizer or default_optimizer()
+    if comm_mode is None:
+        comm_mode = ovl.comm_config().mode
+    if comm_mode not in ("gspmd", "overlap"):
+        raise ValueError(f"unknown comm_mode {comm_mode!r}; "
+                         "expected 'gspmd' or 'overlap'")
+    if comm_mode == "overlap":
+        if getattr(mesh, "size", 1) <= 1:
+            comm_mode = "gspmd"   # single device: nothing to schedule
+        else:
+            reason = ovl.overlap_supported(cfg, mesh)
+            if reason is not None:
+                import sys
+                print(f"comm_mode=overlap unsupported ({reason}); "
+                      "falling back to gspmd", file=sys.stderr)
+                comm_mode = "gspmd"
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
     if mesh.shape.get("sp", 1) > 1:
@@ -106,6 +133,15 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
                                mesh=mesh, ce_mode=ce_mode)
 
+    overlap_fns = (ovl.build_overlap_step_fns(cfg, mesh)
+                   if comm_mode == "overlap" else None)
+
+    def value_and_grad(params, batch):
+        if overlap_fns is not None:
+            return overlap_fns["value_and_grad"](
+                params, batch["tokens"], batch["targets"])
+        return jax.value_and_grad(loss)(params, batch)
+
     def init(key) -> TrainState:
         params = gpt_mod.init_params(cfg, key)
         return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
@@ -116,7 +152,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
                        out_shardings=(st_sh, None), donate_argnums=(0,))
     def step(state: TrainState, batch):
-        loss_val, grads = jax.value_and_grad(loss)(state.params, batch)
+        loss_val, grads = value_and_grad(state.params, batch)
         updates, opt_state = tx.update(grads, state.opt_state,
                                        state.params)
         params = optax.apply_updates(state.params, updates)
@@ -127,6 +163,9 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
 
     @functools.partial(jax.jit, in_shardings=(st_sh.params, batch_sh))
     def loss_eval(params, batch):
+        if overlap_fns is not None:
+            return overlap_fns["loss"](params, batch["tokens"],
+                                       batch["targets"])
         return loss(params, batch)
 
     @functools.partial(jax.jit, in_shardings=(st_sh.params, batch_sh),
@@ -144,6 +183,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "state_shardings": st_sh,
         "batch_sharding": batch_sh,
         "attn_fn": attn_fn,
+        "comm_mode": comm_mode,
     }
 
 
@@ -223,7 +263,7 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         h = out.reshape(B, S, d)
         h = gpt_mod._norm(h, params["ln_f"], cfg.norm,
                           bias=params.get("ln_f_b"),
-                          eps=1e-5 if cfg.use_bias else 1e-6)
+                          eps=gpt_mod.norm_eps(cfg))
         return gpt_mod.loss_from_hidden(params, h, targets, cfg,
                                         mesh=mesh)
 

@@ -41,6 +41,18 @@ def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
     return wrap(f)
 
 
+def axis_size(axis_name):
+    """Size of a bound mesh axis inside shard_map.
+
+    ``lax.axis_size`` appeared in jax 0.5; ``psum(1)`` is the 0.4.x
+    spelling (constant-folded to a static int).  One home for the shim
+    — ring_attention, moe and overlap all need it."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def supports_partial_manual() -> bool:
     import inspect
 

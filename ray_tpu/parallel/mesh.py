@@ -70,6 +70,24 @@ class MeshSpec:
         return self  # smaller meshes use the first `fixed` devices
 
 
+def parse_mesh_axes(arg: str) -> Dict[str, int]:
+    """``"fsdp=4,tp=2"`` -> ``{"fsdp": 4, "tp": 2}`` (CLI mesh syntax
+    shared by ``bench.py --mesh`` and the scratch drivers).  Axis names
+    are validated against :data:`AXIS_ORDER`; one axis may be ``-1``."""
+    sizes: Dict[str, int] = {}
+    for part in arg.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh axis {part!r} (want e.g. 'fsdp=4,tp=2')")
+        name, _, value = part.partition("=")
+        sizes[name.strip()] = int(value)
+    MeshSpec.create(**sizes)   # validates axis names
+    return sizes
+
+
 def make_mesh(spec: Optional[MeshSpec] = None, devices=None,
               **sizes: int):
     """Build a ``jax.sharding.Mesh`` from a spec or axis sizes.

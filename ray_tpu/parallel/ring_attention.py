@@ -100,9 +100,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     block, identical on every rank: causal work is balanced AND ~halved
     (striped/zigzag context parallelism).
     """
-    # lax.axis_size appeared in jax 0.5; psum(1) is the 0.4.x spelling
-    n = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
-         else lax.psum(1, axis_name))
+    from ray_tpu.parallel.compat import axis_size
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     if scale is None:

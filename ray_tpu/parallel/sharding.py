@@ -81,13 +81,26 @@ def named_sharding(mesh, logical_axes: Sequence[Optional[str]],
     return NamedSharding(mesh, logical_to_spec(logical_axes, rules, mesh))
 
 
+def _is_axes_leaf(x) -> bool:
+    return isinstance(x, tuple) and all(
+        isinstance(a, (str, type(None))) for a in x)
+
+
 def tree_shardings(mesh, logical_tree, rules: Optional[Rules] = None):
     """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
     return jax.tree.map(
         lambda axes: named_sharding(mesh, axes, rules),
-        logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple) and all(
-            isinstance(a, (str, type(None))) for a in x))
+        logical_tree, is_leaf=_is_axes_leaf)
+
+
+def tree_specs(mesh, logical_tree, rules: Optional[Rules] = None):
+    """Map a pytree of logical-axis tuples to bare PartitionSpecs —
+    the shard_map-facing sibling of :func:`tree_shardings`, so manual
+    paths (``parallel/overlap.py``) and GSPMD in_shardings resolve from
+    one rule table and cannot disagree."""
+    return jax.tree.map(
+        lambda axes: logical_to_spec(axes, rules, mesh),
+        logical_tree, is_leaf=_is_axes_leaf)
 
 
 def constrain(x, logical_axes: Sequence[Optional[str]],

@@ -482,6 +482,17 @@ class IciGroup(BaseGroup):
         else:
             if coordinator is None:
                 coordinator = self._rendezvous(timeout)
+            # The CPU backend ships its cross-process collectives behind
+            # a config (default "none" → "Multiprocess computations
+            # aren't implemented on the CPU backend" at the first verb).
+            # Enable gloo before the backend initializes; builds without
+            # it (or jax versions that dropped the knob) just proceed —
+            # tests/test_collective_pg.py detects that and skips.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # noqa: BLE001
+                pass
             jax.distributed.initialize(coordinator_address=coordinator,
                                        num_processes=world_size,
                                        process_id=rank)

@@ -1,0 +1,108 @@
+"""Round-8 A/B: overlap-scheduled FSDP/TP vs GSPMD on real chips.
+
+Usage: python scratch/r8_overlap.py <variant> [mesh]
+
+``mesh`` is ``bench.py --mesh`` syntax (default ``fsdp=-1`` — absorb
+every visible chip into the FSDP axis; e.g. ``fsdp=4,tp=2`` on 8).
+
+Variants (one per process so env/config land before tracing):
+  overlap   — the r08 candidate: explicit shard_map schedule with
+              prefetched per-block bf16 weight all-gathers, as-you-go
+              grad reduce-scatters, and the ppermute ring
+              all-gather-matmul TP (parallel/overlap.py)
+  gspmd     — the control arm: same model/mesh, collectives left to
+              GSPMD auto-sharding (the r07-era multichip path)
+  ring      — isolated ring all-gather-matmul vs barrier-gather
+              microbench (python -m ray_tpu._private.ray_perf
+              --collective), the kernel-level view of the same bet
+  bytes     — print the logical collective bytes/step accounting for
+              both schedules at the bench shape (no chip time needed)
+
+Carried arms (this CPU-only growth env has produced three rounds of
+kernels with no chip session yet; the r06/r07 PERF.md rows are still
+pending, so the first chip session runs everything from here):
+  pack2ab / flash / noremat / ce / b28 / b32 / b28x / b32x / bv512 /
+  bn2048 — delegated verbatim to scratch/r7_flash_ce.py (single-chip
+  arms; see its header for what each measures)
+
+The r05 rule decides the RAY_TPU_COMM default: the overlap schedule
+must remove *serialized* step time (exposed collective hops), not
+bytes the XLA scheduler already overlaps.  If overlap-vs-gspmd is flat
+or negative at the bench shape, the default stays "gspmd" and the
+number goes in docs/PERF.md either way.
+"""
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "overlap"
+MESH_ARG = sys.argv[2] if len(sys.argv) > 2 else "fsdp=-1"
+
+_R7_ARMS = ("pack2ab", "flash", "noremat", "ce", "b28", "b32", "b28x",
+            "b32x", "bv512", "bn2048")
+if VARIANT in _R7_ARMS:
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(here, "r7_flash_ce.py"),
+         VARIANT]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r8_overlap.py`
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if VARIANT == "ring":
+    from ray_tpu._private.ray_perf import collective_perf
+    collective_perf()
+    sys.exit(0)
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel import overlap as ovl  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh, parse_mesh_axes  # noqa: E402
+
+axes = parse_mesh_axes(MESH_ARG)
+mesh = make_mesh(devices=jax.devices(), **axes)
+data_par = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+# per-data-shard batch 8 with remat: the multichip recipe is untuned —
+# this driver's job is the overlap-vs-gspmd *delta*, not the knee
+batch, seq, steps = 8 * data_par, 1024, 30
+cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024, dtype=jnp.bfloat16,
+                     remat=True)
+
+if VARIANT == "bytes":
+    for mode in ("gspmd", "overlap"):
+        print(mode, ovl.collective_bytes_per_step(
+            cfg, mesh, batch=batch, seq=seq, comm_mode=mode))
+    sys.exit(0)
+
+assert VARIANT in ("overlap", "gspmd"), f"unknown variant {VARIANT!r}"
+fns = training.build_gpt_train(cfg, mesh, comm_mode=VARIANT)
+if fns["comm_mode"] != VARIANT:
+    print(f"requested {VARIANT} but got {fns['comm_mode']} "
+          "(unsupported cfg/mesh?)", file=sys.stderr)
+state = fns["init_fn"](jax.random.PRNGKey(0))
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for _ in range(2):
+    state, m = fns["step_fn"](state, bd)
+    float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(steps):
+    state, m = fns["step_fn"](state, bd)
+loss = float(m["loss"])
+dt = (time.perf_counter() - t0) / steps
+tok = batch * seq / dt
+bytes_step = ovl.collective_bytes_per_step(cfg, mesh, batch=batch,
+                                           seq=seq,
+                                           comm_mode=fns["comm_mode"])
+print(f"{VARIANT} (mesh={dict(mesh.shape)}, batch={batch}): "
+      f"{dt*1e3:7.1f} ms/step  {tok:,.0f} tok/s  "
+      f"{tok/mesh.size:,.0f} tok/s/chip  "
+      f"collective {bytes_step['total']/2**20:.0f} MiB/step/dev  "
+      f"loss {loss:.3f}", flush=True)

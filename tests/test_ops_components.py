@@ -115,6 +115,7 @@ def test_job_submission_lifecycle(ray_start_regular):
     assert bad not in {j.submission_id for j in c.list_jobs()}
 
 
+@pytest.mark.slow  # r08 --durations re-profile: tier-1 crossed the 870s budget
 def test_autoscaler_up_and_down(ray_start_cluster):
     """Sustained queue depth launches provider nodes; idleness reaps
     them (autoscaler.py parity)."""

@@ -218,3 +218,224 @@ def test_zigzag_ring_attention_grads():
     g_ref = jax.grad(
         lambda q: (local_attention(q, k, v, causal=True) ** 2).sum())(q)
     np.testing.assert_allclose(g, g_ref, atol=5e-5)
+
+
+# ---------------------------------------------------------------------------
+# r08: overlap-scheduled FSDP/TP (parallel/overlap.py)
+# ---------------------------------------------------------------------------
+
+def test_ring_allgather_matmul_matches_gather():
+    """ppermute ring AG-matmul == all_gather-then-matmul, values and
+    grads, incl. the multi-weight (one ring, several matmuls) form."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.compat import shard_map
+    from ray_tpu.parallel.overlap import ring_allgather_matmul
+
+    mesh = make_mesh(tp=8)
+    T, K, M = 16, 8, 12
+    kx, kw1, kw2 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (T, K))
+    w1 = jax.random.normal(kw1, (K, M))
+    w2 = jax.random.normal(kw2, (K, 2, 3))     # non-matrix out dims
+
+    def ring(x, w1, w2):
+        a, b = ring_allgather_matmul(x, [w1, w2], "tp")
+        return a, b
+
+    fn = jax.jit(shard_map(ring, mesh=mesh,
+                           in_specs=(P("tp", None), P(), P()),
+                           out_specs=(P(), P())))
+    a, b = fn(x, w1, w2)
+    np.testing.assert_allclose(a, x @ w1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(b, np.einsum("tk,kab->tab", x, w2),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads flow through the ring (transpose = ring matmul-accumulate)
+    def loss(x):
+        a, _ = fn(x, w1, w2)
+        return (a ** 2).sum()
+    g = jax.grad(loss)(x)
+    g_ref = jax.grad(lambda x: ((x @ w1) ** 2).sum())(x)
+    np.testing.assert_allclose(g, g_ref, rtol=1e-5, atol=1e-5)
+
+    # no ring axis -> plain matmul
+    np.testing.assert_allclose(ring_allgather_matmul(x, w1, None),
+                               x @ w1, rtol=1e-6, atol=1e-6)
+
+
+def _overlap_vs_gspmd(cfg, axes, *, batch_size=8, seq=32, masked=False,
+                      rtol=2e-4, atol=2e-5, grad_atol=5e-5):
+    """Loss + per-parameter grad parity of the overlap schedule against
+    the GSPMD path on the same mesh, from identical (GSPMD-initialized)
+    params."""
+    from ray_tpu.models import gpt as gpt_mod, training
+    from ray_tpu.parallel import overlap as ovl
+
+    mesh = make_mesh(**axes)
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1),
+                                        batch_size, seq, cfg.vocab_size)
+    if masked:
+        t = np.array(batch["targets"])
+        t[:, : seq // 4] = -1
+        batch["targets"] = jnp.asarray(t)
+    fns_g = training.build_gpt_train(cfg, mesh, comm_mode="gspmd")
+    st = fns_g["init_fn"](jax.random.PRNGKey(0))
+
+    def gspmd_loss(p, b):
+        return gpt_mod.loss_fn(p, b, cfg, attn_fn=fns_g["attn_fn"],
+                               mesh=mesh)
+
+    l_ref, g_ref = jax.jit(jax.value_and_grad(gspmd_loss))(st.params,
+                                                           batch)
+    o = ovl.build_overlap_step_fns(cfg, mesh)
+    l_ovl, g_ovl = jax.jit(o["value_and_grad"])(
+        st.params, batch["tokens"], batch["targets"])
+    np.testing.assert_allclose(float(l_ovl), float(l_ref),
+                               rtol=rtol, atol=atol)
+    for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(g_ref),
+            jax.tree.leaves(g_ovl)):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32),
+            rtol=5e-3, atol=grad_atol,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)} "
+                    f"on mesh {axes}")
+
+
+def test_overlap_fsdp_parity():
+    """Pure-FSDP overlap schedule (prefetched per-block gathers,
+    per-block grad reduce-scatters) matches GSPMD exactly in f32."""
+    from ray_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    _overlap_vs_gspmd(cfg, {"fsdp": 8})
+
+
+def test_overlap_fsdp_tp_parity():
+    """fsdp x tp: ring all-gather-matmul TP + vocab-parallel CE, with
+    masked targets and an odd layer count (the scan's double-buffer
+    wraparound block)."""
+    from ray_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=3, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    _overlap_vs_gspmd(cfg, {"fsdp": 4, "tp": 2}, masked=True)
+
+
+def test_overlap_uneven_shapes_parity():
+    """Ragged shapes: d_ff/seq chunks far from lane multiples, batch
+    that splits into odd-sized (3-row) shards over the batch axes."""
+    from ray_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=192, d_model=48, n_layers=3, n_heads=4,
+                    d_ff=40, max_seq=24, dtype=jnp.float32)
+    _overlap_vs_gspmd(cfg, {"fsdp": 2, "tp": 4}, batch_size=6, seq=24)
+
+
+@pytest.mark.slow
+def test_overlap_full_mesh_variants():
+    """dp x fsdp x tp with unroll+remat, and the bf16 arm
+    (bf16-gather-aware tolerances: gathered weights and ring chunks
+    round per hop)."""
+    from ray_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32, unroll_layers=True,
+                    remat=True)
+    _overlap_vs_gspmd(cfg, {"dp": 2, "fsdp": 2, "tp": 2})
+    cfg16 = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                      max_seq=32, dtype=jnp.bfloat16)
+    _overlap_vs_gspmd(cfg16, {"fsdp": 4, "tp": 2}, rtol=3e-2,
+                      atol=3e-2, grad_atol=3e-2)
+
+
+@pytest.mark.slow  # r08 budget: dryrun_multichip runs an overlap step too
+def test_overlap_step_trains():
+    """build_gpt_train(comm_mode='overlap'): the full jitted train step
+    (optimizer + donation) runs and loss decreases."""
+    import optax
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    mesh = make_mesh(fsdp=4, tp=2)
+    fns = training.build_gpt_train(cfg, mesh, comm_mode="overlap",
+                                   optimizer=optax.adam(1e-2))
+    assert fns["comm_mode"] == "overlap"
+    st = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 8, 32,
+                                        256)
+    l0 = None
+    for _ in range(6):
+        st, m = fns["step_fn"](st, batch)
+        l0 = l0 if l0 is not None else float(m["loss"])
+    assert float(m["loss"]) < l0 - 0.3
+    assert float(m["grad_norm"]) == float(m["grad_norm"])  # not NaN
+
+
+def test_comm_config_and_fallback_dispatch(monkeypatch):
+    """comm_config env resolution + the loud gspmd fallbacks for
+    unsupported (cfg, mesh) combinations."""
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel import overlap as ovl
+
+    monkeypatch.setenv("RAY_TPU_COMM", "overlap")
+    assert ovl.comm_config(refresh=True).mode == "overlap"
+    monkeypatch.setenv("RAY_TPU_COMM", "bogus")
+    assert ovl.comm_config(refresh=True).mode == "gspmd"
+    monkeypatch.delenv("RAY_TPU_COMM")
+    assert ovl.comm_config(refresh=True).mode == "gspmd"
+
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    # sp mesh: outside overlap coverage -> falls back, says why
+    assert "sp" in ovl.overlap_supported(cfg, make_mesh(dp=2, sp=4))
+    fns = training.build_gpt_train(cfg, make_mesh(dp=2, sp=4),
+                                   comm_mode="overlap")
+    assert fns["comm_mode"] == "gspmd"
+    # indivisible heads / moe all have reasons
+    cfg3 = GPTConfig(vocab_size=256, d_model=66, n_layers=2, n_heads=3,
+                     max_seq=32)
+    assert "n_heads" in ovl.overlap_supported(cfg3, make_mesh(tp=2))
+    assert "MoE" in ovl.overlap_supported(
+        GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                  n_experts=2), make_mesh(fsdp=2))
+    assert ovl.overlap_supported(cfg, make_mesh(fsdp=4, tp=2)) is None
+    # single device: nothing to schedule
+    from ray_tpu.parallel.mesh import single_device_mesh
+    fns1 = training.build_gpt_train(cfg, single_device_mesh(),
+                                    comm_mode="overlap")
+    assert fns1["comm_mode"] == "gspmd"
+
+
+def test_parse_mesh_axes():
+    from ray_tpu.parallel.mesh import parse_mesh_axes
+
+    assert parse_mesh_axes("fsdp=4,tp=2") == {"fsdp": 4, "tp": 2}
+    assert parse_mesh_axes("dp=-1") == {"dp": -1}
+    with pytest.raises(ValueError):
+        parse_mesh_axes("bogus=2")
+    with pytest.raises(ValueError):
+        parse_mesh_axes("fsdp4")
+
+
+def test_collective_bytes_accounting():
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel import overlap as ovl
+    from ray_tpu.parallel.mesh import single_device_mesh
+
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    for mode in ("gspmd", "overlap"):
+        zero = ovl.collective_bytes_per_step(
+            cfg, single_device_mesh(), batch=8, seq=32, comm_mode=mode)
+        assert zero["total"] == 0
+        multi = ovl.collective_bytes_per_step(
+            cfg, make_mesh(fsdp=4, tp=2), batch=8, seq=32,
+            comm_mode=mode)
+        assert multi["weight_allgather"] > 0
+        assert multi["grad_reduce_scatter"] > 0
+        assert multi["tp_ring"] > 0
+        assert multi["total"] == sum(v for k, v in multi.items()
+                                     if k != "total")

@@ -18,6 +18,7 @@ def test_gae_computation():
     np.testing.assert_allclose(out["value_targets"], [3.0, 2.0, 1.0])
 
 
+@pytest.mark.slow  # r08 --durations re-profile: tier-1 crossed the 870s budget (dqn/bc cover learning)
 def test_ppo_learns_cartpole(ray_start_regular):
     from ray_tpu.rllib.algorithms.ppo import PPOConfig
     algo = (PPOConfig()
@@ -84,6 +85,7 @@ def test_ppo_learner_group_ddp(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow  # r08 --durations re-profile: tier-1 crossed the 870s budget (bc covers learning)
 def test_dqn_learns_cartpole(ray_start_regular):
     """Double-DQN + target net + replay improves CartPole return
     (parity: rllib/algorithms/dqn new stack)."""

@@ -91,6 +91,7 @@ def test_trainer_failure_restart(ray_start_regular, tmp_path):
     assert attempts == {0, 1}
 
 
+@pytest.mark.slow  # r08 --durations re-profile: tier-1 crossed the 870s budget
 def test_jax_trainer_dp_allreduce(ray_start_regular, tmp_path):
     """2-worker data-parallel jax training with host-collective grad sync."""
     import ray_tpu.train as train
