@@ -20,21 +20,12 @@ import time
 
 H100_GPT2_TOKENS_PER_SEC = 255_000.0
 
-# bf16 peak of the chip families we may land on (for the MFU figure)
-_CHIP_PEAK_TFLOPS = {
-    "v4": 275.0,
-    "v5 lite": 197.0, "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0, "v6e": 918.0,
-}
-
 
 def _chip_peak(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _CHIP_PEAK_TFLOPS.items():
-        if key in kind:
-            return peak
-    return 197.0
+    # the peak table moved to the telemetry layer (single home for the
+    # MFU accounting); this alias keeps the historical bench entry
+    from ray_tpu.telemetry.flops import chip_peak_tflops
+    return chip_peak_tflops(device)
 
 
 def _kernel_smoke():
@@ -179,11 +170,18 @@ def _bench_mesh_body(axes):
             for _ in range(2):
                 state, metrics = fns["step_fn"](state, batch_data)
                 float(metrics["loss"])
+        # raw jit step for the timed loop (same executable the wrapped
+        # warmup compiled — the light wrapper delegates to it), then a
+        # short instrumented window for the telemetry steady stats
+        raw_step = fns.get("raw_step_fn", fns["step_fn"])
         t0 = _time.perf_counter()
         for _ in range(steps):
-            state, metrics = fns["step_fn"](state, batch_data)
+            state, metrics = raw_step(state, batch_data)
         float(metrics["loss"])
         dt = _time.perf_counter() - t0
+        if "telemetry" in fns:
+            for _ in range(3):
+                state, metrics = fns["step_fn"](state, batch_data)
         tok_s = steps * batch * seq / dt
         record = {
             "metric": "gpt2_train_tokens_per_sec_multichip",
@@ -199,6 +197,8 @@ def _bench_mesh_body(axes):
                 cfg, mesh, batch=batch, seq=seq, comm_mode=mode),
             "final_loss": round(float(metrics["loss"]), 4),
         }
+        if "telemetry" in fns:
+            record["telemetry"] = fns["telemetry"].summary()
         if fallback:
             record["fallback_from"] = fallback
         print(json.dumps(record))
@@ -274,8 +274,19 @@ def main():
         return "noremat" if cfg.ce_chunk < 0 else "chunked"
 
     def build(cfg, pack2, ce_pin):
+        # bench owns its recorder (AOT mode: exact compile split + HBM
+        # memory_analysis) instead of the builders' default light wrap.
+        # profile_dir is forced off: the xplane capture starts at
+        # warmup step 1 and would still be running through the timed
+        # headline loop (use scratch/r9_telemetry.py for captures).
+        import ray_tpu.telemetry as tel_mod
         fns = training.build_gpt_train(cfg, mesh, attn_pack2=pack2,
-                                       ce_mode=ce_pin)
+                                       ce_mode=ce_pin, telemetry=False)
+        fns = tel_mod.instrument(
+            fns, cfg, mesh, comm_mode=fns["comm_mode"],
+            ce_mode=ce_pin, label="bench", aot=True,
+            config=tel_mod.TelemetryConfig(
+                enabled=tel_mod.telemetry_config().enabled))
         return fns, fns["init_fn"](jax.random.PRNGKey(0))
 
     fns, state = build(cfg, attn_pack2, ce_pin)
@@ -319,12 +330,27 @@ def main():
                   f"falling back: {what}", file=sys.stderr)
             fns, state = build(cfg, attn_pack2, ce_pin)
 
+    # the timed headline loop must NOT run through the telemetry
+    # wrapper: its per-step blocking sync would serialize host dispatch
+    # into the figure and break comparability with r05-r08 JSON.  The
+    # AOT executable is the same compiled program the wrapped warmup
+    # ran (no recompile); if the AOT path fell back, raw_step is the
+    # raw jit call the wrapper delegates to.
+    tel = fns.get("telemetry")
+    raw_step = ((tel.compiled_step() if tel else None)
+                or fns.get("raw_step_fn", fns["step_fn"]))
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = fns["step_fn"](state, batch_data)
+        state, metrics = raw_step(state, batch_data)
     # fetching the last loss forces the whole state-dependency chain
     float(metrics["loss"])
     dt = time.perf_counter() - t0
+    if tel:
+        # short instrumented window AFTER the measurement:
+        # steady-state telemetry stats come from per-step blocking
+        # syncs outside the timed loop
+        for _ in range(3):
+            state, metrics = fns["step_fn"](state, batch_data)
 
     tokens_per_step = batch * seq
     tok_s = steps * tokens_per_step / dt
@@ -360,7 +386,13 @@ def main():
         "comm_mode": fns["comm_mode"],
         "collective_bytes_per_step": _collective_bytes(
             cfg, mesh, batch, seq, fns["comm_mode"]),
+        # per-step telemetry (compile split, blocking-sync step time,
+        # analytic-FLOPs MFU, HBM memory_analysis, collective bytes);
+        # {"enabled": False} under RAY_TPU_TELEMETRY=0
+        "telemetry": tel.summary() if tel else {"enabled": False},
     }
+    if tel:
+        tel.stop()
     print(json.dumps(result))
 
     if "--components" in sys.argv and not quick:

@@ -194,7 +194,8 @@ class ControlPlane:
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_cap = 65536
         # errors pushed to drivers
-        self._counters: Dict[str, int] = defaultdict(int)
+        # int-valued until a float increment arrives (user metrics)
+        self._counters: Dict[str, float] = defaultdict(int)
         self.start_time = time.time()
 
     # ----------------------------------------------------- persistence ----
@@ -872,7 +873,9 @@ class ControlPlane:
             return list(last.values())
 
     # -------------------------------------------------------- counters ----
-    def incr(self, name: str, amount: int = 1) -> int:
+    def incr(self, name: str, amount: float = 1) -> float:
+        """Accumulate a counter; float amounts accumulate exactly
+        (user metrics count fractional quantities, e.g. seconds)."""
         with self._lock:
             self._counters[name] += amount
             return self._counters[name]

@@ -49,6 +49,19 @@ def chrome_tracing_dump(task_events: List[Dict[str, Any]],
 
 def timeline(filename: Optional[str] = None,
              limit: int = 100_000) -> str:
+    """Cluster task timeline + the unified host/train telemetry events
+    (``ray_tpu.telemetry.chrome_trace``) as one Chrome-trace array, so
+    the dashboard ``/api/timeline`` shows train steps beside tasks."""
     from ray_tpu._private.worker import global_worker
     events = global_worker().cp.list_task_events(limit)
-    return chrome_tracing_dump(events, filename)
+    trace = json.loads(chrome_tracing_dump(events))
+    try:
+        from ray_tpu.telemetry import chrome_trace
+        trace.extend(chrome_trace.trace_events())
+    except Exception:  # noqa: BLE001 — telemetry is optional here
+        pass
+    out = json.dumps(trace)
+    if filename:
+        with open(filename, "w") as f:
+            f.write(out)
+    return out

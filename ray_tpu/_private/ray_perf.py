@@ -11,7 +11,9 @@ fwd+bwd at the bench shape, flash-CE (streamed-logits Pallas kernel)
 vs the no-remat XLA control.  ``collective_perf`` (``--collective``)
 is the comm-schedule analogue: ring all-gather-matmul
 (``parallel/overlap.py``) vs the barrier all-gather-then-matmul on a
-tp ring.
+tp ring.  ``train_step_perf`` (``--train``) runs the full train step
+through the telemetry recorder and prints the ``telemetry`` JSON block
+(compile split / MFU / HBM) in isolation.
 """
 
 from __future__ import annotations
@@ -177,6 +179,56 @@ def ce_perf(n_tokens: int = 24576, d_model: int = 768,
           f"{result['effective_tflops']:.1f} eff TFLOPs "
           f"({matmuls} vocab matmuls)")
     return result
+
+
+def train_step_perf(steps: int = 8, batch: Optional[int] = None,
+                    seq: Optional[int] = None) -> Dict[str, float]:
+    """Instrumented GPT train-step microbench: one telemetry block.
+
+    Runs ``steps`` steps of the single-device GPT train step through a
+    :class:`ray_tpu.telemetry.StepTelemetry` recorder in AOT mode and
+    prints the ``telemetry`` summary as one JSON line — compile split,
+    blocking-sync steady step time, tokens/s, analytic-FLOPs MFU and
+    the ``memory_analysis()`` HBM footprint, the same block
+    ``bench.py`` attaches to its headline JSON.  On CPU the shapes
+    shrink to a smoke configuration (numbers exercise the recorder,
+    not the hardware).
+    """
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.telemetry import StepTelemetry
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=False,
+                             unroll_layers=True, ce_chunk=-1)
+        batch, seq = batch or 24, seq or 1024
+    else:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        batch, seq = batch or 4, seq or 128
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    fns = training.build_gpt_train(cfg, mesh, telemetry=False)
+    tel = StepTelemetry(cfg, mesh, comm_mode=fns["comm_mode"],
+                        label="ray_perf", aot=True)
+    step = tel.wrap(fns["step_fn"])
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    data = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch,
+                                       seq, cfg.vocab_size)
+    for _ in range(steps):
+        state, _ = step(state, data)
+    tel.stop()
+    summary = tel.summary()
+    summary["metric"] = "train_step_telemetry"
+    print(json.dumps(summary))
+    return summary
 
 
 def collective_perf(tokens: int = 4096, d_model: int = 512,
@@ -364,6 +416,9 @@ if __name__ == "__main__":
     elif "--collective" in sys.argv:
         # TP-schedule A/B: ring all-gather-matmul vs barrier gather
         collective_perf()
+    elif "--train" in sys.argv:
+        # instrumented train step: the bench telemetry block in isolation
+        train_step_perf()
     else:
         ray_tpu.init()
         try:

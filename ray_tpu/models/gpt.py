@@ -291,41 +291,44 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
     (``models/training.py`` build_gpt_train_pp)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
     eps = norm_eps(cfg)
-    h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
-    # (a fused [d, 3Hk] qkv projection was A/B'd on the v5e bench and
-    # lost ~5%: the runtime weight concat serializes against the
-    # matmul and XLA already pipelines the three projections)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-    if "bq" in lp:
-        q = q + lp["bq"]
-        k = k + lp["bk"]
-        v = v + lp["bv"]
-    fused_rope = (cfg.pos == "rope"
-                  and getattr(attn_fn, "fused_rope", False))
-    if cfg.pos == "rope" and not fused_rope:
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-    q = constrain(q, ("batch", "seq", "heads", None))
-    k = constrain(k, ("batch", "seq", "heads", None))
-    v = constrain(v, ("batch", "seq", "heads", None))
-    if fused_rope:
-        attn = attn_fn(q, k, v, positions=positions)
-    else:
-        attn = attn_fn(q, k, v)
-    attn = constrain(attn, ("batch", "seq", "heads", None))
-    proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-    if "bo" in lp:
-        proj = proj + lp["bo"]
-    x = x + proj
-    h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"), eps=eps)
-    if cfg.n_experts > 0:
-        ffn_out, aux = _moe_ffn(lp, h2, cfg)
-    else:
-        ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
-    x = x + ffn_out
-    x = constrain(x, ("batch", "seq", None))
+    with jax.named_scope("gpt/attn"):
+        h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
+        # (a fused [d, 3Hk] qkv projection was A/B'd on the v5e bench
+        # and lost ~5%: the runtime weight concat serializes against
+        # the matmul and XLA already pipelines the three projections)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        if "bq" in lp:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        fused_rope = (cfg.pos == "rope"
+                      and getattr(attn_fn, "fused_rope", False))
+        if cfg.pos == "rope" and not fused_rope:
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+        q = constrain(q, ("batch", "seq", "heads", None))
+        k = constrain(k, ("batch", "seq", "heads", None))
+        v = constrain(v, ("batch", "seq", "heads", None))
+        if fused_rope:
+            attn = attn_fn(q, k, v, positions=positions)
+        else:
+            attn = attn_fn(q, k, v)
+        attn = constrain(attn, ("batch", "seq", "heads", None))
+        proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+        if "bo" in lp:
+            proj = proj + lp["bo"]
+        x = x + proj
+    with jax.named_scope("gpt/ffn"):
+        h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"),
+                   eps=eps)
+        if cfg.n_experts > 0:
+            ffn_out, aux = _moe_ffn(lp, h2, cfg)
+        else:
+            ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
+        x = x + ffn_out
+        x = constrain(x, ("batch", "seq", None))
     return x, aux
 
 
@@ -342,11 +345,13 @@ def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     """
     constrain = functools.partial(shd.constrain, mesh=mesh)
     S = tokens.shape[1]
-    table = constrain(params["embed"].astype(cfg.dtype), (None, None))
-    x = constrain(table[tokens], ("batch", "seq", None))
-    if cfg.pos == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
-    return constrain(x, ("batch", "seq", None))
+    with jax.named_scope("gpt/embed"):
+        table = constrain(params["embed"].astype(cfg.dtype),
+                          (None, None))
+        x = constrain(table[tokens], ("batch", "seq", None))
+        if cfg.pos == "learned":
+            x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
+        return constrain(x, ("batch", "seq", None))
 
 
 def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
@@ -360,11 +365,12 @@ def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
     SPMD rule, so on a sharded mesh the XLA formulations run instead —
     lifting that with a shard_map wrapper is an open item)."""
     B, S, d = x.shape
-    s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
-                       targets.reshape(B * S),
-                       chunk=getattr(cfg, "ce_chunk", _CE_CHUNK),
-                       mesh=mesh, mode=ce_mode)
-    return s / jnp.maximum(n, 1.0)
+    with jax.named_scope("gpt/ce"):
+        s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
+                           targets.reshape(B * S),
+                           chunk=getattr(cfg, "ce_chunk", _CE_CHUNK),
+                           mesh=mesh, mode=ce_mode)
+        return s / jnp.maximum(n, 1.0)
 
 
 def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,

@@ -65,12 +65,36 @@ def _batch_sharding(mesh):
     return NamedSharding(mesh, P(shd.data_axes(mesh), seq_axis))
 
 
+def _maybe_instrument(fns: Dict[str, Callable], cfg, mesh, *,
+                      comm_mode: Optional[str] = None,
+                      ce_mode: Optional[str] = None,
+                      label: str = "train",
+                      telemetry: Optional[bool] = None):
+    """Wrap ``fns["step_fn"]`` with a :class:`StepTelemetry` recorder.
+
+    ``telemetry``: ``None`` follows ``RAY_TPU_TELEMETRY`` (default on),
+    ``False`` skips, ``True`` forces on (A/B drivers).  When on, the
+    dict gains ``telemetry`` (the recorder) and ``raw_step_fn``."""
+    if telemetry is False:
+        return fns
+    from ray_tpu import telemetry as tel_mod
+    config = None
+    if telemetry is True:
+        config = tel_mod.TelemetryConfig(
+            enabled=True,
+            profile_dir=tel_mod.telemetry_config().profile_dir)
+    return tel_mod.instrument(fns, cfg, mesh, comm_mode=comm_mode,
+                              ce_mode=ce_mode, label=label,
+                              config=config)
+
+
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     optimizer=None,
                     sp_impl: str = "ring",
                     attn_pack2: Optional[bool] = None,
                     ce_mode: Optional[str] = None,
-                    comm_mode: Optional[str] = None) -> Dict[str, Callable]:
+                    comm_mode: Optional[str] = None,
+                    telemetry: Optional[bool] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
@@ -90,7 +114,10 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     mode is returned as ``fns["comm_mode"]``.  The overlap step/loss
     use their own block formulation (einsum attention, vocab-parallel
     CE), so ``attn_pack2``/``ce_mode`` only affect the GSPMD-side
-    ``forward_fn`` there.
+    ``forward_fn`` there.  ``telemetry`` (default: env
+    ``RAY_TPU_TELEMETRY``) wraps ``step_fn`` with a per-step
+    :class:`ray_tpu.telemetry.StepTelemetry` recorder — the returned
+    dict then also carries ``telemetry`` and ``raw_step_fn``.
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
     from ray_tpu.parallel import overlap as ovl
@@ -175,7 +202,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                                     attn_fn=attn_fn, mesh=mesh)
         return logits
 
-    return {
+    fns = {
         "init_fn": init_jit,
         "step_fn": step,
         "loss_fn": loss_eval,
@@ -185,11 +212,15 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "attn_fn": attn_fn,
         "comm_mode": comm_mode,
     }
+    return _maybe_instrument(fns, cfg, mesh, comm_mode=comm_mode,
+                             ce_mode=ce_mode, telemetry=telemetry)
 
 
 def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                        num_microbatches: Optional[int] = None,
-                       optimizer=None) -> Dict[str, Callable]:
+                       optimizer=None,
+                       telemetry: Optional[bool] = None
+                       ) -> Dict[str, Callable]:
     """Pipeline-parallel GPT training over a mesh with a ``pp`` axis.
 
     The layer stack ``[L, ...]`` is reshaped to ``[pp, L/pp, ...]`` and
@@ -284,7 +315,7 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
     def loss_eval(params, batch):
         return loss(params, batch)
 
-    return {
+    fns = {
         "init_fn": init_jit,
         "step_fn": step,
         "loss_fn": loss_eval,
@@ -292,6 +323,8 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         "batch_sharding": batch_sh,
         "num_microbatches": M,
     }
+    return _maybe_instrument(fns, cfg, mesh, label="train_pp",
+                             telemetry=telemetry)
 
 
 def synthetic_lm_batch(key, batch_size: int, seq_len: int,

@@ -1191,16 +1191,18 @@ def flash_attention(q, k, v, *, causal: bool = True,
         qp = jnp.swapaxes(q.reshape(B, S, H // 2, Dp), 1, 2)
         kp = jnp.swapaxes(k.reshape(B, Sk, H // 2, Dp), 1, 2)
         vp = jnp.swapaxes(v.reshape(B, Sk, H // 2, Dp), 1, 2)
-        if fuse_rope:
-            cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
-            cos2 = jnp.concatenate([cos2, cos2], -1)      # [S, 128]
-            sinm = jnp.concatenate([sinm, sinm], -1)
-            op = _flash_pack2_rope(qp, kp, vp, cos2, sinm, scale,
-                                   causal, pbq, pbk, pbwq, pbwk)
-        else:
-            op = _flash_pack2(qp, kp, vp, scale, causal, pbq, pbk,
-                              pbwq, pbwk)
-        return jnp.swapaxes(op, 1, 2).reshape(B, S, H, D)
+        with jax.named_scope("attn/pack2"):
+            if fuse_rope:
+                cos2, sinm = rope_tables(positions, D, rope_theta,
+                                         q.dtype)
+                cos2 = jnp.concatenate([cos2, cos2], -1)  # [S, 128]
+                sinm = jnp.concatenate([sinm, sinm], -1)
+                op = _flash_pack2_rope(qp, kp, vp, cos2, sinm, scale,
+                                       causal, pbq, pbk, pbwq, pbwk)
+            else:
+                op = _flash_pack2(qp, kp, vp, scale, causal, pbq, pbk,
+                                  pbwq, pbwk)
+            return jnp.swapaxes(op, 1, 2).reshape(B, S, H, D)
 
     if bwd_block_q is None:
         bwd_block_q = cfg.bwd_block_q if causal else block_q
@@ -1220,18 +1222,21 @@ def flash_attention(q, k, v, *, causal: bool = True,
         k = rope_rotate(k, positions, rope_theta)
     if not kernel_ok:
         from ray_tpu.parallel.ring_attention import local_attention
-        return local_attention(q, k, v, causal=causal, scale=scale)
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    if fuse_rope:
-        cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
-        o = _flash_bhsd_rope(qt, kt, vt, cos2, sinm, scale, causal,
-                             block_q, block_k, bwd_block_q, bwd_block_k)
-    else:
-        o = _flash_bhsd(qt, kt, vt, scale, causal, block_q, block_k,
-                        bwd_block_q, bwd_block_k)
-    return jnp.swapaxes(o, 1, 2)
+        with jax.named_scope("attn/xla"):
+            return local_attention(q, k, v, causal=causal, scale=scale)
+    with jax.named_scope("attn/flash"):
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        if fuse_rope:
+            cos2, sinm = rope_tables(positions, D, rope_theta, q.dtype)
+            o = _flash_bhsd_rope(qt, kt, vt, cos2, sinm, scale, causal,
+                                 block_q, block_k, bwd_block_q,
+                                 bwd_block_k)
+        else:
+            o = _flash_bhsd(qt, kt, vt, scale, causal, block_q,
+                            block_k, bwd_block_q, bwd_block_k)
+        return jnp.swapaxes(o, 1, 2)
 
 
 def make_flash_attention_fn(mesh=None, *, causal: bool = True,

@@ -412,9 +412,11 @@ def flash_ce_sum(x, head, targets, *, block_n: Optional[int] = None,
     N, d = x.shape
     V = head.shape[1]
     if not supports(N, d, V):
-        return _xla_ce_sum(x, head, targets)
-    return _flash_ce(x, head, targets,
-                     block_n or cfg.block_n,
-                     block_v or cfg.block_v,
-                     bwd_block_n or cfg.bwd_block_n,
-                     bwd_block_v or cfg.bwd_block_v)
+        with jax.named_scope("ce/xla"):
+            return _xla_ce_sum(x, head, targets)
+    with jax.named_scope("ce/flash"):
+        return _flash_ce(x, head, targets,
+                         block_n or cfg.block_n,
+                         block_v or cfg.block_v,
+                         bwd_block_n or cfg.bwd_block_n,
+                         bwd_block_v or cfg.bwd_block_v)

@@ -1,0 +1,526 @@
+"""Step-level training telemetry: the :class:`StepTelemetry` recorder.
+
+Wraps a jitted train step (``build_gpt_train``/``build_gpt_train_pp``
+``step_fn``) and emits one structured record per step:
+
+- wall time with an explicit blocking ``jax.block_until_ready`` sync,
+  split into dispatch (host returns) and sync (device drains),
+- first-step compile time split from steady state — in AOT mode
+  (``aot=True``) via an explicit ``lower().compile()`` whose compiled
+  executable also yields the HBM footprint from ``memory_analysis()``,
+- tokens/sec and an analytic-FLOPs MFU estimate
+  (:mod:`ray_tpu.telemetry.flops`) against the chip peak,
+- logical collective bytes/step per comm_mode
+  (``ray_tpu.parallel.overlap.collective_bytes_per_step``).
+
+Records flow to three sinks: the Chrome-trace exporter
+(:mod:`ray_tpu.telemetry.chrome_trace`, merged into the dashboard
+``/api/timeline``), Prometheus gauges/histograms through the
+control-plane metrics (``train_step_seconds`` / ``train_mfu`` /
+``train_collective_bytes`` on ``/metrics``), and the ``telemetry``
+block in ``bench.py`` / ``ray_perf.py`` JSON.  ``RAY_TPU_TELEMETRY=0``
+turns the whole wrapper into identity; ``RAY_TPU_PROFILE=<dir>``
+additionally captures a ``jax.profiler`` xplane trace of the first
+steady steps (see :mod:`ray_tpu.telemetry.config`).
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.telemetry import flops as flops_mod
+from ray_tpu.telemetry.config import telemetry_config
+
+# live recorders, so the chrome-trace exporter / dashboard timeline can
+# merge every in-process training loop without explicit plumbing
+_RECORDERS: "weakref.WeakSet[StepTelemetry]" = weakref.WeakSet()
+
+
+def recorders() -> List["StepTelemetry"]:
+    return list(_RECORDERS)
+
+
+def _memory_dict(compiled) -> Optional[Dict[str, int]]:
+    """``memory_analysis()`` of an AOT-compiled step as plain ints."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend may not implement it
+        return None
+    if ma is None:
+        return None
+    out: Dict[str, int] = {}
+    for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes",
+                        "generated_code_bytes")):
+        val = getattr(ma, field, None)
+        if val is not None:
+            out[key] = int(val)
+    if not out:
+        return None
+    # arguments alias outputs for donated buffers; the liveness-ish
+    # total charges each once
+    out["total_bytes"] = (out.get("argument_bytes", 0)
+                          + out.get("output_bytes", 0)
+                          + out.get("temp_bytes", 0)
+                          + out.get("generated_code_bytes", 0)
+                          - out.get("alias_bytes", 0))
+    return out
+
+
+def _arg_signature(args):
+    import jax
+    return tuple(
+        (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", "")))
+        for leaf in jax.tree.leaves(args))
+
+
+def _find_tokens(args, kwargs):
+    """The [B, S] token array of a step call, if one is recognizable."""
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, dict) and "tokens" in a:
+            tok = a["tokens"]
+            if hasattr(tok, "shape") and len(tok.shape) == 2:
+                return tok
+    return None
+
+
+class StepTelemetry:
+    """Per-step telemetry recorder around one jitted train step.
+
+    ``aot=True`` routes the first call through
+    ``step_fn.lower(...).compile()`` — one compile total, an exact
+    compile/steady split, and ``memory_analysis()`` HBM numbers; any
+    failure on that path falls back loudly to the plain jit call.
+    ``aot=False`` (the default the train-step builders use) never
+    re-routes compilation: the first step's wall time simply includes
+    the jit compile and is reported as ``first_step_s``.
+    """
+
+    _MAX_RECORDS = 10_000
+
+    def __init__(self, cfg=None, mesh=None, *,
+                 comm_mode: Optional[str] = None,
+                 ce_mode: Optional[str] = None,
+                 label: str = "train",
+                 aot: bool = False,
+                 chip_peak_tflops: Optional[float] = None,
+                 config=None):
+        tcfg = config or telemetry_config()
+        self.enabled: bool = tcfg.enabled
+        self.cfg = cfg
+        self.mesh = mesh
+        self.comm_mode = comm_mode
+        self.ce_mode = ce_mode
+        self.label = label
+        self.records: List[Dict[str, Any]] = []
+        self.step_count = 0      # total steps seen (survives trimming)
+        self.compile_s: Optional[float] = None
+        self.first_step_s: Optional[float] = None
+        self.memory: Optional[Dict[str, int]] = None
+        self._aot = aot
+        self._cfgobj = tcfg
+        self._compiled = None
+        self._signature = None
+        self._compile_ts: Optional[float] = None
+        self._tokens_per_step: Optional[int] = None
+        self._seq: Optional[int] = None
+        self._batch: Optional[int] = None
+        self._peak = chip_peak_tflops
+        self._fpt: Optional[float] = None   # cached; -1 = unavailable
+        self._metrics = None          # lazily-created metric objects
+        self._metrics_dead = False    # no cluster / emission failed
+        self._metrics_last = 0.0      # last emission (monotonic)
+        self._bytes_emitted = False
+        self._profile_started = False
+        self._profile_stopped = False
+        if self.enabled:
+            _RECORDERS.add(self)
+
+    # ------------------------------------------------------------- wrap --
+
+    def wrap(self, step_fn):
+        """``step_fn -> step_fn`` (identity when telemetry is off)."""
+        if not self.enabled:
+            return step_fn
+        import functools
+
+        @functools.wraps(step_fn)
+        def wrapped(*args, **kwargs):
+            return self._call(step_fn, args, kwargs)
+
+        wrapped.telemetry = self
+        return wrapped
+
+    def _call(self, step_fn, args, kwargs):
+        import jax
+
+        from ray_tpu.util import tracing
+        i = self.step_count
+        self.step_count += 1
+        self._note_tokens(args, kwargs)
+        self._profile(i, before=True)
+        ts = time.time()
+        t0 = time.monotonic()
+        with tracing.span(f"{self.label}/step", step=i):
+            with jax.profiler.StepTraceAnnotation(self.label, step_num=i):
+                with tracing.span(f"{self.label}/dispatch", step=i):
+                    out = self._dispatch(step_fn, args, kwargs, i, ts)
+                t_disp = time.monotonic()
+                with tracing.span(f"{self.label}/sync", step=i):
+                    jax.block_until_ready(out)
+        t_end = time.monotonic()
+        self._profile(i, before=False)
+        rec: Dict[str, Any] = {
+            "step": i,
+            "ts": ts,
+            "wall_s": t_end - t0,
+            "dispatch_s": t_disp - t0,
+            "sync_s": t_end - t_disp,
+        }
+        if i == 0 and self.compile_s is not None:
+            rec["compile_s"] = self.compile_s
+        if i == 0:
+            self.first_step_s = rec["wall_s"]
+        if self._tokens_per_step:
+            rec["tokens"] = self._tokens_per_step
+            # step 0's wall includes the (jit or AOT) compile — a
+            # throughput/MFU derived from it would be garbage, and step
+            # 0 is the one record always emitted to Prometheus
+            if i > 0:
+                rec["tokens_per_sec"] = (self._tokens_per_step
+                                         / rec["wall_s"])
+                fpt = self.flops_per_token()
+                if fpt is not None:
+                    rec["mfu"] = flops_mod.mfu(
+                        rec["tokens_per_sec"] / self.n_devices(), fpt,
+                        self.chip_peak())
+        loss = self._maybe_loss(out)
+        if loss is not None:
+            rec["loss"] = loss
+        self.records.append(rec)
+        if len(self.records) > self._MAX_RECORDS:
+            # bounded like the control plane's task-event buffer: a
+            # 100k-step run must not grow host memory (or the exported
+            # timeline) without limit.  first_step_s/compile_s live as
+            # attributes, so trimming the head loses nothing summary()
+            # reports.
+            del self.records[:len(self.records) - self._MAX_RECORDS]
+        self._emit(rec)
+        return out
+
+    def _dispatch(self, step_fn, args, kwargs, i, ts):
+        if not self._aot:
+            return step_fn(*args, **kwargs)
+        if i == 0:
+            try:
+                self._compile_ts = ts
+                t0 = time.monotonic()
+                compiled = step_fn.lower(*args, **kwargs).compile()
+                self.compile_s = time.monotonic() - t0
+                self.memory = _memory_dict(compiled)
+                out = compiled(*args, **kwargs)
+                self._compiled = compiled
+                self._signature = _arg_signature((args, kwargs))
+                return out
+            except Exception as e:  # noqa: BLE001 — loud jit fallback
+                print(f"telemetry: AOT compile path failed ({e!r}); "
+                      "falling back to plain jit dispatch "
+                      "(no compile/HBM split)", file=sys.stderr)
+                self._aot = False
+                self._compiled = None
+                self.compile_s = None
+                return step_fn(*args, **kwargs)
+        if (self._compiled is not None
+                and _arg_signature((args, kwargs)) == self._signature):
+            try:
+                return self._compiled(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                print(f"telemetry: compiled step call failed ({e!r}); "
+                      "reverting to jit dispatch", file=sys.stderr)
+                self._compiled = None
+        return step_fn(*args, **kwargs)
+
+    # ------------------------------------------------------- accounting --
+
+    def _note_tokens(self, args, kwargs):
+        if self._tokens_per_step is not None:
+            return
+        tok = _find_tokens(args, kwargs)
+        if tok is not None:
+            self._tokens_per_step = int(tok.shape[0]) * int(tok.shape[1])
+            self._seq = int(tok.shape[1])
+            self._batch = int(tok.shape[0])
+
+    def _maybe_loss(self, out) -> Optional[float]:
+        try:
+            if (isinstance(out, tuple) and len(out) == 2
+                    and isinstance(out[1], dict) and "loss" in out[1]):
+                return float(out[1]["loss"])
+        except Exception:  # noqa: BLE001 — loss stays optional
+            pass
+        return None
+
+    def compiled_step(self):
+        """The AOT-compiled executable (``aot=True`` after the first
+        wrapped call), or None.  Benchmark loops that must stay free of
+        the wrapper's per-step blocking sync call this directly — same
+        executable, no recompile, no recording."""
+        return self._compiled
+
+    def n_devices(self) -> int:
+        return getattr(self.mesh, "size", None) or 1
+
+    def chip_peak(self) -> float:
+        if self._peak is None:
+            self._peak = flops_mod.chip_peak_tflops()
+        return self._peak
+
+    def _ce_recompute(self) -> Optional[bool]:
+        """Whether the CE path recomputes the head matmul (4th vocab
+        matmul): pinned mode wins; otherwise infer the dispatch —
+        flash-CE pays it even at ``ce_chunk=-1``."""
+        chunk_remat = getattr(self.cfg, "ce_chunk", 0) >= 0
+        if self.ce_mode == "flash":
+            return True
+        if self.ce_mode in ("xla", "fused"):
+            return chunk_remat
+        if chunk_remat or self._seq is None or self._batch is None:
+            return chunk_remat
+        try:
+            from ray_tpu.ops.flash_ce import uses_flash_ce
+            return uses_flash_ce(self._batch * self._seq,
+                                 self.cfg.d_model,
+                                 self.cfg.vocab_size,
+                                 n_devices=self.n_devices())
+        except Exception:  # noqa: BLE001 — best-effort inference
+            return chunk_remat
+
+    def flops_per_token(self) -> Optional[float]:
+        if self.cfg is None or self._seq is None:
+            return None
+        if self._fpt is None:     # constant once the batch shape is known
+            try:
+                self._fpt = flops_mod.gpt_train_flops_per_token(
+                    self.cfg, self._seq,
+                    ce_recompute=self._ce_recompute())
+            except Exception:  # noqa: BLE001 — non-GPT cfg
+                self._fpt = -1.0
+        return None if self._fpt < 0 else self._fpt
+
+    def collective_bytes(self) -> Optional[Dict[str, int]]:
+        if (self.cfg is None or self.mesh is None
+                or self._seq is None):
+            return None
+        try:
+            from ray_tpu.parallel import overlap as ovl
+            return ovl.collective_bytes_per_step(
+                self.cfg, self.mesh, batch=self._batch, seq=self._seq,
+                comm_mode=self.comm_mode or "gspmd")
+        except Exception:  # noqa: BLE001 — non-GPT cfg / odd mesh
+            return None
+
+    # ---------------------------------------------------------- summary --
+
+    def summary(self) -> Dict[str, Any]:
+        """The aggregate ``telemetry`` block for bench/perf JSON."""
+        if not self.enabled:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"enabled": True, "label": self.label,
+                               "steps": self.step_count}
+        if not self.records:
+            return out
+        out["compile_s"] = self.compile_s
+        out["first_step_s"] = self.first_step_s
+        # a single (compile-inclusive) step has no steady state to
+        # report — mislabeling it would be off by orders of magnitude
+        steady = [r for r in self.records if r["step"] > 0]
+        if steady:
+            wall = statistics.median(r["wall_s"] for r in steady)
+            out.update({
+                "steady_step_s": wall,
+                "steady_dispatch_s": statistics.median(
+                    r["dispatch_s"] for r in steady),
+                "steady_sync_s": statistics.median(
+                    r["sync_s"] for r in steady),
+            })
+            if self._tokens_per_step:
+                tok_s = self._tokens_per_step / wall
+                out["tokens_per_step"] = self._tokens_per_step
+                out["tokens_per_sec"] = tok_s
+                out["tokens_per_sec_per_device"] = \
+                    tok_s / self.n_devices()
+                fpt = self.flops_per_token()
+                if fpt is not None:
+                    out["flops_per_token"] = fpt
+                    out["chip_peak_tflops"] = self.chip_peak()
+                    out["mfu"] = flops_mod.mfu(
+                        tok_s / self.n_devices(), fpt,
+                        self.chip_peak())
+        out["hbm"] = self.memory
+        out["collective_bytes_per_step"] = self.collective_bytes()
+        if self.comm_mode is not None:
+            out["comm_mode"] = self.comm_mode
+        return out
+
+    # ------------------------------------------------------ chrome trace --
+
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        """This recorder's steps as Chrome-trace complete events."""
+        evs: List[Dict[str, Any]] = []
+        pid, tid = "train", self.label
+        if self.compile_s is not None and self._compile_ts is not None:
+            evs.append({"name": f"{self.label}/compile", "cat": "train",
+                        "ph": "X", "ts": self._compile_ts * 1e6,
+                        "dur": self.compile_s * 1e6,
+                        "pid": pid, "tid": tid, "args": {}})
+        for r in self.records:
+            args = {k: r[k] for k in ("loss", "tokens_per_sec", "mfu")
+                    if k in r}
+            args["sync_ms"] = r["sync_s"] * 1e3
+            evs.append({"name": f"{self.label}/step {r['step']}",
+                        "cat": "train_step", "ph": "X",
+                        "ts": r["ts"] * 1e6, "dur": r["wall_s"] * 1e6,
+                        "pid": pid, "tid": tid, "args": args})
+            evs.append({"name": f"{self.label}/dispatch", "cat": "train",
+                        "ph": "X", "ts": r["ts"] * 1e6,
+                        "dur": r["dispatch_s"] * 1e6,
+                        "pid": pid, "tid": f"{tid}/phases", "args": {}})
+            evs.append({"name": f"{self.label}/sync", "cat": "train",
+                        "ph": "X",
+                        "ts": (r["ts"] + r["dispatch_s"]) * 1e6,
+                        "dur": r["sync_s"] * 1e6,
+                        "pid": pid, "tid": f"{tid}/phases", "args": {}})
+        return evs
+
+    # --------------------------------------------------------- profiler --
+
+    def _profile(self, i: int, *, before: bool):
+        pdir = self._cfgobj.profile_dir
+        if not pdir:
+            return
+        first = self._cfgobj.profile_first
+        last = first + self._cfgobj.profile_steps - 1
+        try:
+            import jax
+            if (before and not self._profile_started and i >= first):
+                jax.profiler.start_trace(pdir)
+                self._profile_started = True
+            elif (not before and self._profile_started
+                    and not self._profile_stopped and i >= last):
+                jax.profiler.stop_trace()
+                self._profile_stopped = True
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            print(f"telemetry: xplane capture failed ({e!r})",
+                  file=sys.stderr)
+            self._profile_stopped = True
+            self._profile_started = True
+
+    def stop(self):
+        """Finalize: stop a still-running xplane capture."""
+        if self._profile_started and not self._profile_stopped:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            self._profile_stopped = True
+
+    # ------------------------------------------------------- prometheus --
+
+    _STEP_BOUNDARIES = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+
+    _EMIT_INTERVAL_S = 0.5
+
+    def _emit(self, rec):
+        """Per-step Prometheus gauges/histograms (control-plane metrics).
+
+        Only when a ray_tpu session is up; the first failure disables
+        emission for the rest of the run so a dead control plane cannot
+        tax the step loop.  Emission is throttled to one batch per
+        ``_EMIT_INTERVAL_S`` (step 0 always emits): the control plane is
+        an RPC away, and a per-step RPC burst would tax fast steps for a
+        scrape Prometheus only reads every few seconds anyway."""
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        # steps 0 (compile + collective bytes) and 1 (first real
+        # throughput/MFU) always emit; after that, the interval gates
+        if rec["step"] > 1 and now - self._metrics_last \
+                < self._EMIT_INTERVAL_S:
+            return
+        self._metrics_last = now
+        try:
+            from ray_tpu._private.worker import is_initialized
+            if not is_initialized():
+                return            # cluster may start later; retry then
+            if self._metrics is None:
+                from ray_tpu.util.metrics import Gauge, Histogram
+                tags = ("label",)
+                self._metrics = {
+                    "step_s": Histogram(
+                        "train_step_seconds",
+                        "train step wall seconds (blocking sync)",
+                        boundaries=self._STEP_BOUNDARIES,
+                        tag_keys=tags),
+                    "mfu": Gauge("train_mfu",
+                                 "analytic-FLOPs model FLOPs utilization",
+                                 tag_keys=tags),
+                    "tok": Gauge("train_tokens_per_sec",
+                                 "training throughput", tag_keys=tags),
+                    "bytes": Gauge(
+                        "train_collective_bytes",
+                        "logical collective bytes/device/step",
+                        tag_keys=tags),
+                }
+            tags = {"label": self.label}
+            # step 0's wall includes the compile — keep the 30s-vs-50ms
+            # outlier out of the step-seconds distribution, same policy
+            # as the skipped step-0 throughput/MFU above
+            if rec["step"] > 0:
+                self._metrics["step_s"].observe(rec["wall_s"],
+                                                tags=tags)
+            if "mfu" in rec:
+                self._metrics["mfu"].set(rec["mfu"], tags=tags)
+            if "tokens_per_sec" in rec:
+                self._metrics["tok"].set(rec["tokens_per_sec"],
+                                         tags=tags)
+            if not self._bytes_emitted:
+                # once per run, on the first emission that actually
+                # reaches the control plane (the cluster may have come
+                # up after step 0)
+                cb = self.collective_bytes()
+                if cb is not None:
+                    self._metrics["bytes"].set(cb["total"], tags=tags)
+                self._bytes_emitted = True
+        except Exception:  # noqa: BLE001 — never tax the step loop
+            self._metrics_dead = True
+
+
+def instrument(fns: Dict[str, Any], cfg=None, mesh=None, *,
+               comm_mode: Optional[str] = None,
+               ce_mode: Optional[str] = None, label: str = "train",
+               aot: bool = False,
+               config=None) -> Dict[str, Any]:
+    """Wrap the ``step_fn`` of a train-fns dict with a fresh recorder.
+
+    Returns the same dict with ``step_fn`` wrapped and two extra keys:
+    ``telemetry`` (the :class:`StepTelemetry`) and ``raw_step_fn`` (the
+    unwrapped jitted step).  No-op (no extra keys) when telemetry is
+    disabled."""
+    rec = StepTelemetry(cfg, mesh, comm_mode=comm_mode,
+                        ce_mode=ce_mode, label=label, aot=aot,
+                        config=config)
+    if not rec.enabled:
+        return fns
+    fns["raw_step_fn"] = fns["step_fn"]
+    fns["step_fn"] = rec.wrap(fns["step_fn"])
+    fns["telemetry"] = rec
+    return fns

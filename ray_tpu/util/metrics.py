@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import bisect
 import json
-import threading
 from typing import Dict, List, Optional, Tuple
 
 
@@ -19,6 +18,26 @@ def _cp():
 
 def _tag_key(tags: Optional[Dict[str, str]]) -> str:
     return json.dumps(sorted((tags or {}).items()))
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _tag_labels(tag_key: str) -> str:
+    """``_tag_key`` JSON -> Prometheus label body (no braces)."""
+    try:
+        items = json.loads(tag_key)
+    except (ValueError, TypeError):
+        return ""
+    return ",".join(f'{_sanitize(k)}="{_escape_label(v)}"'
+                    for k, v in items)
 
 
 class Metric:
@@ -42,13 +61,10 @@ class Metric:
 class Counter(Metric):
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None):
-        _cp().kv_put(
-            f"metric:counter:{self._name}:{_tag_key(self._merged(tags))}"
-            .encode(),
-            repr(value).encode(), namespace="_metrics_inc")
+        # true float accumulation through the control plane — the old
+        # path collapsed any non-integer increment to +1
         _cp().incr(f"user_counter:{self._name}"
-                   f":{_tag_key(self._merged(tags))}",
-                   int(value) if float(value).is_integer() else 1)
+                   f":{_tag_key(self._merged(tags))}", float(value))
 
 
 class Gauge(Metric):
@@ -68,32 +84,100 @@ class Histogram(Metric):
                  tag_keys: Tuple[str, ...] = ()):
         super().__init__(name, description, tag_keys)
         self.boundaries = boundaries or _DEFAULT_BOUNDARIES
+        self._spec_published = False
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
+        cp = _cp()
+        if not self._spec_published:
+            # boundaries live beside the samples so the exposition can
+            # rebuild cumulative le-buckets without the Histogram object
+            cp.kv_put(f"histspec:{self._name}".encode(),
+                      json.dumps(self.boundaries).encode(),
+                      namespace="_metrics")
+            self._spec_published = True
+        tk = _tag_key(self._merged(tags))
         idx = bisect.bisect_left(self.boundaries, value)
-        label = (f"le_{self.boundaries[idx]}"
-                 if idx < len(self.boundaries) else "le_inf")
-        _cp().incr(f"user_histogram:{self._name}:{label}"
-                   f":{_tag_key(self._merged(tags))}")
-        _cp().incr(f"user_histogram:{self._name}:count")
+        cp.incr(f"user_histogram:{self._name}:{tk}:bucket:{idx}")
+        cp.incr(f"user_histogram:{self._name}:{tk}:sum", float(value))
+        cp.incr(f"user_histogram:{self._name}:{tk}:count")
+
+
+def _render_value(value) -> str:
+    """Integers render bare (3, not 3.0); floats keep full precision."""
+    f = float(value)
+    return repr(int(f)) if f.is_integer() else repr(f)
+
+
+def _histograms(counters: Dict[str, float]) -> Dict[str, Dict[str, dict]]:
+    """``user_histogram:*`` counters -> {name: {tag_key: {buckets, sum,
+    count}}}."""
+    out: Dict[str, Dict[str, dict]] = {}
+    for key, value in counters.items():
+        if not key.startswith("user_histogram:"):
+            continue
+        # user_histogram:<name>:<tag json>:(bucket:<idx>|sum|count)
+        rest = key[len("user_histogram:"):]
+        name, _, rest = rest.partition(":")
+        tk, _, kind = rest.rpartition(":")
+        if kind.isdigit() and tk.endswith(":bucket"):
+            tk, idx = tk[:-len(":bucket")], int(kind)
+            kind = "bucket"
+        elif kind not in ("sum", "count"):
+            continue
+        series = out.setdefault(name, {}).setdefault(
+            tk, {"buckets": {}, "sum": 0.0, "count": 0.0})
+        if kind == "bucket":
+            series["buckets"][idx] = series["buckets"].get(idx, 0) + value
+        else:
+            series[kind] += value
+    return out
 
 
 def prometheus_text() -> str:
-    """Render counters + gauges in Prometheus exposition format."""
+    """Render counters, gauges + histograms in Prometheus exposition
+    format (histograms as proper cumulative ``_bucket{le=...}`` /
+    ``_sum`` / ``_count`` lines)."""
     cp = _cp()
+    counters = cp.counters()
     lines = []
-    for name, value in sorted(cp.counters().items()):
-        safe = name.replace(":", "_").replace("{", "").replace("}", "")
-        safe = "".join(c if c.isalnum() or c == "_" else "_"
-                       for c in safe)
+    for name, value in sorted(counters.items()):
+        if name.startswith("user_histogram:"):
+            continue                   # rendered as histograms below
+        safe = _sanitize(name.replace(":", "_")
+                         .replace("{", "").replace("}", ""))
         lines.append(f"# TYPE {safe} counter")
-        lines.append(f"{safe} {value}")
+        lines.append(f"{safe} {_render_value(value)}")
     for key in cp.kv_keys(b"gauge:", namespace="_metrics"):
         raw = cp.kv_get(key, namespace="_metrics")
         parts = key.decode().split(":")
-        safe = "".join(c if c.isalnum() or c == "_" else "_"
-                       for c in parts[1])
+        safe = _sanitize(parts[1])
+        labels = _tag_labels(":".join(parts[2:]))
         lines.append(f"# TYPE {safe} gauge")
-        lines.append(f"{safe} {float(raw)}")
+        lines.append(f"{safe}{{{labels}}} {float(raw)}"
+                     if labels else f"{safe} {float(raw)}")
+    for name, by_tags in sorted(_histograms(counters).items()):
+        raw_spec = cp.kv_get(f"histspec:{name}".encode(),
+                             namespace="_metrics")
+        boundaries = json.loads(raw_spec) if raw_spec else []
+        safe = f"user_histogram_{_sanitize(name)}"
+        lines.append(f"# TYPE {safe} histogram")
+        for tk, series in sorted(by_tags.items()):
+            base = _tag_labels(tk)
+            sep = "," if base else ""
+            cum = 0.0
+            for idx, bound in enumerate(boundaries):
+                cum += series["buckets"].get(idx, 0)
+                lines.append(
+                    f'{safe}_bucket{{{base}{sep}le="{bound}"}} '
+                    f'{_render_value(cum)}')
+            lines.append(
+                f'{safe}_bucket{{{base}{sep}le="+Inf"}} '
+                f'{_render_value(series["count"])}')
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(
+                f'{safe}_sum{suffix} {_render_value(series["sum"])}')
+            lines.append(
+                f'{safe}_count{suffix} '
+                f'{_render_value(series["count"])}')
     return "\n".join(lines) + "\n"

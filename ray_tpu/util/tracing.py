@@ -126,7 +126,13 @@ def clear_recorded() -> None:
 
 @contextlib.contextmanager
 def span(name: str, **attributes):
-    """Trace one operation.  No-op (two attr reads) when disabled."""
+    """Trace one operation.  No-op (two attr reads) when disabled.
+
+    The fallback record keeps an *epoch* ``start`` for timeline
+    placement but computes ``dur`` (and the derived ``end``) from the
+    monotonic clock: ``time.time()`` can step backwards under NTP
+    slew, which used to yield negative/garbage durations for spans
+    straddling a clock adjustment."""
     if not _enabled:
         yield None
         return
@@ -139,11 +145,14 @@ def span(name: str, **attributes):
                     pass
             yield s
         return
-    rec = {"name": name, "start": time.time(), "attributes": attributes}
+    rec = {"name": name, "start": time.time(),
+           "tid": threading.get_ident(), "attributes": attributes}
+    t0 = time.monotonic()
     try:
         yield rec
     finally:
-        rec["end"] = time.time()
+        rec["dur"] = time.monotonic() - t0
+        rec["end"] = rec["start"] + rec["dur"]
         with _lock:
             _records.append(rec)
             if len(_records) > _MAX_RECORDS:

@@ -78,9 +78,10 @@ for pack2 in pack2_arms:
     for _ in range(2):
         state, m = fns["step_fn"](state, bd)
         float(m["loss"])
+    raw_step = fns.get("raw_step_fn", fns["step_fn"])
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, m = fns["step_fn"](state, bd)
+        state, m = raw_step(state, bd)
     loss = float(m["loss"])
     dt = (time.perf_counter() - t0) / steps
     tok = batch * seq / dt

@@ -1,0 +1,132 @@
+"""Round-9 on-chip driver: step telemetry + unified timeline capture.
+
+Usage: python scratch/r9_telemetry.py <variant> [mesh]
+
+``mesh`` is ``bench.py --mesh`` syntax (default ``fsdp=-1``).
+
+Variants:
+  xplane    — single-chip bench-shape train step with the telemetry
+              recorder in AOT mode and an xplane capture of steps 1-3
+              (RAY_TPU_PROFILE; default scratch/profiles/r9_xplane).
+              Prints the telemetry JSON block (compile split, blocking
+              step/sync time, analytic MFU, memory_analysis HBM) and
+              writes the merged host+train chrome trace next to it —
+              the first ground-truth check of the claimed MFU/overlap
+              numbers on real hardware.
+  timeline  — overlap-vs-gspmd on one mesh, both arms instrumented +
+              xplane-captured into separate dirs; the named scopes
+              (overlap/gather_block, overlap/block, overlap/head_ring,
+              gpt/attn, gpt/ffn, ce/flash, ...) make the prefetch
+              claim of PR 3 *visible*: the gather_block region of
+              block i+1 should sit under block i's matmuls in the
+              device timeline.  Prints both telemetry blocks.
+
+Carried arms (no chip session has happened yet; r06/r07/r08 rows in
+docs/PERF.md are still pending, so the first chip session runs
+everything from here): overlap / gspmd / ring / bytes / pack2ab /
+flash / noremat / ce / b28 / b32 / b28x / b32x / bv512 / bn2048 —
+delegated verbatim to scratch/r8_overlap.py (which in turn delegates
+the single-chip kernel arms to r7_flash_ce.py).
+"""
+import json
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "xplane"
+MESH_ARG = sys.argv[2] if len(sys.argv) > 2 else "fsdp=-1"
+
+_R8_ARMS = ("overlap", "gspmd", "ring", "bytes", "pack2ab", "flash",
+            "noremat", "ce", "b28", "b32", "b28x", "b32x", "bv512",
+            "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R8_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r8_overlap.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r9_telemetry.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.telemetry import (StepTelemetry, TelemetryConfig,  # noqa: E402
+                               chrome_trace)
+from ray_tpu.parallel.mesh import make_mesh, parse_mesh_axes  # noqa: E402
+
+assert VARIANT in ("xplane", "timeline"), f"unknown variant {VARIANT!r}"
+on_tpu = jax.default_backend() == "tpu"
+
+
+def run_arm(label, mesh, comm_mode, cfg, batch, seq, steps, profile_dir):
+    config = TelemetryConfig(enabled=True, profile_dir=profile_dir)
+    fns = training.build_gpt_train(cfg, mesh, comm_mode=comm_mode,
+                                   telemetry=False)
+    tel = StepTelemetry(cfg, mesh, comm_mode=fns["comm_mode"],
+                        label=label, aot=True, config=config)
+    step = tel.wrap(fns["step_fn"])
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    data = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch,
+                                       seq, cfg.vocab_size)
+    for _ in range(steps):
+        state, m = step(state, data)
+    float(m["loss"])
+    tel.stop()
+    summary = tel.summary()
+    summary["arm"] = label
+    print(json.dumps(summary), flush=True)
+    return tel
+
+
+if VARIANT == "xplane":
+    pdir = os.environ.get("RAY_TPU_PROFILE") or os.path.join(
+        HERE, "profiles", "r9_xplane")
+    os.makedirs(pdir, exist_ok=True)
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    if on_tpu:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=False,
+                             unroll_layers=True, ce_chunk=-1)
+        batch, seq, steps = 24, 1024, 8
+    else:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 6
+    # keep the recorder alive: chrome_trace reads a WeakSet of live
+    # recorders, so dropping the ref here would export an empty trace
+    tel = run_arm("r9_xplane", mesh, None, cfg, batch, seq, steps, pdir)
+    out = os.path.join(pdir, "host_train_trace.json")
+    chrome_trace.export(out)
+    del tel
+    print(f"xplane under {pdir}; merged host+train chrome trace: {out}")
+    sys.exit(0)
+
+# timeline: overlap vs gspmd, both instrumented + captured
+axes = parse_mesh_axes(MESH_ARG)
+mesh = make_mesh(devices=jax.devices(), **axes)
+data_par = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=True)
+    batch, seq, steps = 8 * data_par, 1024, 8
+else:
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+                    max_seq=128, dtype=jnp.float32)
+    batch, seq, steps = 4 * data_par, 128, 4
+base = os.environ.get("RAY_TPU_PROFILE") or os.path.join(
+    HERE, "profiles", "r9_timeline")
+tels = []   # strong refs: the exporter's recorder registry is weak
+for mode in ("overlap", "gspmd"):
+    pdir = os.path.join(base, mode)
+    os.makedirs(pdir, exist_ok=True)
+    tels.append(run_arm(f"r9_{mode}", mesh, mode, cfg, batch, seq,
+                        steps, pdir))
+out = os.path.join(base, "host_train_trace.json")
+chrome_trace.export(out)
+print(f"xplane arms under {base}/{{overlap,gspmd}}; "
+      f"merged chrome trace: {out}")

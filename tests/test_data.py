@@ -51,6 +51,7 @@ def test_random_shuffle_preserves_rows(ray_start_regular):
     assert first != list(range(20))
 
 
+@pytest.mark.slow
 def test_repartition(ray_start_regular):
     import ray_tpu.data as data
     ds = data.range(100, override_num_blocks=2).repartition(5)
@@ -106,6 +107,7 @@ def test_tensor_columns(ray_start_regular):
     np.testing.assert_allclose(batch["feat"], arr[:4])
 
 
+@pytest.mark.slow
 def test_dataset_in_trainer(ray_start_regular, tmp_path):
     """Train ingest: every worker pulls a disjoint stream of one shared
     execution (streaming_split); together they see each row once."""

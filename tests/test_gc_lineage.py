@@ -90,6 +90,7 @@ def test_put_objects_are_not_reconstructible(fast_gc):
         ray.get(ref, timeout=30)
 
 
+@pytest.mark.slow
 def test_buffered_actor_call_pins_args(ray_start_regular):
     """A call submitted while the actor is still starting must pin its
     arg objects: with the caller's ObjectRef dropped, GC would otherwise

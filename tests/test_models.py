@@ -47,6 +47,7 @@ def test_gpt_causality():
     assert float(jnp.abs(l1[0, -1] - l2[0, -1]).max()) > 1e-6
 
 
+@pytest.mark.slow
 def test_gpt_train_loss_decreases_dp_tp_sp():
     mesh = make_mesh(dp=2, sp=2, tp=2)
     cfg = GPTConfig.tiny(dtype=jnp.float32)
@@ -75,6 +76,7 @@ def test_gpt_moe_trains():
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_ring_vs_local_full_model():
     """Same params, sp mesh vs single device: identical loss."""
     cfg = GPTConfig.tiny(dtype=jnp.float32)
@@ -105,6 +107,7 @@ def test_graft_entry():
     mod.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_unrolled_layers_match_scan():
     """cfg.unroll_layers + ce_chunk are pure perf knobs: identical loss
     to the scan path."""

@@ -72,6 +72,49 @@ def test_metrics_prometheus(ray_start_regular):
     assert "user_histogram_lat" in text
 
 
+def test_counter_accumulates_float_increments(ray_start_regular):
+    """Non-integer increments accumulate exactly (the old path
+    collapsed any fractional inc to +1)."""
+    from ray_tpu.util.metrics import Counter, prometheus_text
+    c = Counter("float_ctr")
+    c.inc(0.25)
+    c.inc(0.5)
+    c.inc(2)
+    text = prometheus_text()
+    (line,) = [ln for ln in text.splitlines()
+               if ln.startswith("user_counter_float_ctr")
+               and not ln.startswith("#")]
+    assert float(line.split()[-1]) == 2.75, line
+
+
+def test_histogram_prometheus_exposition(ray_start_regular):
+    """Histograms render proper cumulative ``_bucket{le=...}`` /
+    ``_sum`` / ``_count`` lines (they used to be recorded but never
+    rendered)."""
+    from ray_tpu.util.metrics import Histogram, prometheus_text
+    h = Histogram("svc_lat", boundaries=[0.1, 1.0, 5.0])
+    for v in (0.05, 0.5, 0.5, 2.0, 99.0):
+        h.observe(v)
+    text = prometheus_text()
+    assert "# TYPE user_histogram_svc_lat histogram" in text
+
+    def val(sub):
+        (line,) = [ln for ln in text.splitlines() if sub in ln]
+        return float(line.split()[-1])
+
+    # cumulative buckets: le=0.1 -> 1, le=1.0 -> 3, le=5.0 -> 4, +Inf=5
+    assert val('svc_lat_bucket{le="0.1"}') == 1
+    assert val('svc_lat_bucket{le="1.0"}') == 3
+    assert val('svc_lat_bucket{le="5.0"}') == 4
+    assert val('svc_lat_bucket{le="+Inf"}') == 5
+    assert val("svc_lat_count") == 5
+    assert val("svc_lat_sum") == pytest.approx(102.05)
+    # tagged series keep their labels alongside le
+    h.observe(0.5, tags={"route": "/x"})
+    text = prometheus_text()
+    assert 'route="/x"' in text
+
+
 def test_dashboard_api(ray_start_regular):
     import requests
 

@@ -33,6 +33,7 @@ def test_flash_fwd_matches_einsum(causal):
 
 
 @pytest.mark.kernel_smoke
+@pytest.mark.slow
 def test_flash_grads_match_einsum():
     key = jax.random.PRNGKey(1)
     B, S, H, D = 2, 256, 2, 64
@@ -244,6 +245,7 @@ def test_pack2_matches_unpacked_kernel():
 
 
 @pytest.mark.parametrize("H,D", [(3, 64), (2, 128)])
+@pytest.mark.slow
 def test_pack2_falls_back_cleanly(H, D):
     # odd head counts / head_dim 128 take the single-head schedule even
     # with pack2 requested — same numerics as the reference
@@ -310,6 +312,7 @@ def test_attention_config_env_escape_hatch(monkeypatch):
         A.attention_config(refresh=True)
 
 
+@pytest.mark.slow
 def test_chunked_ce_noremat_matches_dense():
     from ray_tpu.models.gpt import _chunked_ce
     key = jax.random.PRNGKey(7)
@@ -345,6 +348,7 @@ def test_flash_fallback_small_shapes():
     assert float(jnp.abs(out - ref).max()) < 1e-5
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_dense():
     from ray_tpu.models.gpt import _chunked_ce
     key = jax.random.PRNGKey(3)
@@ -376,6 +380,7 @@ def test_chunked_ce_matches_dense():
 
 
 @pytest.mark.kernel_smoke
+@pytest.mark.slow
 def test_pallas_rmsnorm_matches_reference():
     """Fused rmsnorm fwd/bwd (ops/rmsnorm.py) vs the XLA formulation."""
     import jax
@@ -414,6 +419,7 @@ def test_pallas_rmsnorm_matches_reference():
 
 
 @pytest.mark.kernel_smoke
+@pytest.mark.slow
 def test_fused_ce_matches_reference():
     """bf16-resident-logit CE (ops/fused_ce.py) vs the f32 formulation."""
     import jax
@@ -449,6 +455,7 @@ def test_fused_ce_matches_reference():
         assert err / scale < 2e-2, (err, scale)
 
 
+@pytest.mark.slow
 def test_gpt_env_gated_paths_train(monkeypatch):
     """PALLAS_NORM + RAY_TPU_CE=fused paths produce a finite training
     step on the tiny config.  The tiny config's d=64 makes flash-CE's
@@ -561,6 +568,7 @@ def test_flash_ce_mismatched_fwd_bwd_blocks():
         assert err / scale < 1e-4, (err, scale)
 
 
+@pytest.mark.slow
 def test_flash_ce_gpt2_vocab_padding():
     # V=50304 with 1024-wide vocab blocks pads to 51200: 896 dead
     # columns masked in-kernel, plus a non-multiple-of-block N

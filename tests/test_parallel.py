@@ -303,6 +303,7 @@ def _overlap_vs_gspmd(cfg, axes, *, batch_size=8, seq=32, masked=False,
                     f"on mesh {axes}")
 
 
+@pytest.mark.slow
 def test_overlap_fsdp_parity():
     """Pure-FSDP overlap schedule (prefetched per-block gathers,
     per-block grad reduce-scatters) matches GSPMD exactly in f32."""
@@ -312,6 +313,7 @@ def test_overlap_fsdp_parity():
     _overlap_vs_gspmd(cfg, {"fsdp": 8})
 
 
+@pytest.mark.slow
 def test_overlap_fsdp_tp_parity():
     """fsdp x tp: ring all-gather-matmul TP + vocab-parallel CE, with
     masked targets and an odd layer count (the scan's double-buffer
@@ -322,6 +324,7 @@ def test_overlap_fsdp_tp_parity():
     _overlap_vs_gspmd(cfg, {"fsdp": 4, "tp": 2}, masked=True)
 
 
+@pytest.mark.slow
 def test_overlap_uneven_shapes_parity():
     """Ragged shapes: d_ff/seq chunks far from lane multiples, batch
     that splits into odd-sized (3-row) shards over the batch axes."""

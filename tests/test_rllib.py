@@ -110,6 +110,7 @@ def test_dqn_learns_cartpole(ray_start_regular):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_bc_offline_clones_expert(ray_start_regular):
     """BC trains from an offline ray_tpu.data dataset (no env
     interaction) and the cloned policy beats random in the live env

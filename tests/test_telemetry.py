@@ -1,0 +1,305 @@
+"""Step-level training telemetry (``ray_tpu/telemetry/``).
+
+Everything runs on the CPU backend (conftest pins an 8-device host-sim
+world): record schema + compile-vs-steady split, MFU arithmetic against
+a hand-computed GPT FLOPs count, chrome-trace JSON validity, dashboard
+``/api/timeline`` + ``/metrics`` carrying train-step data, and the
+disabled-mode no-op / <1%-overhead budget.
+"""
+
+import json
+import time
+
+import pytest
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt import GPTConfig
+    return GPTConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                     max_seq=64, dtype=jnp.float32)
+
+
+def _single_dev_mesh():
+    import jax
+
+    from ray_tpu.parallel.mesh import make_mesh
+    return make_mesh(dp=1, devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def aot_run():
+    """One instrumented AOT run shared by the schema/MFU/trace tests."""
+    import jax
+
+    from ray_tpu.models import training
+    from ray_tpu.telemetry import StepTelemetry
+
+    cfg = _tiny_cfg()
+    mesh = _single_dev_mesh()
+    fns = training.build_gpt_train(cfg, mesh, telemetry=False)
+    tel = StepTelemetry(cfg, mesh, comm_mode=fns["comm_mode"],
+                        label="t9", aot=True)
+    step = tel.wrap(fns["step_fn"])
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 4, 32,
+                                        cfg.vocab_size)
+    for _ in range(4):
+        state, metrics = step(state, batch)
+    return {"cfg": cfg, "mesh": mesh, "tel": tel, "batch": batch,
+            "loss": float(metrics["loss"])}
+
+
+def test_step_record_schema_and_compile_split(aot_run):
+    tel = aot_run["tel"]
+    assert len(tel.records) == 4
+    for rec in tel.records:
+        for key in ("step", "ts", "wall_s", "dispatch_s", "sync_s",
+                    "tokens", "loss"):
+            assert key in rec, (key, rec)
+        assert rec["wall_s"] > 0
+        assert rec["wall_s"] >= rec["dispatch_s"] > 0
+        assert rec["tokens"] == 4 * 32
+    # throughput/MFU only on steady steps: step 0's wall includes the
+    # compile, so a rate derived from it would be garbage
+    assert "tokens_per_sec" not in tel.records[0]
+    for rec in tel.records[1:]:
+        assert rec["tokens_per_sec"] > 0 and "mfu" in rec
+    # compile time is split out of steady state: only step 0 carries
+    # it, and the steady median must not include the compile
+    assert tel.records[0]["compile_s"] > 0
+    assert "compile_s" not in tel.records[1]
+    s = tel.summary()
+    assert s["enabled"] and s["steps"] == 4
+    assert s["compile_s"] == tel.records[0]["compile_s"]
+    assert s["first_step_s"] >= s["compile_s"]
+    assert s["steady_step_s"] < s["first_step_s"]
+    # HBM footprint from jit(...).lower().compile().memory_analysis()
+    assert s["hbm"] is not None
+    assert s["hbm"]["argument_bytes"] > 0
+    assert s["hbm"]["total_bytes"] > 0
+    # logical collective accounting is present (single-device: zeros)
+    assert s["collective_bytes_per_step"]["total"] == 0
+    assert s["comm_mode"] == "gspmd"
+
+
+def test_mfu_arithmetic_vs_hand_computed_flops(aot_run):
+    """The analytic FLOPs/token matches an independently hand-computed
+    count for the tiny GPT, and the recorded MFU is exactly
+    tokens/s/device * flops_per_token / peak."""
+    from ray_tpu.telemetry import (chip_peak_tflops,
+                                   gpt_train_flops_per_token)
+
+    cfg, tel = aot_run["cfg"], aot_run["tel"]
+    seq = 32
+    d, H, hd, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.ff_dim
+    L, V = cfg.n_layers, cfg.vocab_size
+    # hand count (2 FLOPs/MAC): qkv + causal attention (half of the
+    # 2 * 2*seq*H*hd score/value matmuls) + out-proj + swiglu FFN
+    per_layer = (3 * 2 * d * H * hd          # q, k, v projections
+                 + 2 * seq * H * hd          # QK^T + AV, causal-halved
+                 + 2 * H * hd * d            # output projection
+                 + 3 * 2 * d * f)            # w1, w3, w2
+    fwd = L * per_layer + 2 * d * V          # + lm head
+    want = 3 * fwd                           # fwd + 2x bwd
+    # default ce_chunk=4096 >= 0 rematerializes the head matmul once
+    want += 2 * d * V
+    got = gpt_train_flops_per_token(cfg, seq)
+    assert got == pytest.approx(want, rel=1e-9), (got, want)
+
+    rec = tel.records[2]
+    expect_mfu = (rec["tokens_per_sec"] * got
+                  / (chip_peak_tflops() * 1e12))
+    assert rec["mfu"] == pytest.approx(expect_mfu, rel=1e-6)
+
+
+def test_chrome_trace_export_valid(aot_run):
+    """The exporter emits Perfetto-loadable JSON: a ``traceEvents``
+    list of complete events carrying both host spans and step
+    annotations."""
+    from ray_tpu.telemetry import chrome_trace
+    from ray_tpu.util import tracing
+
+    tracing.clear_recorded()
+    tracing.enable_tracing()
+    try:
+        with tracing.span("host-side-work", kind="test"):
+            time.sleep(0.01)
+    finally:
+        tracing.disable_tracing()
+
+    trace = json.loads(chrome_trace.export())
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for ev in evs:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    # host span from the tracing fallback recorder ...
+    host = [e for e in evs if e["name"] == "host-side-work"]
+    assert host and host[0]["pid"] == "host"
+    assert host[0]["dur"] >= 0.01 * 1e6
+    # ... merged with the train-step records (step + phases + compile)
+    steps = [e for e in evs if e.get("cat") == "train_step"]
+    assert len(steps) >= 4
+    assert any("compile" in e["name"] for e in evs)
+    assert any(e["name"].endswith("/sync") for e in evs)
+    # events are time-sorted, as trace viewers expect
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_tracing_spans_use_monotonic_durations():
+    """Fallback-recorder spans carry a monotonic ``dur`` (NTP-safe)
+    plus the epoch placement keys."""
+    from ray_tpu.util import tracing
+
+    tracing.clear_recorded()
+    tracing.enable_tracing()
+    try:
+        with tracing.span("mono"):
+            time.sleep(0.02)
+    finally:
+        tracing.disable_tracing()
+    (rec,) = [s for s in tracing.recorded_spans()
+              if s["name"] == "mono"]
+    assert rec["dur"] >= 0.02
+    assert rec["end"] == pytest.approx(rec["start"] + rec["dur"])
+    assert "tid" in rec
+
+
+def test_disabled_mode_noop(monkeypatch):
+    """RAY_TPU_TELEMETRY=0: the wrapper is identity, instrument() adds
+    nothing, and the builders return unwrapped steps."""
+    import ray_tpu.telemetry.config as tcfg_mod
+    from ray_tpu.telemetry import StepTelemetry, instrument, \
+        telemetry_config
+
+    monkeypatch.setenv("RAY_TPU_TELEMETRY", "0")
+    try:
+        cfg = telemetry_config(refresh=True)
+        assert not cfg.enabled
+        tel = StepTelemetry(label="off")
+        assert not tel.enabled
+
+        def step(x):
+            return x
+
+        assert tel.wrap(step) is step
+        fns = {"step_fn": step}
+        out = instrument(fns)
+        assert out is fns and "telemetry" not in out
+        assert tel.summary() == {"enabled": False}
+    finally:
+        monkeypatch.delenv("RAY_TPU_TELEMETRY")
+        telemetry_config(refresh=True)
+    assert tcfg_mod.telemetry_config().enabled
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_one_percent():
+    """Acceptance budget: telemetry-on steady-state step time exceeds
+    telemetry-off by <1%.
+
+    A direct A/B on the real train step cannot resolve 1% on this
+    1-core CI box — its per-step variance is ±30% between runs, two
+    orders of magnitude above the wrapper's actual bookkeeping cost.
+    So the budget is checked by decomposition: (1) the wrapper's
+    absolute per-call cost, measured as the mean delta over many
+    calls of a near-free jitted step (identical code path through the
+    recorder: spans, sync, record build, emit check); (2) the real
+    GPT step's steady wall time; assert (1) < 1% of (2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.telemetry import StepTelemetry
+
+    # (1) absolute bookkeeping cost around a near-free step
+    @jax.jit
+    def fake_step(state, batch):
+        s = state + 1.0
+        return s, {"loss": jnp.sum(s)}
+
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    mesh = _single_dev_mesh()
+    tel = StepTelemetry(cfg, mesh, comm_mode="gspmd",
+                        label="overhead")
+    wrapped = tel.wrap(fake_step)
+    s = jnp.zeros((8, 128))
+    batch = {"tokens": jnp.zeros((4, 128), jnp.int32)}
+    s, _ = fake_step(s, batch)
+    s, _ = wrapped(s, batch)       # step 0 (jit warm) out of the way
+    n = 800
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fake_step(s, batch)
+        jax.block_until_ready(out)
+    t_raw = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(n):
+        wrapped(s, batch)          # blocks internally
+    t_wrapped = time.monotonic() - t0
+    per_call = max((t_wrapped - t_raw) / n, 0.0)
+    assert len(tel.records) == n + 1
+
+    # (2) the real step's steady wall time (median of a few)
+    fns = training.build_gpt_train(cfg, mesh, telemetry=False)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    gbatch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 4,
+                                         128, cfg.vocab_size)
+    walls = []
+    for i in range(6):
+        t0 = time.monotonic()
+        state, m = fns["step_fn"](state, gbatch)
+        jax.block_until_ready((state, m))
+        if i > 0:
+            walls.append(time.monotonic() - t0)
+    walls.sort()
+    steady = walls[len(walls) // 2]
+
+    overhead = per_call / steady
+    assert overhead < 0.01, (
+        f"telemetry bookkeeping {per_call*1e6:.0f}µs/step is "
+        f"{overhead:.2%} of the {steady*1e3:.1f}ms steady step — "
+        "exceeds the 1% budget")
+
+
+@pytest.mark.slow
+def test_dashboard_timeline_and_metrics_show_train_steps(
+        ray_start_regular):
+    """The unified timeline reaches ``/api/timeline`` and the per-step
+    Prometheus series reach ``/metrics`` through the control plane."""
+    import jax
+    import requests
+
+    from ray_tpu.dashboard.app import Dashboard
+    from ray_tpu.models import training
+
+    cfg = _tiny_cfg()
+    mesh = _single_dev_mesh()
+    fns = training.build_gpt_train(cfg, mesh)   # default-on telemetry
+    assert "telemetry" in fns
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 2, 32,
+                                        cfg.vocab_size)
+    for _ in range(2):
+        state, _ = fns["step_fn"](state, batch)
+
+    port = Dashboard(18311).start()
+    timeline = requests.get(
+        f"http://127.0.0.1:{port}/api/timeline", timeout=10).json()
+    steps = [ev for ev in timeline
+             if ev.get("cat") == "train_step"]
+    assert steps, [ev.get("name") for ev in timeline][:20]
+    assert all(ev["ph"] == "X" and ev["dur"] > 0 for ev in steps)
+
+    text = requests.get(f"http://127.0.0.1:{port}/metrics",
+                        timeout=10).text
+    assert "train_step_seconds" in text, text[:2000]
+    assert "user_histogram_train_step_seconds_bucket" in text
+    assert "train_mfu" in text
+    assert "train_collective_bytes" in text

@@ -120,6 +120,7 @@ def test_tuner_over_trainer(ray_start_regular, tmp_path):
     assert abs(best.metrics["config"]["lr"] - 0.1) < 1e-9
 
 
+@pytest.mark.slow
 def test_pbt_mutates_and_exploits(ray_start_regular, tmp_path):
     """PBT: bottom-quantile trials clone a top trial's checkpoint and
     mutate hyperparams (parity: tune/schedulers/pbt.py)."""
