@@ -11,7 +11,10 @@ fwd+bwd at the bench shape, flash-CE (streamed-logits Pallas kernel)
 vs the no-remat XLA control.  ``collective_perf`` (``--collective``)
 is the comm-schedule analogue: ring all-gather-matmul
 (``parallel/overlap.py``) vs the barrier all-gather-then-matmul on a
-tp ring.  ``train_step_perf`` (``--train``) runs the full train step
+tp ring.  ``decode_perf`` (``--decode``) is the serving-side entry:
+cache-aware single-token decode attention, strip-mined Pallas kernel
+vs the masked-einsum XLA fallback at the engine's gathered-context
+shape.  ``train_step_perf`` (``--train``) runs the full train step
 through the telemetry recorder and prints the ``telemetry`` JSON block
 (compile split / MFU / HBM) in isolation.
 """
@@ -178,6 +181,58 @@ def ce_perf(n_tokens: int = 24576, d_model: int = 768,
           f"{result['tokens_per_sec']:,.0f} tok/s  "
           f"{result['effective_tflops']:.1f} eff TFLOPs "
           f"({matmuls} vocab matmuls)")
+    return result
+
+
+def decode_perf(batch: int = 8, ctx: int = 1024, heads: int = 12,
+                head_dim: int = 64, steps: int = 50,
+                impl: str = "auto") -> Dict[str, float]:
+    """Isolated decode-attention microbenchmark (``--decode``).
+
+    Times ``steps`` jitted evaluations of the cache-aware single-token
+    attention (``ops/attention.py:decode_attention``) at a padded
+    context of ``ctx`` with mixed valid lengths — the per-layer
+    attention cost of one engine decode tick.  ``impl`` A/Bs the
+    strip-mined Pallas kernel against the masked-einsum XLA fallback
+    without env games.  On CPU the kernel runs in Pallas interpret
+    mode — numbers are only meaningful on a real chip, but the entry
+    stays runnable anywhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import decode_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, heads, head_dim), dtype)
+    k = jax.random.normal(kk, (batch, ctx, heads, head_dim), dtype)
+    v = jax.random.normal(kv, (batch, ctx, heads, head_dim), dtype)
+    lengths = jnp.arange(1, batch + 1) * (ctx // batch)
+
+    fn = jax.jit(lambda q, k, v: decode_attention(q, k, v, lengths,
+                                                  impl=impl))
+    out = fn(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / steps
+
+    # 2 context-shaped matmuls (scores, p@V), each 2*B*H*ctx*D flops
+    # on the padded context (masking does not skip compute)
+    flops = 2 * 2 * batch * heads * ctx * head_dim
+    result = {
+        "name": f"decode attention impl={impl}",
+        "us_per_step": dt * 1e6,
+        "tokens_per_sec": batch / dt,
+        "effective_gflops": flops / dt / 1e9,
+    }
+    print(f"{result['name']}: {result['us_per_step']:.1f} us  "
+          f"{result['tokens_per_sec']:,.0f} tok/s  "
+          f"{result['effective_gflops']:.1f} eff GFLOPs")
     return result
 
 
@@ -416,6 +471,10 @@ if __name__ == "__main__":
     elif "--collective" in sys.argv:
         # TP-schedule A/B: ring all-gather-matmul vs barrier gather
         collective_perf()
+    elif "--decode" in sys.argv:
+        # cache-aware decode attention A/B: Pallas kernel vs XLA mask
+        decode_perf(impl="pallas")
+        decode_perf(impl="xla")
     elif "--train" in sys.argv:
         # instrumented train step: the bench telemetry block in isolation
         train_step_perf()

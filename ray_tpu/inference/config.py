@@ -1,0 +1,83 @@
+"""Inference-engine env knobs — the single home for serving config.
+
+Follows the ``attention_config()`` / ``ce_config()`` / ``comm_config()``
+/ ``telemetry_config()`` precedent: one frozen dataclass resolved from
+the environment once, ``refresh=True`` for tests and A/B drivers that
+flip flags after import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class InferConfig:
+    """Inference-engine knobs, resolved once from the environment.
+
+    - ``RAY_TPU_INFER_SLOTS`` (default ``8``): decode batch slots — the
+      fixed batch dimension of the compiled decode step.  Continuous
+      batching admits/retires sequences into these slots without
+      changing the compiled shape.
+    - ``RAY_TPU_INFER_PAGE_SIZE`` (default ``128``): tokens per KV-cache
+      page.  128 keeps a slot's gathered context a multiple of the
+      decode kernel's 128-lane strip.
+    - ``RAY_TPU_INFER_PAGES`` (default ``0`` = auto): total pages in the
+      preallocated cache.  Auto sizes for every slot at full context
+      (``slots * ceil(max_seq / page_size)``) plus the reserved garbage
+      page; set lower to trade admission concurrency for HBM.
+    - ``RAY_TPU_INFER_BUCKETS`` (default unset = powers of two from 32
+      up to the model's ``max_seq``): comma-separated prefill length
+      buckets.  Prompts are padded up to the smallest bucket that fits,
+      so arbitrary request lengths hit at most ``len(buckets)`` prefill
+      compiles and the decode step exactly one.
+    - ``RAY_TPU_INFER_DECODE`` (default ``auto``): decode-attention
+      implementation — ``pallas`` (strip-mined online-softmax kernel,
+      ``ops/attention.py:decode_attention``), ``xla`` (masked einsum),
+      or ``auto`` (pallas on a TPU backend when the context tiles).
+    """
+    slots: int = 8
+    page_size: int = 128
+    pages: int = 0
+    buckets: Tuple[int, ...] = ()
+    decode_impl: str = "auto"
+
+
+_CONFIG: Optional[InferConfig] = None
+
+
+def infer_config(refresh: bool = False) -> InferConfig:
+    """The process-wide :class:`InferConfig` (env read once, cached)."""
+    global _CONFIG
+    if _CONFIG is None or refresh:
+        env = os.environ.get
+        impl = env("RAY_TPU_INFER_DECODE", "auto")
+        if impl not in ("auto", "pallas", "xla"):
+            print(f"RAY_TPU_INFER_DECODE={impl!r} unknown; using 'auto'",
+                  file=sys.stderr)
+            impl = "auto"
+        raw_buckets = env("RAY_TPU_INFER_BUCKETS", "")
+        buckets = tuple(sorted(int(b) for b in raw_buckets.split(",")
+                               if b.strip())) if raw_buckets else ()
+        _CONFIG = InferConfig(
+            slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
+            page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
+            pages=int(env("RAY_TPU_INFER_PAGES", "0")),
+            buckets=buckets,
+            decode_impl=impl,
+        )
+    return _CONFIG
+
+
+def default_buckets(max_seq: int, smallest: int = 32) -> Tuple[int, ...]:
+    """Powers of two from ``smallest`` up to (and including) ``max_seq``."""
+    out = []
+    b = min(smallest, max_seq)
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)

@@ -1,0 +1,468 @@
+"""TPU-native continuous-batching inference engine for the GPT family.
+
+The training path compiles one step function and reuses it forever; the
+serving path has to survive arbitrary request shapes without paying XLA
+compiles on the hot path.  Two mechanisms bound the compile surface
+(the arXiv:2011.03641 lesson — steady-state recompiles are the TPU
+serving killer):
+
+- **shape buckets**: prompts pad to the smallest configured prefill
+  bucket that fits, so prefill compiles at most once per bucket;
+- **fixed decode slots**: the decode step is compiled exactly once for
+  ``[slots]``-shaped inputs; continuous batching admits/retires
+  sequences into those slots (host-side scheduler, Podracer-style
+  colocated with the compiled steps) without changing the shape.
+
+Both step functions are AOT-compiled (``jit(...).lower().compile()``)
+into an explicit compile cache with hit/miss counters — an unexpected
+shape *raises* instead of silently recompiling, and the zero-recompile
+acceptance test asserts on the counters.
+
+The steps themselves derive from the training model: ``embed`` +
+``layer_apply`` with a KV-cache hook threaded through (post-RoPE keys
+written to the paged cache, decode attention over the gathered pages
+via ``ops/attention.py:decode_attention``), plus the model's own final
+norm / tied head so cached decode logits match teacher-forced
+``forward`` logits bit-for-bit-modulo-dtype (parity-tested in
+``tests/test_inference.py``).  The cache arrays are donated through
+every step, so steady-state decode allocates nothing.
+
+Single-device by design for now: ``pallas_call`` has no SPMD rule and
+a serving replica owns one chip; sharded multi-chip decode is an open
+ROADMAP item.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ray_tpu.inference import kv_cache as kvc
+from ray_tpu.inference.config import default_buckets, infer_config
+from ray_tpu.inference.sampling import SamplingParams, sample_tokens
+from ray_tpu.inference.scheduler import Request, SlotScheduler
+from ray_tpu.models import gpt as gpt_mod
+
+
+class InferenceEngine:
+    """Continuous-batching decode engine over one GPT parameter set.
+
+    ``submit()`` enqueues a request and returns its id; ``step()``
+    advances the world by one engine tick — admit waiting sequences
+    into free slots (one bucketed prefill each), then one batched
+    decode for every active slot — and returns ``(rid, token, done)``
+    events.  ``generate()`` is the run-to-completion convenience;
+    streaming callers (the serve deployment) pump ``step()`` and fan
+    events out per request.
+
+    Knobs default to :func:`ray_tpu.inference.config.infer_config`
+    (``RAY_TPU_INFER_*``); constructor arguments pin them for tests and
+    A/B drivers.  ``debug_logits`` stashes each request's logits rows
+    in ``logits_trace[rid]`` for the parity tests.
+
+    ``executable_cache``: params are *call arguments* of the compiled
+    steps, not baked constants, so executables only depend on (config,
+    geometry).  Callers building several engines over the same model
+    shape (re-deploys, A/B drivers, tests) can pass a shared dict to
+    compile once per process; the per-engine compile/hit counters still
+    count this engine's cache misses/hits.
+    """
+
+    def __init__(self, cfg: "gpt_mod.GPTConfig", params, *,
+                 slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 decode_impl: Optional[str] = None,
+                 telemetry: Optional[bool] = None,
+                 debug_logits: bool = False,
+                 executable_cache: Optional[Dict[Any, Any]] = None):
+        if cfg.n_experts > 0:
+            raise NotImplementedError("MoE decode cache not supported yet")
+        icfg = infer_config()
+        self.cfg = cfg
+        self.params = jax.device_put(params)
+        self.slots = slots if slots is not None else icfg.slots
+        self.page_size = (page_size if page_size is not None
+                          else icfg.page_size)
+        self.decode_impl = decode_impl or icfg.decode_impl
+        if self.slots < 1:
+            raise ValueError(f"need >= 1 decode slot, got {self.slots} "
+                             "(check RAY_TPU_INFER_SLOTS)")
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got "
+                             f"{self.page_size}")
+        self.buckets = tuple(sorted(
+            b for b in (buckets or icfg.buckets
+                        or default_buckets(cfg.max_seq))
+            if b <= cfg.max_seq)) or (cfg.max_seq,)
+        max_pages_per_slot = kvc.pages_needed(cfg.max_seq, self.page_size)
+        num_pages = num_pages or icfg.pages or (
+            self.slots * max_pages_per_slot + 1)
+        self.scheduler = SlotScheduler(
+            slots=self.slots, page_size=self.page_size,
+            num_pages=num_pages, max_pages_per_slot=max_pages_per_slot)
+        self.cache = kvc.KVCache(
+            n_layers=cfg.n_layers, num_pages=num_pages,
+            page_size=self.page_size, n_heads=cfg.n_heads,
+            head_dim=cfg.head_dim, dtype=cfg.dtype)
+        # compile cache: key -> AOT executable; an executable raises on
+        # shape drift, so the counters below are honest.  Keys carry
+        # the full (cfg, geometry) so a shared cache cannot alias
+        # engines of different shapes.
+        self._compiled: Dict[Any, Any] = (
+            executable_cache if executable_cache is not None else {})
+        self._exec_key = (cfg, self.slots, self.page_size, num_pages,
+                          max_pages_per_slot, self.decode_impl)
+        self.compile_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self.hit_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self._requests: Dict[int, Request] = {}
+        self._next_rid = 0
+        self._cancelled: set = set()
+        self._lock = threading.Lock()   # submit() vs step() admissions
+        self.debug_logits = debug_logits
+        # rid -> [logits row per generated token], appended in event
+        # order (parity tests only; off by default)
+        self.logits_trace: Dict[int, List[np.ndarray]] = {}
+        from ray_tpu.telemetry.infer import InferTelemetry
+        from ray_tpu.telemetry.config import TelemetryConfig
+        config = (TelemetryConfig(enabled=True) if telemetry is True
+                  else TelemetryConfig(enabled=False)
+                  if telemetry is False else None)
+        self.telemetry = InferTelemetry(config=config)
+
+    # --------------------------------------------------------- requests
+    def submit(self, prompt, max_new_tokens: int = 16,
+               sampling: Optional[SamplingParams] = None,
+               eos_token: Optional[int] = None) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq {self.cfg.max_seq}")
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(f"prompt length {len(prompt)} exceeds the "
+                             f"largest prefill bucket {self.buckets[-1]}")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            req = Request(rid=rid, prompt=prompt,
+                          max_new_tokens=max_new_tokens,
+                          sampling=sampling or SamplingParams(),
+                          eos_token=eos_token)
+            self.scheduler.submit(req)    # validates; may raise —
+            self._requests[rid] = req     # register only if accepted
+        return rid
+
+    def cancel(self, rid: int) -> None:
+        """Retire ``rid`` early (abandoned stream / client disconnect).
+
+        Processed at the start of the next :meth:`step` tick — the only
+        place scheduler state mutates besides admission, so a cancel
+        can never race a decode that is mid-flight over the slot.  A
+        no-op for finished/unknown rids."""
+        with self._lock:
+            if rid in self._requests:
+                self._cancelled.add(rid)
+
+    def _process_cancels(self) -> None:
+        with self._lock:
+            cancelled, self._cancelled = self._cancelled, set()
+            if not cancelled:
+                return
+            sched = self.scheduler
+            for slot, req in list(sched.active.items()):
+                if req.rid in cancelled:
+                    sched.retire(slot)
+                    self._requests.pop(req.rid, None)
+            for req in [r for r in sched.waiting
+                        if r.rid in cancelled]:
+                sched.waiting.remove(req)
+                req.done = True
+                self._requests.pop(req.rid, None)
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.scheduler.has_work
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "compiles": dict(self.compile_counts),
+            "hits": dict(self.hit_counts),
+            "free_slots": len(self.scheduler.free_slots),
+            "free_pages": self.scheduler.allocator.free_count,
+            "waiting": len(self.scheduler.waiting),
+            "active": len(self.scheduler.active),
+            "cache_bytes": self.cache.bytes,
+        }
+
+    # ------------------------------------------------------ engine tick
+    def step(self) -> List[Tuple[int, int, bool]]:
+        """One engine tick -> [(rid, token, done), ...] events."""
+        events: List[Tuple[int, int, bool]] = []
+        self._process_cancels()
+        while True:
+            with self._lock:
+                req = self.scheduler.try_admit()
+            if req is None:
+                break
+            self._prefill(req, events)
+        if self.scheduler.active:
+            self._decode(events)
+        return events
+
+    def generate(self, prompts, max_new_tokens: int = 16,
+                 sampling: Optional[SamplingParams] = None,
+                 eos_token: Optional[int] = None) -> List[List[int]]:
+        """Run-to-completion over a batch of prompts (ordered results)."""
+        rids = [self.submit(p, max_new_tokens, sampling, eos_token)
+                for p in prompts]
+        out: Dict[int, List[int]] = {r: [] for r in rids}
+        while self.has_work():
+            for rid, tok, _done in self.step():
+                out[rid].append(tok)
+        return [out[r] for r in rids]
+
+    # ---------------------------------------------------------- prefill
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no prefill bucket fits length {n}")
+
+    def _prefill(self, req: Request, events) -> None:
+        from ray_tpu.util import tracing
+        sched = self.scheduler
+        slot = req.slot
+        plen = len(req.prompt)
+        bucket = self._bucket_for(plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = req.prompt
+        t0 = time.monotonic()
+        with tracing.span("infer/prefill", rid=req.rid, bucket=bucket):
+            fn = self._get_compiled(
+                ("prefill", bucket), self._build_prefill,
+                (self.params, self.cache.k, self.cache.v, tokens,
+                 np.int32(plen), sched.page_table[slot]),
+                kind="prefill")
+            logits, self.cache.k, self.cache.v = fn(
+                self.params, self.cache.k, self.cache.v, tokens,
+                np.int32(plen), sched.page_table[slot])
+            tok = self._sample_slots(logits, [req])[0]
+        if self.debug_logits:
+            self.logits_trace.setdefault(req.rid, []).append(
+                np.asarray(logits[0]))
+        sched.lengths[slot] = plen
+        now = time.monotonic()
+        if self.telemetry.enabled:
+            self.telemetry.record_prefill(now - t0, prompt_tokens=plen,
+                                          bucket=bucket)
+            self.telemetry.record_ttft(now - req.submitted_ts)
+        self._deliver(req, int(tok), events)
+
+    # ----------------------------------------------------------- decode
+    def _decode(self, events) -> None:
+        from ray_tpu.util import tracing
+        sched = self.scheduler
+        tokens = np.zeros((self.slots,), np.int32)
+        reqs: List[Optional[Request]] = [None] * self.slots
+        for slot, req in sched.active.items():
+            tokens[slot] = req.generated[-1]
+            reqs[slot] = req
+        active = [r for r in reqs if r is not None]
+        t0 = time.monotonic()
+        with tracing.span("infer/decode", active=len(active)):
+            fn = self._get_compiled(
+                ("decode",), self._build_decode,
+                (self.params, self.cache.k, self.cache.v, tokens,
+                 sched.lengths, sched.page_table),
+                kind="decode")
+            logits, self.cache.k, self.cache.v = fn(
+                self.params, self.cache.k, self.cache.v, tokens,
+                sched.lengths, sched.page_table)
+            sampled = self._sample_slots(logits, reqs)
+        wall = time.monotonic() - t0
+        if self.telemetry.enabled:
+            self.telemetry.record_decode(wall, active=len(active))
+        if self.debug_logits:
+            host_logits = np.asarray(logits)
+        for slot in list(sched.active):
+            req = sched.active[slot]
+            sched.lengths[slot] += 1     # the input token is now cached
+            if self.debug_logits:
+                self.logits_trace.setdefault(req.rid, []).append(
+                    host_logits[slot])
+            self._deliver(req, int(sampled[slot]), events)
+
+    def _deliver(self, req: Request, tok: int, events) -> None:
+        req.generated.append(tok)
+        done = (len(req.generated) >= req.max_new_tokens
+                or (req.eos_token is not None and tok == req.eos_token))
+        if done:
+            self.scheduler.retire(req.slot)
+            if self.telemetry.enabled:
+                self.telemetry.record_request_done()
+            if not self.debug_logits:
+                # a serve replica lives for the deployment's lifetime:
+                # finished requests must not accumulate (debug engines
+                # keep them so parity tests can read trajectories)
+                self._requests.pop(req.rid, None)
+        events.append((req.rid, tok, done))
+
+    # --------------------------------------------------------- sampling
+    def _sample_slots(self, logits,
+                      reqs: List[Optional[Request]]) -> np.ndarray:
+        """Sample one token per logits row — the full [slots, V] decode
+        batch (None rows are inactive, result discarded) or a prefill's
+        single [1, V] row."""
+        null = SamplingParams()
+        seeds = np.array([(r.sampling.seed if r else 0) for r in reqs],
+                         np.int32)
+        counts = np.array([(len(r.generated) if r else 0) for r in reqs],
+                          np.int32)
+        temps = np.array(
+            [(r.sampling.temperature if r else null.temperature)
+             for r in reqs], np.float32)
+        top_ks = np.array([(r.sampling.top_k if r else 0) for r in reqs],
+                          np.int32)
+        top_ps = np.array([(r.sampling.top_p if r else 1.0)
+                           for r in reqs], np.float32)
+        return np.asarray(sample_tokens(logits, seeds, counts, temps,
+                                        top_ks, top_ps))
+
+    # ---------------------------------------------------- compile cache
+    def _get_compiled(self, key, build_fn, example_args, *, kind: str):
+        key = self._exec_key + key
+        fn = self._compiled.get(key)
+        if fn is not None:
+            self.hit_counts[kind] += 1
+            return fn
+        self.compile_counts[kind] += 1
+        jitted = build_fn()
+        fn = jitted.lower(*example_args).compile()
+        self._compiled[key] = fn
+        return fn
+
+    # ------------------------------------------------------- step fns --
+    def _embed(self, params, tokens, positions):
+        """tokens [B, S], positions [S] or [B, S] -> hidden [B, S, d].
+
+        ``embed_tokens`` assumes positions 0..S-1 for learned tables;
+        prefill/decode index the table by absolute position instead."""
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        if cfg.pos == "learned":
+            pe = params["pos_embed"].astype(cfg.dtype)[positions]
+            x = x + (pe if positions.ndim == 2 else pe[None])
+        return x
+
+    def _layer_scan(self, params, x, k_all, v_all, positions, attn_hook):
+        """Run the layer stack with per-layer cache slices in the scan
+        carry (dynamic-slice in / dynamic-update out, the donation-
+        friendly pattern) -> (final normed hidden, k_all, v_all)."""
+        cfg = self.cfg
+
+        def body(carry, i):
+            x, k_all, v_all = carry
+            lp = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, i, 0,
+                                                   keepdims=False),
+                params["layers"])
+            ck = lax.dynamic_index_in_dim(k_all, i, 0, keepdims=False)
+            cv = lax.dynamic_index_in_dim(v_all, i, 0, keepdims=False)
+            x, _aux, (ck, cv) = gpt_mod.layer_apply(
+                lp, x, cfg, positions=positions, attn_fn=attn_hook,
+                cache=(ck, cv))
+            k_all = lax.dynamic_update_index_in_dim(k_all, ck, i, 0)
+            v_all = lax.dynamic_update_index_in_dim(v_all, cv, i, 0)
+            return (x, k_all, v_all), None
+
+        (x, k_all, v_all), _ = lax.scan(
+            body, (x, k_all, v_all), jnp.arange(cfg.n_layers))
+        x = gpt_mod._norm(x, params["ln_f"], cfg.norm,
+                          bias=params.get("ln_f_b"),
+                          eps=gpt_mod.norm_eps(cfg))
+        return x, k_all, v_all
+
+    def _build_prefill(self):
+        cfg = self.cfg
+        page_size = self.page_size
+
+        def prefill(params, k_all, v_all, tokens, length, page_row):
+            """tokens [1, S_bucket]; length scalar (valid prefix);
+            page_row [max_pages] -> (last-token logits [1, V] f32,
+            k_all, v_all)."""
+            S = tokens.shape[1]
+            positions = jnp.arange(S)
+
+            def attn_hook(q, k, v, cache):
+                ck, cv = cache
+                ck = kvc.write_prefill(ck, k[0], page_row, page_size)
+                cv = kvc.write_prefill(cv, v[0], page_row, page_size)
+                o = self._prefill_attention(q, k, v)
+                return o, (ck, cv)
+
+            x = self._embed(params, tokens, positions)
+            x, k_all, v_all = self._layer_scan(params, x, k_all, v_all,
+                                               positions, attn_hook)
+            h = jnp.take(x[0], length - 1, axis=0)[None, None]  # [1,1,d]
+            logits = jnp.einsum("bsd,dv->bsv", h,
+                                gpt_mod.lm_head(params, cfg))
+            return logits[:, 0].astype(jnp.float32), k_all, v_all
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    def _prefill_attention(self, q, k, v):
+        """Causal self-attention over the bucket (no cache read — the
+        prompt is the whole context).  Flash kernel on a real TPU,
+        einsum elsewhere (interpret-mode Pallas is only paid for in the
+        dedicated kernel tests, not every engine test)."""
+        if jax.default_backend() == "tpu":
+            from ray_tpu.ops.attention import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        from ray_tpu.parallel.ring_attention import local_attention
+        return local_attention(q, k, v, causal=True)
+
+    def _build_decode(self):
+        cfg = self.cfg
+        page_size = self.page_size
+        impl = self.decode_impl
+
+        def decode(params, k_all, v_all, tokens, lengths, page_table):
+            """tokens [slots] (each slot's next input token); lengths
+            [slots] (tokens already cached = the new token's absolute
+            position); page_table [slots, max_pages] -> (logits
+            [slots, V] f32, k_all, v_all)."""
+            positions = lengths[:, None]                   # [B, 1]
+
+            def attn_hook(q, k, v, cache):
+                ck, cv = cache
+                ck = kvc.write_decode(ck, k[:, 0], page_table, lengths,
+                                      page_size)
+                cv = kvc.write_decode(cv, v[:, 0], page_table, lengths,
+                                      page_size)
+                kctx = kvc.gather_pages(ck, page_table)
+                vctx = kvc.gather_pages(cv, page_table)
+                from ray_tpu.ops.attention import decode_attention
+                o = decode_attention(q[:, 0], kctx, vctx, lengths + 1,
+                                     impl=impl)
+                return o[:, None], (ck, cv)
+
+            x = self._embed(params, tokens[:, None], positions)
+            x, k_all, v_all = self._layer_scan(params, x, k_all, v_all,
+                                               positions, attn_hook)
+            logits = jnp.einsum("bsd,dv->bsv", x,
+                                gpt_mod.lm_head(params, cfg))
+            return logits[:, 0].astype(jnp.float32), k_all, v_all
+
+        return jax.jit(decode, donate_argnums=(1, 2))

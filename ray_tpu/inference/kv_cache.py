@@ -1,0 +1,117 @@
+"""Paged KV cache for continuous-batching decode.
+
+The cache is two preallocated device arrays per model —
+``[n_layers, pages, page_size, kv_heads, head_dim]`` K and V — plus a
+*host-side* page table: each decode slot owns a row of page indices
+covering its reserved context.  Sequences of wildly different lengths
+then share one fixed allocation (the vLLM paged-attention idea, here
+XLA-functional): admission reserves ``ceil((prompt + max_new) / page)``
+pages from a free list, retirement returns them, and the device arrays
+never reallocate — the compiled decode step donates them in and gets
+them back, so steady-state decode allocates nothing.
+
+Page 0 is reserved as a garbage page: free slots' page-table rows (and
+the padded tail of short rows) point at it, so the fixed-shape decode
+step can scatter "writes" for inactive slots and prefill can write its
+padded bucket tail without corrupting live pages.  Reads of garbage are
+masked by per-slot lengths in ``decode_attention``.
+
+Device-side update/gather helpers are plain functional jnp ops (scatter
+via ``.at[]``, gather via advanced indexing) so they trace into the
+engine's compiled steps; the host-side :class:`PageAllocator` owns the
+free list and the leak invariants (``tests/test_inference.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+GARBAGE_PAGE = 0
+
+
+class PageAllocator:
+    """Host-side free list over the page pool (page 0 never handed out)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (1 garbage + 1 usable), "
+                             f"got {num_pages}")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None (caller keeps the request waiting)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == GARBAGE_PAGE:
+                raise ValueError("freeing the reserved garbage page")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+class KVCache:
+    """The preallocated paged K/V arrays plus their static geometry."""
+
+    def __init__(self, *, n_layers: int, num_pages: int, page_size: int,
+                 n_heads: int, head_dim: int, dtype):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        shape = (n_layers, num_pages, page_size, n_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+
+    @property
+    def bytes(self) -> int:
+        return 2 * self.k.size * self.k.dtype.itemsize
+
+
+def write_prefill(pages, new, page_row, page_size: int):
+    """Scatter a prompt's K (or V) into one slot's pages.
+
+    pages: [P, page_size, H, D] (one layer); new: [S, H, D] (bucket-
+    padded — tail positions land in whatever ``page_row`` maps them to,
+    the garbage page for unreserved tail entries); page_row: [max_pages]
+    int32.  Returns the updated pages array."""
+    S = new.shape[0]
+    pos = jnp.arange(S)
+    return pages.at[page_row[pos // page_size], pos % page_size].set(new)
+
+
+def write_decode(pages, new, page_table, lengths, page_size: int):
+    """Scatter one new token per slot into its page.
+
+    pages: [P, page_size, H, D]; new: [B, H, D]; page_table:
+    [B, max_pages] int32; lengths: [B] int32 — the token's absolute
+    position (inactive slots point at the garbage page)."""
+    B = new.shape[0]
+    page = jnp.take_along_axis(page_table,
+                               (lengths // page_size)[:, None], 1)[:, 0]
+    return pages.at[page, lengths % page_size].set(new)
+
+
+def gather_pages(pages, page_table):
+    """[P, page_size, H, D] x [B, max_pages] -> [B, max_pages*page, H, D].
+
+    The padded per-slot context the decode attention masks by length —
+    gather-then-attend (indexing pages *inside* the kernel is the
+    natural next step once this path has chip numbers)."""
+    B, max_pages = page_table.shape
+    _, ps, H, D = pages.shape
+    ctx = pages[page_table]                  # [B, max_pages, ps, H, D]
+    return ctx.reshape(B, max_pages * ps, H, D)
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    return -(-tokens // page_size)

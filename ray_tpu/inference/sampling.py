@@ -1,0 +1,69 @@
+"""Token sampling for the decode loop: greedy / temperature / top-k / top-p.
+
+Per-sequence PRNG: every request owns a key chain
+``fold_in(PRNGKey(seed), n_generated)`` derived *inside* the jitted
+sampler from its seed and generation count — a sequence's tokens are a
+function of (seed, step) only, never of which slot it landed in or who
+it was co-batched with.  That property is what makes continuous
+batching transparent to callers (asserted by the solo-vs-batched test
+in ``tests/test_inference.py``).
+
+All four modes run through one vmapped program (fixed [slots, V] shape,
+one compile): temperature scaling, per-row top-k threshold, top-p
+nucleus mask computed on the sorted distribution and mapped back by
+probability threshold, then a Gumbel argmax; ``temperature <= 0``
+selects the plain argmax instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    ``temperature <= 0`` is greedy (argmax; ``top_k``/``top_p``/``seed``
+    are then irrelevant).  ``top_k = 0`` disables the top-k filter;
+    ``top_p = 1.0`` disables the nucleus filter."""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+
+def _sample_one(logits, seed, count, temp, top_k, top_p):
+    V = logits.shape[-1]
+    l = logits.astype(jnp.float32)
+    greedy = jnp.argmax(l, -1).astype(jnp.int32)
+    z = l / jnp.maximum(temp, 1e-6)
+    # top-k: threshold at the k-th largest logit (0 = off)
+    kth = jnp.sort(z)[::-1][jnp.clip(top_k - 1, 0, V - 1)]
+    z = jnp.where((top_k > 0) & (z < kth), -jnp.inf, z)
+    # top-p: keep the smallest prefix of the sorted distribution whose
+    # mass reaches top_p (the first token always survives), mapped back
+    # to vocab order by probability threshold
+    probs = jax.nn.softmax(z)
+    sp = jnp.sort(probs)[::-1]
+    cum = jnp.cumsum(sp)
+    keep = (cum - sp) < top_p
+    thresh = jnp.min(jnp.where(keep, sp, jnp.inf))
+    z = jnp.where(probs >= thresh, z, -jnp.inf)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), count)
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(key, (V,), minval=1e-20, maxval=1.0)))
+    sampled = jnp.argmax(z + g, -1).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit)
+def sample_tokens(logits, seeds, counts, temps, top_ks, top_ps):
+    """logits [B, V] f32; seeds/counts [B] i32; temps/top_ps [B] f32;
+    top_ks [B] i32 -> sampled token ids [B] i32 (row-independent)."""
+    return jax.vmap(_sample_one)(logits, seeds, counts, temps, top_ks,
+                                 top_ps)

@@ -1,0 +1,121 @@
+"""Continuous-batching scheduler: slots, pages, request lifecycle.
+
+Host-side state machine beside the compiled steps (the Podracer
+pattern: a python scheduler colocated with AOT-compiled device step
+functions).  Requests move ``waiting -> active(slot) -> finished``:
+
+- **admit**: the head of the waiting queue takes a free decode slot and
+  reserves ``ceil((prompt + max_new) / page_size)`` pages up front —
+  reservation-at-admission means a running sequence can never run out
+  of cache mid-decode, so there is no preemption path to get wrong.
+  Admission blocks (request stays queued) until both a slot and the
+  pages are free.
+- **retire** (EOS / max-new-tokens): pages return to the free list, the
+  page-table row resets to the garbage page, the slot frees.
+
+The page table and per-slot lengths live here as numpy arrays and are
+passed into the fixed-shape compiled steps each call; the engine owns
+the device-side cache arrays.  Invariants (no slot/page leaks across
+any admit/retire interleaving) are fuzzed in
+``tests/test_inference.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.inference.kv_cache import (GARBAGE_PAGE, PageAllocator,
+                                        pages_needed)
+from ray_tpu.inference.sampling import SamplingParams
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token: Optional[int] = None
+    # lifecycle state (owned by the scheduler/engine)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    pages: Optional[List[int]] = None
+    submitted_ts: float = dataclasses.field(default_factory=time.monotonic)
+    done: bool = False
+
+
+class SlotScheduler:
+    def __init__(self, *, slots: int, page_size: int, num_pages: int,
+                 max_pages_per_slot: int):
+        self.slots = slots
+        self.page_size = page_size
+        self.max_pages_per_slot = max_pages_per_slot
+        self.allocator = PageAllocator(num_pages)
+        self.page_table = np.full((slots, max_pages_per_slot),
+                                  GARBAGE_PAGE, np.int32)
+        self.lengths = np.zeros((slots,), np.int32)   # tokens in cache
+        self.free_slots: List[int] = list(range(slots - 1, -1, -1))
+        self.active: Dict[int, Request] = {}          # slot -> request
+        self.waiting: Deque[Request] = collections.deque()
+
+    # ------------------------------------------------------------ admit
+    def submit(self, req: Request) -> None:
+        need = pages_needed(len(req.prompt) + req.max_new_tokens,
+                            self.page_size)
+        if need > self.max_pages_per_slot:
+            raise ValueError(
+                f"request {req.rid}: prompt+max_new = "
+                f"{len(req.prompt) + req.max_new_tokens} tokens needs "
+                f"{need} pages > {self.max_pages_per_slot} per slot")
+        # an unsatisfiable-even-when-idle request must raise, not queue:
+        # FIFO admission would otherwise spin on it forever (page 0 is
+        # reserved, so the whole pool is num_pages - 1)
+        if need > self.allocator.num_pages - 1:
+            raise ValueError(
+                f"request {req.rid}: needs {need} pages but the pool "
+                f"only has {self.allocator.num_pages - 1} "
+                f"(raise RAY_TPU_INFER_PAGES or shrink the request)")
+        self.waiting.append(req)
+
+    def try_admit(self) -> Optional[Request]:
+        """Move the queue head into a free slot, or None (FIFO: a large
+        stuck head does not get bypassed by smaller requests — simple
+        and starvation-free)."""
+        if not self.waiting or not self.free_slots:
+            return None
+        req = self.waiting[0]
+        need = pages_needed(len(req.prompt) + req.max_new_tokens,
+                            self.page_size)
+        pages = self.allocator.alloc(need)
+        if pages is None:
+            return None
+        self.waiting.popleft()
+        slot = self.free_slots.pop()
+        req.slot, req.pages = slot, pages
+        self.page_table[slot, :] = GARBAGE_PAGE
+        self.page_table[slot, :len(pages)] = pages
+        self.lengths[slot] = 0
+        self.active[slot] = req
+        return req
+
+    # ----------------------------------------------------------- retire
+    def retire(self, slot: int) -> Request:
+        req = self.active.pop(slot)
+        self.allocator.free(req.pages)
+        req.pages = None
+        req.slot = None
+        req.done = True
+        self.page_table[slot, :] = GARBAGE_PAGE
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+        return req
+
+    # ------------------------------------------------------------ views
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)

@@ -1,0 +1,146 @@
+"""GPT serving: the inference engine behind a ``serve`` deployment.
+
+One replica owns one :class:`~ray_tpu.inference.engine.InferenceEngine`
+and a single *pump* task that advances ``engine.step()`` in an executor
+thread (the compiled step blocks; the event loop must keep accepting
+requests while it runs) and fans the ``(rid, token, done)`` events out
+to per-request asyncio queues.  Each HTTP/handle request is an async
+generator that drains its queue — tokens flow through the existing
+``ServeReplica.handle_request_streaming`` path, one object-ref slot per
+token, and the handle-side ``DeploymentResponseGenerator`` yields them
+as they land.  Continuous batching happens inside the engine: requests
+arriving mid-stream join free decode slots without disturbing running
+sequences.
+
+Abandoned streams: closing the request's (replica-side) generator —
+asyncio cancellation, ``aclose()``, the proxy tearing down a
+disconnected HTTP response — cancels the sequence in the engine so its
+decode slot frees within a tick.  A *handle* consumer that silently
+drops its ``DeploymentResponseGenerator`` does **not** close the
+replica-side generator (the object-ref streaming protocol carries no
+consumer-liveness signal today), so such requests decode to
+``max_new_tokens`` before the slot frees — bound ``max_new_tokens``
+accordingly; ref-generator cancellation is an open runtime item.
+
+Usage (see the README serving quickstart)::
+
+    import ray_tpu, ray_tpu.serve as serve
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+
+    ray_tpu.init()
+    handle = serve.run(GPTDeployment.bind(model="tiny"), name="gpt")
+    stream = handle.options(stream=True).remote(
+        {"tokens": [1, 2, 3], "max_new_tokens": 8})
+    for token in stream:
+        ...
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import ray_tpu.serve as serve
+from ray_tpu.inference.sampling import SamplingParams
+
+_PRESETS = ("tiny", "gpt2", "gpt2_medium", "gpt2_large")
+
+
+def _build_engine(model: str, model_config: Optional[Dict[str, Any]],
+                  engine_config: Optional[Dict[str, Any]], seed: int):
+    import jax
+
+    from ray_tpu.inference.engine import InferenceEngine
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    if model not in _PRESETS:
+        raise ValueError(f"unknown model preset {model!r}; "
+                         f"expected one of {_PRESETS}")
+    cfg = getattr(GPTConfig, model)(**(model_config or {}))
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, InferenceEngine(cfg, params, **(engine_config or {}))
+
+
+@serve.deployment(max_ongoing_requests=32)
+class GPTDeployment:
+    """Streaming GPT deployment over the continuous-batching engine.
+
+    ``model``: a ``GPTConfig`` preset name (random-init weights —
+    checkpoint loading rides ``train.checkpoint.load_pytree`` via
+    ``params`` plumbing once a serving checkpoint format lands);
+    ``model_config`` / ``engine_config``: kwargs forwarded to
+    ``GPTConfig.<preset>()`` / :class:`InferenceEngine`.
+
+    Request payload (one dict): ``{"tokens": [...], "max_new_tokens":
+    int, "temperature": float, "top_k": int, "top_p": float, "seed":
+    int, "eos_token": int | None}`` — yields generated token ids.
+    """
+
+    def __init__(self, model: str = "tiny",
+                 model_config: Optional[Dict[str, Any]] = None,
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 seed: int = 0):
+        self.cfg, self.engine = _build_engine(model, model_config,
+                                              engine_config, seed)
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def __call__(self, request: Dict[str, Any]):
+        sampling = SamplingParams(
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            seed=int(request.get("seed", 0)))
+        rid = self.engine.submit(
+            request["tokens"],
+            max_new_tokens=int(request.get("max_new_tokens", 16)),
+            sampling=sampling,
+            eos_token=request.get("eos_token"))
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[rid] = queue
+        self._ensure_pump()
+        try:
+            while True:
+                item = await queue.get()
+                if isinstance(item, BaseException):
+                    raise item       # pump died: surface, don't hang
+                token, done = item
+                yield token
+                if done:
+                    return
+        finally:
+            self._queues.pop(rid, None)
+            # abandoned mid-stream (client disconnect): retire the
+            # sequence instead of decoding to max_new_tokens in a slot
+            # nobody is reading (no-op for normal completion)
+            self.engine.cancel(rid)
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.get_running_loop().create_task(
+                self._pump())
+
+    async def _pump(self) -> None:
+        """Advance the engine while any request is in flight; the
+        compiled step runs in an executor thread so the event loop
+        keeps admitting new requests mid-stream.  A step failure fans
+        out to every waiting consumer — a hung stream is worse than a
+        failed one."""
+        loop = asyncio.get_running_loop()
+        try:
+            while self.engine.has_work():
+                events = await loop.run_in_executor(None,
+                                                    self.engine.step)
+                for rid, token, done in events:
+                    queue = self._queues.get(rid)
+                    if queue is not None:
+                        queue.put_nowait((token, done))
+        except BaseException as e:  # noqa: BLE001 — deliver, then die
+            for queue in self._queues.values():
+                queue.put_nowait(e)
+            raise
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        summary = self.engine.telemetry.summary()
+        summary["stats"] = self.engine.stats()
+        return summary

@@ -284,11 +284,22 @@ def _moe_ffn(lp, x, cfg: GPTConfig):
     return out.reshape(B, S, d), aux
 
 
-def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
+def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
+                cache=None):
     """One transformer block: ``(layer params, hidden [B,S,d]) -> (hidden,
-    moe aux)``.  Shared by the stacked ``lax.scan`` in ``forward_hidden``
-    and the per-stage scan in the pipeline-parallel trainer
-    (``models/training.py`` build_gpt_train_pp)."""
+    moe aux)``.  Shared by the stacked ``lax.scan`` in ``forward_hidden``,
+    the per-stage scan in the pipeline-parallel trainer
+    (``models/training.py`` build_gpt_train_pp) and the inference
+    engine's prefill/decode steps (``ray_tpu.inference.engine``).
+
+    ``positions`` is [S] (shared across the batch) or [B, S]
+    (per-sequence absolute positions — the decode path, see
+    ``rope_rotate``).  ``cache`` threads per-layer KV-cache state to the
+    attention hook: when not None, ``attn_fn`` is called as
+    ``attn_fn(q, k, v, cache=cache)`` with the *rotated* k (cache
+    entries store post-RoPE keys, so decode never re-rotates history)
+    and must return ``(attn_out, new_cache)``; the block then returns
+    ``(hidden, aux, new_cache)`` instead of the 2-tuple."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
     eps = norm_eps(cfg)
     with jax.named_scope("gpt/attn"):
@@ -311,7 +322,14 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
         q = constrain(q, ("batch", "seq", "heads", None))
         k = constrain(k, ("batch", "seq", "heads", None))
         v = constrain(v, ("batch", "seq", "heads", None))
-        if fused_rope:
+        if cache is not None:
+            if fused_rope:
+                raise ValueError(
+                    "cache= requires an attn_fn without fused RoPE: "
+                    "cache entries must store post-RoPE keys, but a "
+                    "fused_rope attn_fn receives them un-rotated")
+            attn, cache = attn_fn(q, k, v, cache=cache)
+        elif fused_rope:
             attn = attn_fn(q, k, v, positions=positions)
         else:
             attn = attn_fn(q, k, v)
@@ -329,6 +347,8 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None):
             ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
         x = x + ffn_out
         x = constrain(x, ("batch", "seq", None))
+    if cache is not None:
+        return x, aux, cache
     return x, aux
 
 

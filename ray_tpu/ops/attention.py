@@ -112,10 +112,11 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 def rope_tables(positions, D: int, theta: float, dtype):
-    """positions [S] -> (cos2, sinm) each [S, D] for the fused kernels."""
+    """positions [S] (or any leading shape) -> (cos2, sinm) each
+    [*positions.shape, D] for the fused kernels."""
     half = D // 2
     freqs = jnp.exp(-jnp.log(theta) * jnp.arange(half) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
     cos = jnp.cos(angles)
     sin = jnp.sin(angles)
     cos2 = jnp.concatenate([cos, cos], -1).astype(dtype)
@@ -126,14 +127,22 @@ def rope_tables(positions, D: int, theta: float, dtype):
 def rope_rotate(x, positions, theta: float):
     """XLA-side RoPE: x [B, S, H, D] rotated per-position.
 
+    ``positions`` is [S] (one schedule shared across the batch — the
+    training path) or [B, S] (per-sequence absolute positions — the
+    decode path of the inference engine, where co-batched sequences sit
+    at different lengths).
+
     The single source of truth for the rotation outside the kernels —
     ``ray_tpu.models.gpt._rope`` and the ``flash_attention`` fallback
     both call this, so it stays numerically identical to the in-kernel
     ``_rot`` (same duplicated-table formulation)."""
     D = x.shape[-1]
     cos2, sinm = rope_tables(positions, D, theta, x.dtype)
-    return (x * cos2[None, :, None, :]
-            + jnp.roll(x, D // 2, -1) * sinm[None, :, None, :])
+    if positions.ndim == 2:                  # [B, S] -> [B, S, 1, D]
+        cos2, sinm = cos2[:, :, None, :], sinm[:, :, None, :]
+    else:                                    # [S] -> [1, S, 1, D]
+        cos2, sinm = cos2[None, :, None, :], sinm[None, :, None, :]
+    return x * cos2 + jnp.roll(x, D // 2, -1) * sinm
 
 
 def _roll_half(x, D: int):
@@ -1237,6 +1246,154 @@ def flash_attention(q, k, v, *, causal: bool = True,
             o = _flash_bhsd(qt, kt, vt, scale, causal, block_q,
                             block_k, bwd_block_q, bwd_block_k)
         return jnp.swapaxes(o, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# cache-aware decode attention (inference engine)
+#
+# One query token per sequence against a padded KV context gathered from
+# the paged cache ([B, S, H, D], valid prefix per sequence given by
+# ``lengths``).  The q "matrix" is a single row, which the TPU tiling
+# rules cannot block — the kernel broadcasts it to 8 sublanes (every row
+# computes the same result; row 0 is returned) and walks the context in
+# ``block_k`` strips with the same online-softmax scratch discipline as
+# ``_fwd_kernel``.  Lengths ride in scalar-prefetch SMEM so the mask is
+# a per-strip iota compare, not a precomputed [B, S] tensor.
+# ---------------------------------------------------------------------------
+
+_DECODE_QROWS = 8      # sublane-pad the single query row to a tileable block
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
+                   l_sc, *, scale: float, block_k: int, num_kv: int):
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    q = q_ref[0, 0]                          # [QROWS, D]
+    k = k_ref[0, :, 0, :]                    # [bk, D]
+    v = v_ref[0, :, 0, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [QROWS, bk]
+    col = (j * block_k
+           + jax.lax.broadcasted_iota(jnp.int32,
+                                      (_DECODE_QROWS, block_k), 1))
+    s = jnp.where(col < len_ref[b], s, _NEG_INF)
+    m_prev = m_sc[:]                          # [QROWS, 128] (col-bcast)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    l_sc[:] = l_sc[:] * alpha + jnp.sum(p, 1, keepdims=True)
+    acc_sc[:] = (acc_sc[:] * alpha[:, :1]
+                 + jax.lax.dot_general(
+                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                     preferred_element_type=jnp.float32))
+    m_sc[:] = m_new
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_sc[:]
+                       / jnp.maximum(l_sc[:, :1], 1e-30)).astype(
+                           o_ref.dtype)
+
+
+def _decode_block(S: int, block_k: int) -> int:
+    """Largest 128-multiple strip <= block_k that divides S (0: none).
+
+    Dropping to a narrower strip beats silently leaving the kernel for
+    the XLA fallback: any 128-multiple context (every paged-cache
+    gather at the default page_size) stays on the Pallas path."""
+    bk = min(block_k, S) // 128 * 128
+    while bk >= 128 and S % bk:
+        bk -= 128
+    return max(bk, 0)
+
+
+def decode_supports(S: int, D: int, *, block_k: int = 512) -> bool:
+    """Context shapes the decode kernel grid can tile (XLA otherwise)."""
+    return _decode_block(S, block_k) >= 128 and D <= 256
+
+
+def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
+                     impl: str = "auto", block_k: int = 512):
+    """Single-token decode attention against a padded KV context.
+
+    q: [B, H, D] — the current token's (already-rotated) queries;
+    k, v: [B, S, H, D] — the per-sequence context gathered from the
+    paged cache (positions >= ``lengths[b]`` are garbage and masked);
+    lengths: [B] int32 — valid context length per sequence (including
+    the current token, whose K/V the caller has already written).
+    Returns [B, H, D] in q's dtype.
+
+    ``impl``: "pallas" (strip-mined online-softmax kernel; raises for
+    untileable shapes), "xla" (masked einsum formulation, shards and
+    runs anywhere), or "auto" (pallas on a TPU backend for lane-aligned
+    shapes, xla otherwise — interpret-mode parity for the kernel lives
+    in ``tests/test_ops.py``).
+    """
+    B, H, D = q.shape
+    S = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    lengths = lengths.astype(jnp.int32)
+    if impl == "pallas" and not decode_supports(S, D, block_k=block_k):
+        raise ValueError(f"decode kernel cannot tile S={S}, D={D} "
+                         f"(block_k={block_k})")
+    block_k = _decode_block(S, block_k) or block_k
+    use_pallas = impl == "pallas" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+        and decode_supports(S, D, block_k=block_k))
+    if not use_pallas:
+        with jax.named_scope("attn/decode_xla"):
+            s = jnp.einsum("bhd,bshd->bhs", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            mask = jnp.arange(S)[None, None, :] < lengths[:, None, None]
+            s = jnp.where(mask, s, _NEG_INF)
+            m = jnp.max(s, -1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, -1, keepdims=True)
+            o = jnp.einsum("bhs,bshd->bhd", p.astype(v.dtype), v,
+                           preferred_element_type=jnp.float32)
+            return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    bk = min(block_k, S)
+    grid = (B, H, S // bk)
+    qp = jnp.broadcast_to(q[:, :, None, :], (B, H, _DECODE_QROWS, D))
+    with jax.named_scope("attn/decode_pallas"):
+        out = pl.pallas_call(
+            functools.partial(_decode_kernel, scale=scale, block_k=bk,
+                              num_kv=grid[2]),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((1, 1, _DECODE_QROWS, D),
+                                 lambda b, h, j, lens: (b, h, 0, 0)),
+                    pl.BlockSpec((1, bk, 1, D),
+                                 lambda b, h, j, lens: (b, j, h, 0)),
+                    pl.BlockSpec((1, bk, 1, D),
+                                 lambda b, h, j, lens: (b, j, h, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, 1, _DECODE_QROWS, D),
+                                       lambda b, h, j, lens: (b, h, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((_DECODE_QROWS, D), jnp.float32),
+                    pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
+                    pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
+                ],
+            ),
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            out_shape=jax.ShapeDtypeStruct((B, H, _DECODE_QROWS, D),
+                                           q.dtype),
+            interpret=_use_interpret(),
+        )(lengths, qp, k, v)
+        return out[:, :, 0]
 
 
 def make_flash_attention_fn(mesh=None, *, causal: bool = True,

@@ -28,12 +28,14 @@ from ray_tpu.telemetry.config import (TelemetryConfig,  # noqa: F401
 from ray_tpu.telemetry.flops import (chip_peak_tflops,  # noqa: F401
                                      gpt_fwd_flops_per_token,
                                      gpt_train_flops_per_token, mfu)
+from ray_tpu.telemetry.infer import InferTelemetry  # noqa: F401
 from ray_tpu.telemetry.step import (StepTelemetry,  # noqa: F401
                                     instrument, recorders)
 
 __all__ = [
     "TelemetryConfig", "telemetry_config",
     "StepTelemetry", "instrument", "recorders",
+    "InferTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",
     "gpt_train_flops_per_token", "mfu",

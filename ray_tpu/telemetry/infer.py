@@ -1,0 +1,169 @@
+"""Inference telemetry: TTFT / per-token latency / decode throughput.
+
+The serving-side sibling of :class:`ray_tpu.telemetry.step.StepTelemetry`
+— the engine records one entry per prefill and per decode step (wall
+time measured to the host-materialized sampled tokens, so it is the
+honest blocking figure), plus per-request TTFT at first-token time.
+Sinks mirror r09:
+
+- the engine wraps each step in ``ray_tpu.util.tracing`` spans
+  (``infer/prefill`` / ``infer/decode``), which the chrome-trace
+  exporter already merges into the unified host timeline;
+- Prometheus series through the control-plane metrics when a ray_tpu
+  session is up (``infer_ttft_seconds`` / ``infer_decode_step_seconds``
+  histograms, ``infer_decode_tokens_per_sec`` gauge), throttled and
+  dead-on-first-failure exactly like the train recorder;
+- :meth:`summary` is the ``telemetry`` block of ``bench.py --infer``
+  and ``ray_perf`` JSON.
+
+``RAY_TPU_TELEMETRY=0`` disables recording entirely (the engine checks
+``enabled`` before touching the recorder).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.telemetry.config import telemetry_config
+
+_TTFT_BOUNDARIES = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0]
+_STEP_BOUNDARIES = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                    0.01, 0.025, 0.05, 0.1, 0.25, 1.0]
+
+
+class InferTelemetry:
+    """Per-engine recorder for prefill/decode/TTFT records."""
+
+    _MAX_RECORDS = 10_000
+    _EMIT_INTERVAL_S = 0.5
+
+    def __init__(self, *, label: str = "infer", config=None):
+        tcfg = config or telemetry_config()
+        self.enabled: bool = tcfg.enabled
+        self.label = label
+        self.prefills: List[Dict[str, Any]] = []
+        self.decodes: List[Dict[str, Any]] = []
+        self.ttfts: List[float] = []
+        self.prefill_count = 0
+        self.decode_count = 0
+        self.requests_done = 0
+        self.decode_tokens = 0
+        self._metrics = None
+        self._metrics_dead = False
+        self._metrics_last = 0.0
+
+    # ---------------------------------------------------------- records
+    def record_prefill(self, wall_s: float, *, prompt_tokens: int,
+                       bucket: int) -> None:
+        if not self.enabled:
+            return
+        self.prefill_count += 1
+        self.prefills.append({"wall_s": wall_s,
+                              "prompt_tokens": prompt_tokens,
+                              "bucket": bucket})
+        del self.prefills[:-self._MAX_RECORDS]
+
+    def record_decode(self, wall_s: float, *, active: int) -> None:
+        if not self.enabled:
+            return
+        self.decode_count += 1
+        self.decode_tokens += active
+        self.decodes.append({"wall_s": wall_s, "active": active})
+        del self.decodes[:-self._MAX_RECORDS]
+        self._emit_decode(wall_s, active)
+
+    def record_ttft(self, ttft_s: float) -> None:
+        if not self.enabled:
+            return
+        self.ttfts.append(ttft_s)
+        del self.ttfts[:-self._MAX_RECORDS]
+        self._emit_ttft(ttft_s)
+
+    def record_request_done(self) -> None:
+        if self.enabled:
+            self.requests_done += 1
+
+    # ---------------------------------------------------------- summary
+    def summary(self) -> Dict[str, Any]:
+        """The ``telemetry`` block for ``bench.py --infer`` JSON."""
+        if not self.enabled:
+            return {"enabled": False}
+        out: Dict[str, Any] = {
+            "enabled": True, "label": self.label,
+            "requests_done": self.requests_done,
+            "prefills": self.prefill_count,
+            "decode_steps": self.decode_count,
+            "decode_tokens": self.decode_tokens,
+        }
+        if self.ttfts:
+            out["ttft_s"] = statistics.median(self.ttfts)
+            out["ttft_max_s"] = max(self.ttfts)
+        if self.prefills:
+            out["prefill_s"] = statistics.median(
+                r["wall_s"] for r in self.prefills)
+        if self.decodes:
+            # steady decode: drop the first step (carries the compile
+            # on cold engines), same policy as StepTelemetry step 0
+            steady = self.decodes[1:] or self.decodes
+            step_s = statistics.median(r["wall_s"] for r in steady)
+            out["decode_step_s"] = step_s
+            tok = sum(r["active"] for r in steady)
+            wall = sum(r["wall_s"] for r in steady)
+            if wall > 0:
+                out["decode_tokens_per_sec"] = tok / wall
+        return out
+
+    # ------------------------------------------------------- prometheus
+    def _metric_objects(self):
+        from ray_tpu._private.worker import is_initialized
+        if not is_initialized():
+            return None
+        if self._metrics is None:
+            from ray_tpu.util.metrics import Gauge, Histogram
+            tags = ("label",)
+            self._metrics = {
+                "ttft": Histogram(
+                    "infer_ttft_seconds",
+                    "time from request submit to first token",
+                    boundaries=_TTFT_BOUNDARIES, tag_keys=tags),
+                "step": Histogram(
+                    "infer_decode_step_seconds",
+                    "decode step wall seconds (to sampled tokens)",
+                    boundaries=_STEP_BOUNDARIES, tag_keys=tags),
+                "tok": Gauge("infer_decode_tokens_per_sec",
+                             "decode throughput", tag_keys=tags),
+            }
+        return self._metrics
+
+    def _emit_ttft(self, ttft_s: float):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["ttft"].observe(ttft_s,
+                                        tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_decode(self, wall_s: float, active: int):
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        if (self.decode_count > 1
+                and now - self._metrics_last < self._EMIT_INTERVAL_S):
+            return
+        self._metrics_last = now
+        try:
+            metrics = self._metric_objects()
+            if metrics is None:
+                return
+            tags = {"label": self.label}
+            metrics["step"].observe(wall_s, tags=tags)
+            if wall_s > 0:
+                metrics["tok"].set(active / wall_s, tags=tags)
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True

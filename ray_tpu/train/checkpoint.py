@@ -117,28 +117,63 @@ class Checkpoint:
 
 
 # ---------------------------------------------------------------- pytrees
+_ORBAX_WARNED = False
+
+
 def save_pytree(tree, path: str, *, name: str = "state") -> None:
-    """Save a jax pytree: orbax if importable, else npz + structure pickle."""
+    """Save a jax pytree: orbax if usable, else npz + structure pickle.
+
+    The npz fallback handles ml_dtypes leaves (bf16/fp8): ``np.savez``
+    cannot serialize custom dtypes, so those leaves are written as raw
+    uint8 with their (dtype, shape) recorded beside the treedef and
+    reconstructed by :func:`load_pytree` via a view."""
+    global _ORBAX_WARNED
     os.makedirs(path, exist_ok=True)
     try:
         import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        target = os.path.join(path, name)
-        if os.path.exists(target):
-            shutil.rmtree(target)
-        ckptr.save(target, tree)
-        ckptr.wait_until_finished()
-        return
-    except Exception:  # noqa: BLE001 - fall back to numpy
-        pass
+    except ImportError:    # not installed: the documented quiet fallback
+        ocp = None
+    except Exception as e:  # noqa: BLE001 — broken install (jax skew):
+        ocp = None          # fall back like before, but say so
+        if not _ORBAX_WARNED:
+            import sys
+            print(f"save_pytree: orbax import failed ({e!r}); falling "
+                  "back to the npz writer (warning once per process)",
+                  file=sys.stderr)
+            _ORBAX_WARNED = True
+    if ocp is not None:
+        try:
+            ckptr = ocp.StandardCheckpointer()
+            target = os.path.join(path, name)
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            ckptr.save(target, tree)
+            ckptr.wait_until_finished()
+            return
+        except Exception as e:  # noqa: BLE001 - fall back, loudly
+            # a partial orbax dir would shadow the npz fallback at
+            # load time (load_pytree routes on isdir)
+            shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+            if not _ORBAX_WARNED:
+                import sys
+                print(f"save_pytree: orbax save failed ({e!r}); falling "
+                      "back to the npz writer (warning once per process)",
+                      file=sys.stderr)
+                _ORBAX_WARNED = True
     import cloudpickle
     import jax
     import numpy as np
     leaves, treedef = jax.tree.flatten(tree)
-    np.savez(os.path.join(path, f"{name}.npz"),
-             **{str(i): np.asarray(leaf) for i, leaf in enumerate(leaves)})
+    arrays, exotic = {}, {}
+    for i, leaf in enumerate(leaves):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        if arr.dtype.kind == "V":      # ml_dtypes: npz can't serialize
+            exotic[str(i)] = (str(arr.dtype), arr.shape)
+            arr = arr.reshape(-1).view(np.uint8)
+        arrays[str(i)] = arr
+    np.savez(os.path.join(path, f"{name}.npz"), **arrays)
     with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
-        cloudpickle.dump(treedef, f)
+        cloudpickle.dump({"treedef": treedef, "exotic": exotic}, f)
 
 
 def load_pytree(path: str, *, name: str = "state", target=None):
@@ -161,6 +196,16 @@ def load_pytree(path: str, *, name: str = "state", target=None):
     import numpy as np
     data = np.load(os.path.join(path, f"{name}.npz"))
     with open(os.path.join(path, f"{name}.treedef.pkl"), "rb") as f:
-        treedef = cloudpickle.load(f)
-    leaves = [data[str(i)] for i in range(len(data.files))]
+        saved = cloudpickle.load(f)
+    if isinstance(saved, dict):
+        treedef, exotic = saved["treedef"], saved.get("exotic", {})
+    else:                    # pre-r10 files pickled the bare treedef
+        treedef, exotic = saved, {}
+    leaves = []
+    for i in range(len(data.files)):
+        arr = data[str(i)]
+        if str(i) in exotic:
+            dtype, shape = exotic[str(i)]
+            arr = arr.view(np.dtype(dtype)).reshape(shape)
+        leaves.append(arr)
     return jax.tree.unflatten(treedef, leaves)

@@ -1,0 +1,99 @@
+"""Round-10 on-chip driver: inference engine + decode-kernel A/B.
+
+Usage: python scratch/r10_infer.py <variant>
+
+Variants:
+  engine  — the bench.py --infer headline on chip shapes (GPT-2 124M
+            bf16, mixed-length request batch, continuous batching):
+            prints the headline JSON line (decode tokens/s, TTFT,
+            per-step decode latency, compile-cache counters proving
+            zero steady-state recompiles) — the first ground-truth
+            serving numbers for docs/PERF.md r10.
+  decode  — isolated cache-aware decode attention A/B: strip-mined
+            Pallas kernel vs the masked-einsum XLA fallback at the
+            engine's gathered-context shape (ray_perf --decode).
+            Decides the RAY_TPU_INFER_DECODE=auto gate on hardware.
+  slots   — decode-slot sweep (4/8/16/32 slots at GPT-2 shapes): decode
+            tokens/s and per-step latency per slot count, the
+            batching-vs-latency trade for the RAY_TPU_INFER_SLOTS
+            default.
+
+Carried arms (no chip session has happened yet; r06-r09 rows in
+docs/PERF.md are still pending, so the first chip session runs
+everything from here): xplane / timeline plus every r8/r7/r6 arm —
+delegated verbatim to scratch/r9_telemetry.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "engine"
+
+_R9_ARMS = ("xplane", "timeline", "overlap", "gspmd", "ring", "bytes",
+            "pack2ab", "flash", "noremat", "ce", "b28", "b32", "b28x",
+            "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R9_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r9_telemetry.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r10_infer.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("engine", "decode", "slots"), \
+    f"unknown variant {VARIANT!r}"
+
+if VARIANT == "engine":
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(HERE), "bench.py"),
+         "--infer"]).returncode)
+
+if VARIANT == "decode":
+    from ray_tpu._private.ray_perf import decode_perf
+    for ctx in (512, 1024):
+        for impl in ("pallas", "xla"):
+            decode_perf(ctx=ctx, impl=impl)
+    sys.exit(0)
+
+# slots sweep
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.inference import InferenceEngine, SamplingParams  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig, init_params  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16)
+    sweep, requests, max_new = (4, 8, 16, 32), 64, 64
+else:
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2, n_heads=4,
+                    max_seq=256, dtype=jnp.float32)
+    sweep, requests, max_new = (2, 4), 8, 8
+
+params = init_params(cfg, jax.random.PRNGKey(0))
+for slots in sweep:
+    # telemetry pinned on: the sweep's numbers ARE the output
+    engine = InferenceEngine(cfg, params, slots=slots, telemetry=True)
+    rng = jax.random.PRNGKey(1)
+    prompts = []
+    for i in range(requests):
+        rng, sub = jax.random.split(rng)
+        n = 16 + (37 * i) % (cfg.max_seq // 2)
+        prompts.append(list(jax.random.randint(sub, (n,), 0,
+                                               cfg.vocab_size)))
+    engine.generate(prompts, max_new_tokens=max_new,
+                    sampling=SamplingParams())
+    tel = engine.telemetry.summary()
+    print(json.dumps({
+        "arm": f"slots{slots}", "slots": slots,
+        "decode_tokens_per_sec": tel.get("decode_tokens_per_sec"),
+        "decode_step_s": tel.get("decode_step_s"),
+        "ttft_s": tel.get("ttft_s"),
+        "compiles": engine.stats()["compiles"],
+    }), flush=True)

@@ -540,3 +540,47 @@ def test_async_proxy_500_concurrent(ray_start_regular):
     assert len(results) == 500
     assert all(s == 200 for s in results)
     serve.shutdown()
+
+
+def test_streaming_error_propagates_and_frees_slot(ray_start_regular):
+    """An exception raised mid-generator inside
+    ``ServeReplica.handle_request_streaming`` must surface to the
+    ``DeploymentResponseGenerator`` consumer (not hang or truncate
+    silently) and still decrement ``_ongoing`` — a leaked slot would
+    poison pow-2 routing and autoscaling forever (r10 satellite)."""
+    import ray_tpu
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class BoomSync:
+        def __call__(self, n):
+            yield "a"
+            yield "b"
+            raise RuntimeError("boom-sync")
+
+    @serve.deployment
+    class BoomAsync:
+        async def __call__(self, n):
+            yield "x"
+            raise RuntimeError("boom-async")
+
+    def drain(handle, want, marker):
+        got = []
+        with pytest.raises(Exception, match=marker):
+            for item in handle.options(stream=True).remote(0):
+                got.append(item)
+        assert got == want          # items before the raise arrived
+        # the finally must have run replica-side: no in-flight leak
+        replica = handle._get_routing()["replicas"][0]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if ray_tpu.get(replica.num_ongoing.remote(), timeout=10) == 0:
+                return
+            time.sleep(0.05)
+        raise AssertionError("_ongoing never returned to 0")
+
+    h1 = serve.run(BoomSync.bind(), name="boom_sync")
+    drain(h1, ["a", "b"], "boom-sync")
+    h2 = serve.run(BoomAsync.bind(), name="boom_async")
+    drain(h2, ["x"], "boom-async")
+    serve.shutdown()
