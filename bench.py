@@ -59,10 +59,11 @@ def _kernel_smoke():
         sys.exit(proc.returncode)
 
 
-def _collective_bytes(cfg, mesh, batch, seq, comm_mode):
+def _collective_bytes(cfg, mesh, batch, seq, comm_mode, quant="none"):
     from ray_tpu.parallel import overlap as ovl
     return ovl.collective_bytes_per_step(cfg, mesh, batch=batch,
-                                         seq=seq, comm_mode=comm_mode)
+                                         seq=seq, comm_mode=comm_mode,
+                                         quant=quant)
 
 
 def _mesh_arg():
@@ -148,10 +149,17 @@ def _bench_mesh_body(axes):
 
     batch_data = training.synthetic_lm_batch(
         jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
-    for want in ("gspmd", "overlap"):
+    # three rows per mesh: the two schedules plus the int8-wire overlap
+    # arm, so MULTICHIP_r*.json carries gspmd-vs-overlap-vs-quantized
+    # with per-collective wire dtypes side by side
+    for want, want_quant in (("gspmd", "none"), ("overlap", "none"),
+                             ("overlap", "int8")):
         fallback = None
-        fns = training.build_gpt_train(cfg, mesh, comm_mode=want)
+        fns = training.build_gpt_train(cfg, mesh, comm_mode=want,
+                                       comm_quant=want_quant)
         mode = fns["comm_mode"]
+        if want_quant == "int8" and mode != "overlap":
+            continue     # overlap fell back: no distinct quantized arm
         try:
             state = fns["init_fn"](jax.random.PRNGKey(0))
             for _ in range(2):
@@ -193,8 +201,11 @@ def _bench_mesh_body(axes):
             "mesh": dict(mesh.shape),
             "comm_mode": mode,
             "requested_comm_mode": want,
+            "requested_comm_quant": want_quant,
+            "comm_quant": fns.get("comm_quant", "none"),
             "collective_bytes_per_step": ovl.collective_bytes_per_step(
-                cfg, mesh, batch=batch, seq=seq, comm_mode=mode),
+                cfg, mesh, batch=batch, seq=seq, comm_mode=mode,
+                quant=fns.get("comm_quant", "none")),
             "final_loss": round(float(metrics["loss"]), 4),
         }
         if "telemetry" in fns:
@@ -276,6 +287,10 @@ def bench_infer():
         # decode compile ever, one prefill compile per bucket touched
         "compiles": stats["compiles"],
         "compile_cache_hits": stats["hits"],
+        # true per-slot cache footprint (codes + scale arrays when the
+        # cache stores int8) — the capacity-per-HBM-byte headline
+        "kv_dtype": stats["kv_dtype"],
+        "kv_bytes_per_slot": stats["kv_bytes_per_slot"],
         "telemetry": tel,
     }
     print(json.dumps(result))
@@ -464,8 +479,10 @@ def main():
         # schedule is --mesh territory)
         "mesh": dict(mesh.shape),
         "comm_mode": fns["comm_mode"],
+        "comm_quant": fns.get("comm_quant", "none"),
         "collective_bytes_per_step": _collective_bytes(
-            cfg, mesh, batch, seq, fns["comm_mode"]),
+            cfg, mesh, batch, seq, fns["comm_mode"],
+            fns.get("comm_quant", "none")),
         # per-step telemetry (compile split, blocking-sync step time,
         # analytic-FLOPs MFU, HBM memory_analysis, collective bytes);
         # {"enabled": False} under RAY_TPU_TELEMETRY=0

@@ -38,12 +38,23 @@ class InferConfig:
       implementation — ``pallas`` (strip-mined online-softmax kernel,
       ``ops/attention.py:decode_attention``), ``xla`` (masked einsum),
       or ``auto`` (pallas on a TPU backend when the context tiles).
+    - ``RAY_TPU_KV_DTYPE`` (default ``model``): KV-cache storage dtype
+      — ``model`` (the model's ``cfg.dtype``) or ``int8``
+      (block-scaled int8, one f32 scale per (position, head) lane
+      vector stored in per-page scale arrays; keys/values quantize
+      post-RoPE on write and dequantize inside the decode-attention
+      context strips).  ``int8`` roughly halves ``KVCache.bytes`` per
+      page — i.e. ~2x the decode slots per HBM byte — at a bounded
+      logits error (parity-tested against the ``model``-dtype cache).
+      Default stays ``model`` until the on-chip A/B
+      (``scratch/r11_quant.py``).
     """
     slots: int = 8
     page_size: int = 128
     pages: int = 0
     buckets: Tuple[int, ...] = ()
     decode_impl: str = "auto"
+    kv_dtype: str = "model"
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -62,12 +73,18 @@ def infer_config(refresh: bool = False) -> InferConfig:
         raw_buckets = env("RAY_TPU_INFER_BUCKETS", "")
         buckets = tuple(sorted(int(b) for b in raw_buckets.split(",")
                                if b.strip())) if raw_buckets else ()
+        kv_dtype = env("RAY_TPU_KV_DTYPE", "model")
+        if kv_dtype not in ("model", "int8"):
+            print(f"RAY_TPU_KV_DTYPE={kv_dtype!r} unknown; "
+                  "using 'model'", file=sys.stderr)
+            kv_dtype = "model"
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
             pages=int(env("RAY_TPU_INFER_PAGES", "0")),
             buckets=buckets,
             decode_impl=impl,
+            kv_dtype=kv_dtype,
         )
     return _CONFIG
 

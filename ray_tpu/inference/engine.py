@@ -80,6 +80,7 @@ class InferenceEngine:
                  num_pages: Optional[int] = None,
                  buckets: Optional[Tuple[int, ...]] = None,
                  decode_impl: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
                  executable_cache: Optional[Dict[Any, Any]] = None):
@@ -92,6 +93,10 @@ class InferenceEngine:
         self.page_size = (page_size if page_size is not None
                           else icfg.page_size)
         self.decode_impl = decode_impl or icfg.decode_impl
+        self.kv_dtype = kv_dtype or icfg.kv_dtype
+        if self.kv_dtype not in ("model", "int8"):
+            raise ValueError(f"unknown kv_dtype {self.kv_dtype!r} "
+                             "(check RAY_TPU_KV_DTYPE)")
         if self.slots < 1:
             raise ValueError(f"need >= 1 decode slot, got {self.slots} "
                              "(check RAY_TPU_INFER_SLOTS)")
@@ -105,13 +110,15 @@ class InferenceEngine:
         max_pages_per_slot = kvc.pages_needed(cfg.max_seq, self.page_size)
         num_pages = num_pages or icfg.pages or (
             self.slots * max_pages_per_slot + 1)
+        self.max_pages_per_slot = max_pages_per_slot
         self.scheduler = SlotScheduler(
             slots=self.slots, page_size=self.page_size,
             num_pages=num_pages, max_pages_per_slot=max_pages_per_slot)
         self.cache = kvc.KVCache(
             n_layers=cfg.n_layers, num_pages=num_pages,
             page_size=self.page_size, n_heads=cfg.n_heads,
-            head_dim=cfg.head_dim, dtype=cfg.dtype)
+            head_dim=cfg.head_dim, dtype=cfg.dtype,
+            kv_dtype=self.kv_dtype)
         # compile cache: key -> AOT executable; an executable raises on
         # shape drift, so the counters below are honest.  Keys carry
         # the full (cfg, geometry) so a shared cache cannot alias
@@ -119,7 +126,8 @@ class InferenceEngine:
         self._compiled: Dict[Any, Any] = (
             executable_cache if executable_cache is not None else {})
         self._exec_key = (cfg, self.slots, self.page_size, num_pages,
-                          max_pages_per_slot, self.decode_impl)
+                          max_pages_per_slot, self.decode_impl,
+                          self.kv_dtype)
         self.compile_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
         self.hit_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
         self._requests: Dict[int, Request] = {}
@@ -136,6 +144,10 @@ class InferenceEngine:
                   else TelemetryConfig(enabled=False)
                   if telemetry is False else None)
         self.telemetry = InferTelemetry(config=config)
+        self.telemetry.record_cache_info(
+            kv_dtype=self.kv_dtype, cache_bytes=self.cache.bytes,
+            kv_bytes_per_slot=self.cache.bytes_per_slot(
+                max_pages_per_slot))
 
     # --------------------------------------------------------- requests
     def submit(self, prompt, max_new_tokens: int = 16,
@@ -204,6 +216,9 @@ class InferenceEngine:
             "waiting": len(self.scheduler.waiting),
             "active": len(self.scheduler.active),
             "cache_bytes": self.cache.bytes,
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_slot": self.cache.bytes_per_slot(
+                self.max_pages_per_slot),
         }
 
     # ------------------------------------------------------ engine tick
@@ -252,12 +267,13 @@ class InferenceEngine:
         with tracing.span("infer/prefill", rid=req.rid, bucket=bucket):
             fn = self._get_compiled(
                 ("prefill", bucket), self._build_prefill,
-                (self.params, self.cache.k, self.cache.v, tokens,
+                (self.params, *self.cache.state, tokens,
                  np.int32(plen), sched.page_table[slot]),
                 kind="prefill")
-            logits, self.cache.k, self.cache.v = fn(
-                self.params, self.cache.k, self.cache.v, tokens,
+            logits, *state = fn(
+                self.params, *self.cache.state, tokens,
                 np.int32(plen), sched.page_table[slot])
+            self.cache.state = tuple(state)
             tok = self._sample_slots(logits, [req])[0]
         if self.debug_logits:
             self.logits_trace.setdefault(req.rid, []).append(
@@ -284,12 +300,13 @@ class InferenceEngine:
         with tracing.span("infer/decode", active=len(active)):
             fn = self._get_compiled(
                 ("decode",), self._build_decode,
-                (self.params, self.cache.k, self.cache.v, tokens,
+                (self.params, *self.cache.state, tokens,
                  sched.lengths, sched.page_table),
                 kind="decode")
-            logits, self.cache.k, self.cache.v = fn(
-                self.params, self.cache.k, self.cache.v, tokens,
+            logits, *state = fn(
+                self.params, *self.cache.state, tokens,
                 sched.lengths, sched.page_table)
+            self.cache.state = tuple(state)
             sampled = self._sample_slots(logits, reqs)
         wall = time.monotonic() - t0
         if self.telemetry.enabled:
@@ -366,61 +383,99 @@ class InferenceEngine:
             x = x + (pe if positions.ndim == 2 else pe[None])
         return x
 
-    def _layer_scan(self, params, x, k_all, v_all, positions, attn_hook):
+    def _layer_scan(self, params, x, caches, positions, attn_hook):
         """Run the layer stack with per-layer cache slices in the scan
         carry (dynamic-slice in / dynamic-update out, the donation-
-        friendly pattern) -> (final normed hidden, k_all, v_all)."""
+        friendly pattern) -> (final normed hidden, caches).
+
+        ``caches`` is the cache's state tuple of stacked ``[L, ...]``
+        arrays — ``(k, v)`` or, quantized, ``(k, v, k_scale,
+        v_scale)``; the per-layer slice tuple is opaque to
+        ``layer_apply`` and round-trips through ``attn_hook``."""
         cfg = self.cfg
 
         def body(carry, i):
-            x, k_all, v_all = carry
+            x, caches = carry
             lp = jax.tree.map(
                 lambda a: lax.dynamic_index_in_dim(a, i, 0,
                                                    keepdims=False),
                 params["layers"])
-            ck = lax.dynamic_index_in_dim(k_all, i, 0, keepdims=False)
-            cv = lax.dynamic_index_in_dim(v_all, i, 0, keepdims=False)
-            x, _aux, (ck, cv) = gpt_mod.layer_apply(
+            layer_cache = tuple(
+                lax.dynamic_index_in_dim(c, i, 0, keepdims=False)
+                for c in caches)
+            x, _aux, layer_cache = gpt_mod.layer_apply(
                 lp, x, cfg, positions=positions, attn_fn=attn_hook,
-                cache=(ck, cv))
-            k_all = lax.dynamic_update_index_in_dim(k_all, ck, i, 0)
-            v_all = lax.dynamic_update_index_in_dim(v_all, cv, i, 0)
-            return (x, k_all, v_all), None
+                cache=layer_cache)
+            caches = tuple(
+                lax.dynamic_update_index_in_dim(c, nc, i, 0)
+                for c, nc in zip(caches, layer_cache))
+            return (x, caches), None
 
-        (x, k_all, v_all), _ = lax.scan(
-            body, (x, k_all, v_all), jnp.arange(cfg.n_layers))
+        (x, caches), _ = lax.scan(
+            body, (x, caches), jnp.arange(cfg.n_layers))
         x = gpt_mod._norm(x, params["ln_f"], cfg.norm,
                           bias=params.get("ln_f_b"),
                           eps=gpt_mod.norm_eps(cfg))
-        return x, k_all, v_all
+        return x, caches
+
+    def _quantize_kv(self, kv):
+        """[..., H, D] post-RoPE K or V -> (int8 codes, [..., H] f32
+        scales): one scale per head_dim lane vector (deterministic
+        rounding — cache entries are weights-like, read many times)."""
+        from ray_tpu.quant import quantize_block
+        q, s = quantize_block(kv, block=self.cfg.head_dim, axis=-1)
+        return q, s[..., 0]
 
     def _build_prefill(self):
         cfg = self.cfg
         page_size = self.page_size
+        quantized = self.kv_dtype == "int8"
 
-        def prefill(params, k_all, v_all, tokens, length, page_row):
-            """tokens [1, S_bucket]; length scalar (valid prefix);
-            page_row [max_pages] -> (last-token logits [1, V] f32,
-            k_all, v_all)."""
+        def prefill(params, *args):
+            """(params, *cache_state, tokens [1, S_bucket], length
+            scalar (valid prefix), page_row [max_pages]) ->
+            (last-token logits [1, V] f32, *cache_state)."""
+            *cache_state, tokens, length, page_row = args
             S = tokens.shape[1]
             positions = jnp.arange(S)
 
             def attn_hook(q, k, v, cache):
-                ck, cv = cache
-                ck = kvc.write_prefill(ck, k[0], page_row, page_size)
-                cv = kvc.write_prefill(cv, v[0], page_row, page_size)
+                if quantized:
+                    ck, cv, cks, cvs = cache
+                    kq, ks = self._quantize_kv(k[0])
+                    vq, vs = self._quantize_kv(v[0])
+                    ck = kvc.write_prefill(ck, kq, page_row, page_size)
+                    cv = kvc.write_prefill(cv, vq, page_row, page_size)
+                    cks = kvc.write_prefill(cks, ks, page_row,
+                                            page_size)
+                    cvs = kvc.write_prefill(cvs, vs, page_row,
+                                            page_size)
+                    new_cache = (ck, cv, cks, cvs)
+                else:
+                    ck, cv = cache
+                    ck = kvc.write_prefill(ck, k[0], page_row,
+                                           page_size)
+                    cv = kvc.write_prefill(cv, v[0], page_row,
+                                           page_size)
+                    new_cache = (ck, cv)
+                # attention reads the full-precision prompt K/V (the
+                # prompt IS the whole context); quantization only
+                # affects what later decode steps read back
                 o = self._prefill_attention(q, k, v)
-                return o, (ck, cv)
+                return o, new_cache
 
             x = self._embed(params, tokens, positions)
-            x, k_all, v_all = self._layer_scan(params, x, k_all, v_all,
-                                               positions, attn_hook)
+            x, cache_state = self._layer_scan(params, x,
+                                              tuple(cache_state),
+                                              positions, attn_hook)
             h = jnp.take(x[0], length - 1, axis=0)[None, None]  # [1,1,d]
             logits = jnp.einsum("bsd,dv->bsv", h,
                                 gpt_mod.lm_head(params, cfg))
-            return logits[:, 0].astype(jnp.float32), k_all, v_all
+            return (logits[:, 0].astype(jnp.float32),) + cache_state
 
-        return jax.jit(prefill, donate_argnums=(1, 2))
+        n_state = len(self.cache.state)
+        return jax.jit(prefill,
+                       donate_argnums=tuple(range(1, 1 + n_state)))
 
     def _prefill_attention(self, q, k, v):
         """Causal self-attention over the bucket (no cache read — the
@@ -437,15 +492,38 @@ class InferenceEngine:
         cfg = self.cfg
         page_size = self.page_size
         impl = self.decode_impl
+        quantized = self.kv_dtype == "int8"
 
-        def decode(params, k_all, v_all, tokens, lengths, page_table):
-            """tokens [slots] (each slot's next input token); lengths
-            [slots] (tokens already cached = the new token's absolute
-            position); page_table [slots, max_pages] -> (logits
-            [slots, V] f32, k_all, v_all)."""
+        def decode(params, *args):
+            """(params, *cache_state, tokens [slots] (each slot's next
+            input token), lengths [slots] (tokens already cached = the
+            new token's absolute position), page_table
+            [slots, max_pages]) -> (logits [slots, V] f32,
+            *cache_state)."""
+            *cache_state, tokens, lengths, page_table = args
             positions = lengths[:, None]                   # [B, 1]
 
             def attn_hook(q, k, v, cache):
+                from ray_tpu.ops.attention import decode_attention
+                if quantized:
+                    ck, cv, cks, cvs = cache
+                    kq, ks = self._quantize_kv(k[:, 0])
+                    vq, vs = self._quantize_kv(v[:, 0])
+                    ck = kvc.write_decode(ck, kq, page_table, lengths,
+                                          page_size)
+                    cv = kvc.write_decode(cv, vq, page_table, lengths,
+                                          page_size)
+                    cks = kvc.write_decode(cks, ks, page_table,
+                                           lengths, page_size)
+                    cvs = kvc.write_decode(cvs, vs, page_table,
+                                           lengths, page_size)
+                    o = decode_attention(
+                        q[:, 0], kvc.gather_pages(ck, page_table),
+                        kvc.gather_pages(cv, page_table), lengths + 1,
+                        impl=impl,
+                        k_scale=kvc.gather_pages(cks, page_table),
+                        v_scale=kvc.gather_pages(cvs, page_table))
+                    return o[:, None], (ck, cv, cks, cvs)
                 ck, cv = cache
                 ck = kvc.write_decode(ck, k[:, 0], page_table, lengths,
                                       page_size)
@@ -453,16 +531,18 @@ class InferenceEngine:
                                       page_size)
                 kctx = kvc.gather_pages(ck, page_table)
                 vctx = kvc.gather_pages(cv, page_table)
-                from ray_tpu.ops.attention import decode_attention
                 o = decode_attention(q[:, 0], kctx, vctx, lengths + 1,
                                      impl=impl)
                 return o[:, None], (ck, cv)
 
             x = self._embed(params, tokens[:, None], positions)
-            x, k_all, v_all = self._layer_scan(params, x, k_all, v_all,
-                                               positions, attn_hook)
+            x, cache_state = self._layer_scan(params, x,
+                                              tuple(cache_state),
+                                              positions, attn_hook)
             logits = jnp.einsum("bsd,dv->bsv", x,
                                 gpt_mod.lm_head(params, cfg))
-            return logits[:, 0].astype(jnp.float32), k_all, v_all
+            return (logits[:, 0].astype(jnp.float32),) + cache_state
 
-        return jax.jit(decode, donate_argnums=(1, 2))
+        n_state = len(self.cache.state)
+        return jax.jit(decode,
+                       donate_argnums=tuple(range(1, 1 + n_state)))

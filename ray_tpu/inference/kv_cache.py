@@ -20,11 +20,23 @@ Device-side update/gather helpers are plain functional jnp ops (scatter
 via ``.at[]``, gather via advanced indexing) so they trace into the
 engine's compiled steps; the host-side :class:`PageAllocator` owns the
 free list and the leak invariants (``tests/test_inference.py``).
+
+``kv_dtype="int8"`` stores the K/V arrays block-scale-quantized
+(``ray_tpu.quant``): codes in int8, one f32 scale per (page, position,
+head) lane vector riding in per-page scale arrays
+``[n_layers, pages, page_size, kv_heads]``.  The write/gather helpers
+are shape-generic (they address ``[P, page_size, ...]`` storage by
+page), so the same scatter/gather moves codes and scales; the engine
+quantizes post-RoPE on write and ``decode_attention`` dequantizes
+inside its context strips.  At head_dim 64 that is 68 bytes per cached
+vector (64 codes + one f32 scale) vs 128 in bf16 — :meth:`KVCache.bytes`
+counts both arrays, so the ~2x capacity-per-HBM-byte claim is
+asserted, not assumed.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -62,19 +74,73 @@ class PageAllocator:
 
 
 class KVCache:
-    """The preallocated paged K/V arrays plus their static geometry."""
+    """The preallocated paged K/V arrays plus their static geometry.
+
+    ``kv_dtype``: ``"model"`` stores ``dtype`` K/V; ``"int8"`` stores
+    int8 codes plus per-(page, position, head) f32 scale arrays.  The
+    engine threads :attr:`state` — ``(k, v)`` or
+    ``(k, v, k_scale, v_scale)`` — through its donated compiled steps,
+    so decode allocates nothing in either mode.
+    """
 
     def __init__(self, *, n_layers: int, num_pages: int, page_size: int,
-                 n_heads: int, head_dim: int, dtype):
+                 n_heads: int, head_dim: int, dtype,
+                 kv_dtype: str = "model"):
+        if kv_dtype not in ("model", "int8"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}; "
+                             "expected 'model' or 'int8'")
         self.num_pages = num_pages
         self.page_size = page_size
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         shape = (n_layers, num_pages, page_size, n_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        store = jnp.int8 if self.quantized else dtype
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        if self.quantized:
+            # scales start at 0 (fresh garbage dequantizes to zeros),
+            # but writes routed to the garbage page overwrite them with
+            # real values — its harmlessness rests on decode_attention
+            # masking positions >= length, same as the unquantized cache
+            self.k_scale = jnp.zeros(shape[:-1], jnp.float32)
+            self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
+
+    @property
+    def state(self) -> Tuple:
+        """The donated device arrays, in step-argument order."""
+        if self.quantized:
+            return (self.k, self.v, self.k_scale, self.v_scale)
+        return (self.k, self.v)
+
+    @state.setter
+    def state(self, arrays: Tuple) -> None:
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale = arrays
+        else:
+            self.k, self.v = arrays
 
     @property
     def bytes(self) -> int:
-        return 2 * self.k.size * self.k.dtype.itemsize
+        """True cache footprint — K/V *and* (when quantized) the scale
+        arrays; the r10 figure omitted nothing only because there were
+        no scales yet."""
+        total = 2 * self.k.size * self.k.dtype.itemsize
+        if self.quantized:
+            total += 2 * self.k_scale.size * self.k_scale.dtype.itemsize
+        return total
+
+    def bytes_per_slot(self, pages_per_slot: int) -> int:
+        """HBM bytes one fully-reserved decode slot pins (codes +
+        scales across all layers) — the capacity-planning figure the
+        telemetry summary and ``bench.py --infer`` report."""
+        per_page = (2 * self.k.shape[0] * self.page_size
+                    * self.k.shape[3] * self.k.shape[4]
+                    * self.k.dtype.itemsize)
+        if self.quantized:
+            per_page += (2 * self.k.shape[0] * self.page_size
+                         * self.k.shape[3]
+                         * self.k_scale.dtype.itemsize)
+        return pages_per_slot * per_page
 
 
 def write_prefill(pages, new, page_row, page_size: int):
@@ -102,15 +168,17 @@ def write_decode(pages, new, page_table, lengths, page_size: int):
 
 
 def gather_pages(pages, page_table):
-    """[P, page_size, H, D] x [B, max_pages] -> [B, max_pages*page, H, D].
+    """[P, page_size, *rest] x [B, max_pages] -> [B, max_pages*page, *rest].
 
     The padded per-slot context the decode attention masks by length —
     gather-then-attend (indexing pages *inside* the kernel is the
-    natural next step once this path has chip numbers)."""
+    natural next step once this path has chip numbers).  Shape-generic
+    past the page dims, so K/V codes ([..., H, D]) and their scale
+    arrays ([..., H]) ride the same gather."""
     B, max_pages = page_table.shape
-    _, ps, H, D = pages.shape
-    ctx = pages[page_table]                  # [B, max_pages, ps, H, D]
-    return ctx.reshape(B, max_pages * ps, H, D)
+    ps = pages.shape[1]
+    ctx = pages[page_table]             # [B, max_pages, ps, *rest]
+    return ctx.reshape((B, max_pages * ps) + pages.shape[2:])
 
 
 def pages_needed(tokens: int, page_size: int) -> int:

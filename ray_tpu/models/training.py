@@ -67,6 +67,7 @@ def _batch_sharding(mesh):
 
 def _maybe_instrument(fns: Dict[str, Callable], cfg, mesh, *,
                       comm_mode: Optional[str] = None,
+                      comm_quant: Optional[str] = None,
                       ce_mode: Optional[str] = None,
                       label: str = "train",
                       telemetry: Optional[bool] = None):
@@ -84,8 +85,8 @@ def _maybe_instrument(fns: Dict[str, Callable], cfg, mesh, *,
             enabled=True,
             profile_dir=tel_mod.telemetry_config().profile_dir)
     return tel_mod.instrument(fns, cfg, mesh, comm_mode=comm_mode,
-                              ce_mode=ce_mode, label=label,
-                              config=config)
+                              comm_quant=comm_quant, ce_mode=ce_mode,
+                              label=label, config=config)
 
 
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
@@ -94,6 +95,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     attn_pack2: Optional[bool] = None,
                     ce_mode: Optional[str] = None,
                     comm_mode: Optional[str] = None,
+                    comm_quant: Optional[str] = None,
                     telemetry: Optional[bool] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
@@ -111,7 +113,14 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     per-block FSDP gathers, as-you-go grad reduce-scatters, ring
     all-gather-matmul TP) and falls back to "gspmd" loudly when the
     (cfg, mesh) is outside its dp/fsdp/tp dense coverage; the chosen
-    mode is returned as ``fns["comm_mode"]``.  The overlap step/loss
+    mode is returned as ``fns["comm_mode"]``.  ``comm_quant`` pins the
+    overlap schedule's collective wire dtype ("none" / "int8"; default:
+    ``comm_config().quant`` from ``RAY_TPU_COMM_QUANT``) — "int8" moves
+    the FSDP weight all-gathers and grad reduce-scatters as
+    block-scaled int8 (``ray_tpu.quant``, stochastic-rounding ring RS);
+    it is dropped loudly when the effective comm_mode is "gspmd"
+    (GSPMD owns its collectives), and the effective value is returned
+    as ``fns["comm_quant"]``.  The overlap step/loss
     use their own block formulation (einsum attention, vocab-parallel
     CE), so ``attn_pack2``/``ce_mode`` only affect the GSPMD-side
     ``forward_fn`` there.  ``telemetry`` (default: env
@@ -138,6 +147,17 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                 print(f"comm_mode=overlap unsupported ({reason}); "
                       "falling back to gspmd", file=sys.stderr)
                 comm_mode = "gspmd"
+    if comm_quant is None:
+        comm_quant = ovl.comm_config().quant
+    if comm_quant not in ("none", "int8"):
+        raise ValueError(f"unknown comm_quant {comm_quant!r}; "
+                         "expected 'none' or 'int8'")
+    if comm_quant != "none" and comm_mode != "overlap":
+        import sys
+        print(f"comm_quant={comm_quant} needs the overlap schedule "
+              f"(comm_mode is {comm_mode!r}); wire stays "
+              f"{jnp.dtype(cfg.dtype).name}", file=sys.stderr)
+        comm_quant = "none"
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
     if mesh.shape.get("sp", 1) > 1:
@@ -160,7 +180,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
                                mesh=mesh, ce_mode=ce_mode)
 
-    overlap_fns = (ovl.build_overlap_step_fns(cfg, mesh)
+    overlap_fns = (ovl.build_overlap_step_fns(cfg, mesh, quant=comm_quant)
                    if comm_mode == "overlap" else None)
 
     def value_and_grad(params, batch):
@@ -211,8 +231,10 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "batch_sharding": batch_sh,
         "attn_fn": attn_fn,
         "comm_mode": comm_mode,
+        "comm_quant": comm_quant,
     }
     return _maybe_instrument(fns, cfg, mesh, comm_mode=comm_mode,
+                             comm_quant=comm_quant,
                              ce_mode=ce_mode, telemetry=telemetry)
 
 

@@ -1264,8 +1264,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
 _DECODE_QROWS = 8      # sublane-pad the single query row to a tileable block
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
-                   l_sc, *, scale: float, block_k: int, num_kv: int):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+                   block_k: int, num_kv: int, quantized: bool = False):
+    """``quantized`` (static): K/V arrive as int8 codes plus
+    per-(position, head) f32 scale refs and are dequantized *inside*
+    the 128-lane context strip — the quantized cache never
+    materializes in anything wider than its strip.  One body for both
+    modes so the scratch discipline cannot diverge."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
     b, j = pl.program_id(0), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -1277,6 +1286,10 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc,
     q = q_ref[0, 0]                          # [QROWS, D]
     k = k_ref[0, :, 0, :]                    # [bk, D]
     v = v_ref[0, :, 0, :]
+    if quantized:
+        q = q.astype(jnp.float32)
+        k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+        v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale      # [QROWS, bk]
@@ -1320,7 +1333,8 @@ def decode_supports(S: int, D: int, *, block_k: int = 512) -> bool:
 
 
 def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
-                     impl: str = "auto", block_k: int = 512):
+                     impl: str = "auto", block_k: int = 512,
+                     k_scale=None, v_scale=None):
     """Single-token decode attention against a padded KV context.
 
     q: [B, H, D] — the current token's (already-rotated) queries;
@@ -1330,6 +1344,12 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     the current token, whose K/V the caller has already written).
     Returns [B, H, D] in q's dtype.
 
+    ``k_scale``/``v_scale`` ([B, S, H] f32, both or neither): the
+    context is block-scaled int8 (``kv_dtype="int8"`` caches) and is
+    dequantized here — inside the kernel's 128-lane context strips on
+    the Pallas path, as a fused ``codes * scale`` element-wise on the
+    XLA path — so the int8 cache is never materialized wide.
+
     ``impl``: "pallas" (strip-mined online-softmax kernel; raises for
     untileable shapes), "xla" (masked einsum formulation, shards and
     runs anywhere), or "auto" (pallas on a TPU backend for lane-aligned
@@ -1338,6 +1358,9 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     """
     B, H, D = q.shape
     S = k.shape[1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale/v_scale must be passed together")
+    quantized = k_scale is not None
     if scale is None:
         scale = D ** -0.5
     lengths = lengths.astype(jnp.int32)
@@ -1350,6 +1373,13 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
         and decode_supports(S, D, block_k=block_k))
     if not use_pallas:
         with jax.named_scope("attn/decode_xla"):
+            if quantized:
+                # masked-einsum fallback: dequantize as one fused
+                # elementwise (XLA folds it into the gather consumers)
+                k = (k.astype(jnp.float32)
+                     * k_scale[..., None]).astype(q.dtype)
+                v = (v.astype(jnp.float32)
+                     * v_scale[..., None]).astype(q.dtype)
             s = jnp.einsum("bhd,bshd->bhs", q, k,
                            preferred_element_type=jnp.float32) * scale
             mask = jnp.arange(S)[None, None, :] < lengths[:, None, None]
@@ -1363,28 +1393,43 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
     bk = min(block_k, S)
     grid = (B, H, S // bk)
     qp = jnp.broadcast_to(q[:, :, None, :], (B, H, _DECODE_QROWS, D))
-    with jax.named_scope("attn/decode_pallas"):
+    qkv_specs = [
+        pl.BlockSpec((1, 1, _DECODE_QROWS, D),
+                     lambda b, h, j, lens: (b, h, 0, 0)),
+        pl.BlockSpec((1, bk, 1, D),
+                     lambda b, h, j, lens: (b, j, h, 0)),
+        pl.BlockSpec((1, bk, 1, D),
+                     lambda b, h, j, lens: (b, j, h, 0)),
+    ]
+    common = dict(
+        out_specs=pl.BlockSpec((1, 1, _DECODE_QROWS, D),
+                               lambda b, h, j, lens: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((_DECODE_QROWS, D), jnp.float32),
+            pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
+            pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
+        ],
+    )
+    scale_in, scale_args = [], []
+    if quantized:
+        # scales travel [B, H, S] so the strip lands on the 128-lane
+        # (trailing) dim — one [bk] vector per (b, h, j) grid cell
+        scale_spec = pl.BlockSpec((1, 1, bk),
+                                  lambda b, h, j, lens: (b, h, j))
+        scale_in = [scale_spec, scale_spec]
+        scale_args = [jnp.swapaxes(k_scale, 1, 2),
+                      jnp.swapaxes(v_scale, 1, 2)]
+    name = "attn/decode_pallas_int8" if quantized else \
+        "attn/decode_pallas"
+    with jax.named_scope(name):
         out = pl.pallas_call(
             functools.partial(_decode_kernel, scale=scale, block_k=bk,
-                              num_kv=grid[2]),
+                              num_kv=grid[2], quantized=quantized),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=grid,
-                in_specs=[
-                    pl.BlockSpec((1, 1, _DECODE_QROWS, D),
-                                 lambda b, h, j, lens: (b, h, 0, 0)),
-                    pl.BlockSpec((1, bk, 1, D),
-                                 lambda b, h, j, lens: (b, j, h, 0)),
-                    pl.BlockSpec((1, bk, 1, D),
-                                 lambda b, h, j, lens: (b, j, h, 0)),
-                ],
-                out_specs=pl.BlockSpec((1, 1, _DECODE_QROWS, D),
-                                       lambda b, h, j, lens: (b, h, 0, 0)),
-                scratch_shapes=[
-                    pltpu.VMEM((_DECODE_QROWS, D), jnp.float32),
-                    pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
-                    pltpu.VMEM((_DECODE_QROWS, 128), jnp.float32),
-                ],
+                in_specs=qkv_specs + scale_in,
+                **common,
             ),
             compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
@@ -1392,7 +1437,7 @@ def decode_attention(q, k, v, lengths, *, scale: Optional[float] = None,
             out_shape=jax.ShapeDtypeStruct((B, H, _DECODE_QROWS, D),
                                            q.dtype),
             interpret=_use_interpret(),
-        )(lengths, qp, k, v)
+        )(lengths, qp, k, v, *scale_args)
         return out[:, :, 0]
 
 

@@ -1,0 +1,154 @@
+"""Block-scaled int8 quantize/dequantize with deterministic and
+stochastic rounding.
+
+The format: a tensor is split into ``block``-element groups along one
+axis; each group stores int8 codes in ``[-127, 127]`` plus one f32
+scale (``amax / 127``).  Dequantization is ``code * scale``.  The
+worst-case per-element error is ``scale / 2`` (deterministic
+round-to-nearest) or ``scale`` (stochastic), i.e. a relative error of
+at most ``1/254`` / ``1/127`` of the block's amax —
+:func:`quant_error_bound` states this for the tests' error budgets.
+
+Rounding modes:
+
+- ``"nearest"`` — ``jnp.round`` (round-half-to-even).  Lowest
+  per-element error; used for **weights** (KV-cache entries, the
+  overlap schedule's gathered weight shards), where the same value is
+  read many times and bias does not accumulate across steps.
+- ``"stochastic"`` — ``floor(y + u)``, ``u ~ U[0, 1)``, so
+  ``E[q] = y`` exactly.  Used for **gradients** (the quantized
+  reduce-scatters): each ring hop requantizes a partial *sum*, and a
+  biased rounding there compounds over ranks and steps while unbiased
+  noise averages out (the EQuARX argument, arXiv:2506.17615).
+
+Two implementations of the same math:
+
+- :func:`quantize_block_ref` — the padded, any-axis, any-size pure-JAX
+  reference;
+- :func:`quantize_block` — dispatches to a lane-aligned fast path
+  (plain reshape, no pad/transpose data movement) when the block axis
+  is the last one and ``block`` divides it — the KV cache (block =
+  head_dim) and the collectives (128-element lane blocks) both hit it —
+  and falls back to the reference otherwise.  Both paths produce
+  bit-identical outputs for aligned shapes (``tests/test_quant.py``).
+
+All-zero blocks store scale 0 and dequantize to exact zeros (the
+quantizer divides by a guarded scale, so no inf/nan either way).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127
+
+
+def _quantize_blocked(xb, mode: str, key) -> jnp.ndarray:
+    """[..., nb, block] f32 -> ([..., nb, block] int8, [..., nb] f32)."""
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = amax / INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = xb / safe[..., None]
+    if mode == "stochastic":
+        if key is None:
+            raise ValueError("mode='stochastic' needs a PRNG key")
+        u = jax.random.uniform(key, xb.shape, jnp.float32)
+        q = jnp.floor(y + u)
+    elif mode == "nearest":
+        q = jnp.round(y)
+    else:
+        raise ValueError(f"unknown rounding mode {mode!r}; "
+                         "expected 'nearest' or 'stochastic'")
+    return (jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8),
+            scale.astype(jnp.float32))
+
+
+def quantize_block_ref(x, *, block: int = 128, axis: int = -1,
+                       mode: str = "nearest",
+                       key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Padded reference: any axis, any size (tail block zero-padded).
+
+    Returns ``(q int8, scales f32)``; ``q`` has ``x``'s shape, the
+    scales have ``axis`` replaced by ``ceil(n / block)``."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    nb = -(-n // block)
+    xm = jnp.moveaxis(x, axis, -1).astype(jnp.float32)
+    pad = nb * block - n
+    if pad:
+        xm = jnp.pad(xm, [(0, 0)] * (xm.ndim - 1) + [(0, pad)])
+    q, scale = _quantize_blocked(
+        xm.reshape(xm.shape[:-1] + (nb, block)), mode, key)
+    q = q.reshape(q.shape[:-2] + (nb * block,))[..., :n]
+    return (jnp.moveaxis(q, -1, axis), jnp.moveaxis(scale, -1, axis))
+
+
+def quantize_block(x, *, block: int = 128, axis: int = -1,
+                   mode: str = "nearest",
+                   key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Block-scaled int8 quantization (see module docstring).
+
+    Fast path (no pad, no transpose) when ``axis`` is the trailing one
+    and ``block`` divides it; the padded reference otherwise."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    if axis != x.ndim - 1 or n % block:
+        return quantize_block_ref(x, block=block, axis=axis, mode=mode,
+                                  key=key)
+    nb = n // block
+    xb = x.astype(jnp.float32).reshape(x.shape[:-1] + (nb, block))
+    q, scale = _quantize_blocked(xb, mode, key)
+    return q.reshape(x.shape), scale
+
+
+def dequantize_block(q, scales, *, block: int = 128, axis: int = -1,
+                     dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_block`: ``code * scale`` in f32, cast
+    to ``dtype``."""
+    axis = axis % q.ndim
+    n = q.shape[axis]
+    qm = jnp.moveaxis(q, axis, -1).astype(jnp.float32)
+    sm = jnp.moveaxis(scales, axis, -1)
+    full = jnp.repeat(sm, block, axis=-1)[..., :n]
+    return jnp.moveaxis((qm * full).astype(dtype), -1, axis)
+
+
+def quant_error_bound(x_amax: float, *, mode: str = "nearest") -> float:
+    """Worst-case per-element absolute error for a block whose amax is
+    ``x_amax`` — the quantity the round-trip tests assert against."""
+    step = x_amax / INT8_MAX
+    return step / 2 if mode == "nearest" else step
+
+
+def wire_bytes(n_elements: int, *, block: int = 128,
+               scale_bytes: int = 4) -> int:
+    """Bytes an int8+per-block-f32-scale payload of ``n_elements``
+    occupies on the wire (or in HBM) — the accounting primitive
+    ``collective_bytes_per_step`` and ``KVCache.bytes`` share."""
+    nb = -(-n_elements // block)
+    return n_elements + nb * scale_bytes
+
+
+def stochastic_key(base: int, *salts) -> jax.Array:
+    """A PRNG key for in-collective stochastic rounding, folded from
+    trace-time salts (rank, hop) and optionally data-dependent ints so
+    the rounding pattern varies across steps, not just across elements.
+
+    Safe inside shard_map/jit: ``base`` is a Python int; each salt may
+    be a traced int32 scalar."""
+    key = jax.random.PRNGKey(base)
+    for s in salts:
+        key = jax.random.fold_in(key, s)
+    return key
+
+
+def data_salt(x) -> jax.Array:
+    """An int32 scalar derived from ``x``'s values (bitcast of the f32
+    sum) — folded into :func:`stochastic_key` so two steps with
+    different payloads round differently even at identical (rank, hop).
+    One cheap reduction; NaN-free inputs assumed (grads are)."""
+    s = jnp.sum(x.astype(jnp.float32))
+    return jax.lax.bitcast_convert_type(s, jnp.int32)

@@ -51,6 +51,7 @@ class InferTelemetry:
         self.decode_count = 0
         self.requests_done = 0
         self.decode_tokens = 0
+        self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
         self._metrics_last = 0.0
@@ -86,6 +87,18 @@ class InferTelemetry:
         if self.enabled:
             self.requests_done += 1
 
+    def record_cache_info(self, *, kv_dtype: str, cache_bytes: int,
+                          kv_bytes_per_slot: int) -> None:
+        """Static KV-cache geometry the engine reports once at
+        construction: the storage dtype and the *true* per-slot
+        footprint (codes + scale arrays for int8 caches) — the figures
+        the ``bench.py --infer`` headline carries."""
+        if self.enabled:
+            self.cache_info = {"kv_dtype": kv_dtype,
+                               "kv_cache_bytes": int(cache_bytes),
+                               "kv_bytes_per_slot":
+                                   int(kv_bytes_per_slot)}
+
     # ---------------------------------------------------------- summary
     def summary(self) -> Dict[str, Any]:
         """The ``telemetry`` block for ``bench.py --infer`` JSON."""
@@ -97,6 +110,7 @@ class InferTelemetry:
             "prefills": self.prefill_count,
             "decode_steps": self.decode_count,
             "decode_tokens": self.decode_tokens,
+            **self.cache_info,
         }
         if self.ttfts:
             out["ttft_s"] = statistics.median(self.ttfts)

@@ -107,6 +107,7 @@ class StepTelemetry:
 
     def __init__(self, cfg=None, mesh=None, *,
                  comm_mode: Optional[str] = None,
+                 comm_quant: Optional[str] = None,
                  ce_mode: Optional[str] = None,
                  label: str = "train",
                  aot: bool = False,
@@ -117,6 +118,7 @@ class StepTelemetry:
         self.cfg = cfg
         self.mesh = mesh
         self.comm_mode = comm_mode
+        self.comm_quant = comm_quant
         self.ce_mode = ce_mode
         self.label = label
         self.records: List[Dict[str, Any]] = []
@@ -314,7 +316,7 @@ class StepTelemetry:
                 self._fpt = -1.0
         return None if self._fpt < 0 else self._fpt
 
-    def collective_bytes(self) -> Optional[Dict[str, int]]:
+    def collective_bytes(self) -> Optional[Dict[str, Any]]:
         if (self.cfg is None or self.mesh is None
                 or self._seq is None):
             return None
@@ -322,7 +324,8 @@ class StepTelemetry:
             from ray_tpu.parallel import overlap as ovl
             return ovl.collective_bytes_per_step(
                 self.cfg, self.mesh, batch=self._batch, seq=self._seq,
-                comm_mode=self.comm_mode or "gspmd")
+                comm_mode=self.comm_mode or "gspmd",
+                quant=self.comm_quant or "none")
         except Exception:  # noqa: BLE001 — non-GPT cfg / odd mesh
             return None
 
@@ -367,6 +370,8 @@ class StepTelemetry:
         out["collective_bytes_per_step"] = self.collective_bytes()
         if self.comm_mode is not None:
             out["comm_mode"] = self.comm_mode
+        if self.comm_quant is not None:
+            out["comm_quant"] = self.comm_quant
         return out
 
     # ------------------------------------------------------ chrome trace --
@@ -506,6 +511,7 @@ class StepTelemetry:
 
 def instrument(fns: Dict[str, Any], cfg=None, mesh=None, *,
                comm_mode: Optional[str] = None,
+               comm_quant: Optional[str] = None,
                ce_mode: Optional[str] = None, label: str = "train",
                aot: bool = False,
                config=None) -> Dict[str, Any]:
@@ -516,8 +522,8 @@ def instrument(fns: Dict[str, Any], cfg=None, mesh=None, *,
     unwrapped jitted step).  No-op (no extra keys) when telemetry is
     disabled."""
     rec = StepTelemetry(cfg, mesh, comm_mode=comm_mode,
-                        ce_mode=ce_mode, label=label, aot=aot,
-                        config=config)
+                        comm_quant=comm_quant, ce_mode=ce_mode,
+                        label=label, aot=aot, config=config)
     if not rec.enabled:
         return fns
     fns["raw_step_fn"] = fns["step_fn"]

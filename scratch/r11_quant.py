@@ -1,0 +1,181 @@
+"""Round-11 on-chip driver: block-scaled int8 A/Bs — KV cache + wire.
+
+Usage: python scratch/r11_quant.py <variant>
+
+Variants:
+  kv8    — int8-KV decode-rate arm: the engine at GPT-2 124M bf16, the
+           bf16 cache vs the int8 cache (RAY_TPU_KV_DTYPE paths) at
+           matched slots, then the int8 cache again at ~2x the slots in
+           the same HBM envelope — decode tokens/s, per-step latency,
+           true kv_bytes_per_slot, and the compile counters proving the
+           doubled state tuple still never recompiles.  Decides the
+           RAY_TPU_KV_DTYPE default.
+  commq  — quantized-wire training arm on the pod mesh: overlap
+           schedule with RAY_TPU_COMM_QUANT none-vs-int8 (EQuARX-style
+           stochastic-rounding grad RS), step time + 30-step loss curve
+           side by side — the wire-byte halving is proven off-chip, the
+           step-time delta and loss drift need real ICI.  Decides the
+           RAY_TPU_COMM_QUANT default.
+  bytes  — the collective_bytes_per_step accounting table at the bench
+           mesh: gspmd / overlap / overlap+int8 rows with per-collective
+           wire dtypes (no chip needed; sanity anchor for the JSONs).
+
+Carried arms (no chip session yet; every r06-r10 row in docs/PERF.md is
+still pending, so the first session runs everything from here): engine /
+decode / slots plus all r6-r9 arms — delegated verbatim to
+scratch/r10_infer.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "kv8"
+
+_R10_ARMS = ("engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R10_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r10_infer.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r11_quant.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("kv8", "commq", "bytes"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if (VARIANT in ("bytes", "commq") and not on_tpu
+        and len(jax.devices()) < 8
+        and not os.environ.get("_R11_HOST_SIM")):
+    # same move as bench.py --mesh: re-exec on a host-simulated 8-CPU
+    # mesh — these numbers exercise the schedule, not the hardware
+    print("re-exec on a host-simulated 8-device CPU mesh",
+          file=sys.stderr)
+    env = dict(os.environ, _R11_HOST_SIM="1", JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"))
+    sys.exit(subprocess.run([sys.executable] + sys.argv,
+                            env=env).returncode)
+
+if VARIANT == "bytes":
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel import overlap as ovl
+    from ray_tpu.parallel.mesh import make_mesh
+
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=True)
+    mesh = make_mesh(devices=jax.devices(), fsdp=4, tp=2)
+    for mode, quant in (("gspmd", "none"), ("overlap", "none"),
+                        ("overlap", "int8")):
+        row = ovl.collective_bytes_per_step(
+            cfg, mesh, batch=32, seq=1024, comm_mode=mode, quant=quant)
+        print(json.dumps({"comm_mode": mode, "quant": quant, **row}),
+              flush=True)
+    sys.exit(0)
+
+if VARIANT == "kv8":
+    from ray_tpu.inference import InferenceEngine, SamplingParams
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    if on_tpu:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        base_slots, requests, max_new = 8, 64, 64
+    else:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        base_slots, requests, max_new = 4, 8, 8
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    prompts = []
+    for i in range(requests):
+        rng, sub = jax.random.split(rng)
+        n = 16 + (37 * i) % (cfg.max_seq // 2)
+        prompts.append(list(jax.random.randint(sub, (n,), 0,
+                                               cfg.vocab_size)))
+
+    # bf16@S slots vs int8@S (the parity/latency arm) vs int8@2S (the
+    # capacity arm: same HBM envelope the bf16 cache needed for S)
+    arms = (("model", base_slots), ("int8", base_slots),
+            ("int8", 2 * base_slots))
+    for kv_dtype, slots in arms:
+        engine = InferenceEngine(cfg, params, slots=slots,
+                                 kv_dtype=kv_dtype, telemetry=True)
+        engine.generate(prompts, max_new_tokens=max_new,
+                        sampling=SamplingParams())
+        tel = engine.telemetry.summary()
+        st = engine.stats()
+        print(json.dumps({
+            "arm": f"{kv_dtype}@{slots}", "kv_dtype": kv_dtype,
+            "slots": slots,
+            "kv_bytes_per_slot": st["kv_bytes_per_slot"],
+            "cache_bytes": st["cache_bytes"],
+            "decode_tokens_per_sec": tel.get("decode_tokens_per_sec"),
+            "decode_step_s": tel.get("decode_step_s"),
+            "ttft_s": tel.get("ttft_s"),
+            "compiles": st["compiles"],
+        }), flush=True)
+    sys.exit(0)
+
+# commq — overlap schedule, int8 wire vs cfg.dtype wire
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel import overlap as ovl  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh, parse_mesh_axes  # noqa: E402
+
+axes = parse_mesh_axes(sys.argv[2]) if len(sys.argv) > 2 else \
+    {"fsdp": 4, "tp": 2}
+mesh = make_mesh(devices=jax.devices(), **axes)
+data_par = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=True)
+    batch, seq, steps = 8 * data_par, 1024, 30
+else:
+    cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    batch, seq, steps = 8, 32, 10
+
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for quant in ("none", "int8"):
+    fns = training.build_gpt_train(cfg, mesh, comm_mode="overlap",
+                                   comm_quant=quant)
+    if fns["comm_mode"] != "overlap":
+        print(f"overlap unsupported on {dict(mesh.shape)}; aborting",
+              file=sys.stderr)
+        sys.exit(1)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(2):
+        state, m = fns["step_fn"](state, bd)
+        losses.append(float(m["loss"]))
+    raw_step = fns.get("raw_step_fn", fns["step_fn"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = raw_step(state, bd)
+        losses.append(float(m["loss"]))
+    dt = (time.perf_counter() - t0) / steps
+    bytes_row = ovl.collective_bytes_per_step(
+        cfg, mesh, batch=batch, seq=seq, comm_mode="overlap",
+        quant=quant)
+    print(json.dumps({
+        "arm": f"commq-{quant}", "quant": quant,
+        "mesh": dict(mesh.shape), "step_ms": round(dt * 1e3, 1),
+        "tokens_per_sec": round(batch * seq / dt),
+        "wire_bytes_per_step": bytes_row["total"],
+        "loss_first": round(losses[0], 4),
+        "loss_last": round(losses[-1], 4),
+        "loss_curve": [round(x, 4) for x in losses],
+    }), flush=True)

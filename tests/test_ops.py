@@ -758,3 +758,60 @@ def test_decode_attention_bf16_and_dispatch():
     out_auto = A.decode_attention(q, k, v, lengths, impl="auto")
     np.testing.assert_allclose(np.asarray(out_auto, np.float32), ref,
                                rtol=0.06, atol=0.06)
+
+
+@pytest.mark.kernel_smoke
+def test_decode_attention_int8_scales_parity():
+    """r11 int8-KV decode: both impls dequantize the block-scaled int8
+    context (one f32 scale per (position, head) lane vector) and agree
+    with the full-precision reference within the quantization budget —
+    per-element K/V error <= amax/254, so logits-path error is O(1%).
+    The Pallas kernel dequantizes inside its 128-lane strips; scale
+    shapes must also survive the narrower-strip fallback (S=640)."""
+    import numpy as np
+
+    from ray_tpu.quant import dequantize_block, quantize_block
+
+    key = jax.random.PRNGKey(6)
+    B, S, H, D = 4, 256, 3, 64
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+    lengths = jnp.array([1, 100, 129, 256], jnp.int32)
+
+    k8, ks = quantize_block(k, block=D)
+    v8, vs = quantize_block(v, block=D)
+    ks, vs = ks[..., 0], vs[..., 0]          # [B, S, H]
+    # reference: exact attention over the *dequantized* context — this
+    # isolates the kernels' dequant plumbing from the quant error
+    kd = dequantize_block(k8, ks[..., None], block=D)
+    vd = dequantize_block(v8, vs[..., None], block=D)
+    ref = _decode_ref(q, kd, vd, lengths)
+
+    out_x = A.decode_attention(q, k8, v8, lengths, impl="xla",
+                               k_scale=ks, v_scale=vs)
+    out_p = A.decode_attention(q, k8, v8, lengths, impl="pallas",
+                               block_k=128, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out_x), ref, rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_p), ref, rtol=2e-5,
+                               atol=2e-5)
+    # and vs the unquantized context: bounded by the int8 budget
+    full = _decode_ref(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(out_p), full, rtol=0.05,
+                               atol=0.05)
+
+    # narrower-strip fallback keeps the scale blocks aligned
+    k6, v6 = (jnp.concatenate([a] * 5, axis=1) for a in (k8, v8))
+    ks6, vs6 = (jnp.concatenate([a] * 5, axis=1) for a in (ks, vs))
+    l6 = jnp.array([500, 640, 3, 640], jnp.int32)
+    ref6 = _decode_ref(q, jnp.concatenate([kd] * 5, axis=1),
+                       jnp.concatenate([vd] * 5, axis=1), l6)
+    out6 = A.decode_attention(q, k6, v6, l6, impl="pallas",
+                              k_scale=ks6, v_scale=vs6)
+    np.testing.assert_allclose(np.asarray(out6), ref6, rtol=2e-5,
+                               atol=2e-5)
+    # scales must come as a pair
+    with pytest.raises(ValueError, match="together"):
+        A.decode_attention(q, k8, v8, lengths, k_scale=ks)

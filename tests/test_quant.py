@@ -1,0 +1,128 @@
+"""Block-scaled int8 quantization: round-trip error bounds per block
+size, fast-path/reference agreement, stochastic-rounding unbiasedness.
+
+The error-budget numbers asserted here are the ones the int8 KV cache
+and quantized-collective parity tests (test_inference.py /
+test_parallel.py) lean on: per-element error <= scale/2 deterministic,
+<= scale stochastic, with scale = block_amax / 127.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.quant import (INT8_MAX, data_salt, dequantize_block,
+                           quantize_block, quantize_block_ref,
+                           quant_error_bound, stochastic_key,
+                           wire_bytes)
+
+
+@pytest.mark.parametrize("block", [16, 64, 128])
+def test_round_trip_error_bound_per_block(block):
+    """|dequant(quant(x)) - x| <= amax/(2*127) per block, both paths."""
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (8, 256)),
+                   np.float32)
+    q, s = quantize_block(jnp.asarray(x), block=block)
+    assert q.dtype == jnp.int8 and s.shape == (8, 256 // block)
+    out = np.asarray(dequantize_block(q, s, block=block))
+    blocks = x.reshape(8, 256 // block, block)
+    bound = np.abs(blocks).max(-1, keepdims=True) / (2 * INT8_MAX)
+    err = np.abs(out.reshape(blocks.shape) - blocks)
+    assert (err <= bound + 1e-7).all()
+    # the stated bound helper agrees
+    assert quant_error_bound(1.0) == pytest.approx(1 / 254)
+    assert quant_error_bound(1.0, mode="stochastic") == \
+        pytest.approx(1 / 127)
+
+
+def test_fast_path_matches_reference():
+    """Aligned trailing-axis shapes take the reshape fast path; it must
+    be bit-identical to the padded reference."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 6, 128))
+    qf, sf = quantize_block(x, block=32)
+    qr, sr = quantize_block_ref(x, block=32)
+    np.testing.assert_array_equal(np.asarray(qf), np.asarray(qr))
+    np.testing.assert_array_equal(np.asarray(sf), np.asarray(sr))
+    # same key -> same stochastic codes too
+    key = jax.random.PRNGKey(7)
+    qf2, _ = quantize_block(x, block=32, mode="stochastic", key=key)
+    qr2, _ = quantize_block_ref(x, block=32, mode="stochastic", key=key)
+    np.testing.assert_array_equal(np.asarray(qf2), np.asarray(qr2))
+
+
+def test_ragged_and_nonlast_axis():
+    """Non-dividing sizes pad (tail block scales from real values
+    only... the pad is zeros, which never raise amax), and a middle
+    axis round-trips through the moveaxis path."""
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (3, 50)),
+                   np.float32)
+    q, s = quantize_block(jnp.asarray(x), block=16)
+    assert s.shape == (3, 4)                      # ceil(50/16)
+    out = np.asarray(dequantize_block(q, s, block=16))
+    assert out.shape == x.shape
+    assert np.abs(out - x).max() <= np.abs(x).max() / (2 * INT8_MAX) + 1e-7
+
+    xm = np.asarray(jax.random.normal(jax.random.PRNGKey(3), (4, 64, 5)),
+                    np.float32)
+    qm, sm = quantize_block(jnp.asarray(xm), block=32, axis=1)
+    assert sm.shape == (4, 2, 5)
+    outm = np.asarray(dequantize_block(qm, sm, block=32, axis=1))
+    bound = np.abs(xm).max() / (2 * INT8_MAX)
+    assert np.abs(outm - xm).max() <= bound + 1e-7
+
+
+def test_zero_blocks_and_extremes():
+    """All-zero blocks store scale 0 and dequantize to exact zeros;
+    +/-amax maps to +/-127 exactly."""
+    x = jnp.zeros((2, 64))
+    q, s = quantize_block(x, block=32)
+    assert not np.asarray(q).any() and not np.asarray(s).any()
+    assert not np.asarray(dequantize_block(q, s, block=32)).any()
+    x2 = jnp.array([[1.0, -1.0] + [0.0] * 30])
+    q2, s2 = quantize_block(x2, block=32)
+    assert np.asarray(q2)[0, 0] == 127 and np.asarray(q2)[0, 1] == -127
+    out2 = np.asarray(dequantize_block(q2, s2, block=32))
+    np.testing.assert_allclose(out2[0, :2], [1.0, -1.0], rtol=1e-6)
+
+
+def test_stochastic_rounding_unbiased():
+    """mean over many keys of dequant(quant_stochastic(x)) -> x: the
+    EQuARX property the quantized reduce-scatter depends on (a biased
+    rounding would drift the grads over ranks and steps)."""
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(4, 64).astype(np.float32))
+
+    def one(key):
+        q, s = quantize_block(x, block=64, mode="stochastic", key=key)
+        return dequantize_block(q, s, block=64)
+
+    keys = jax.random.split(jax.random.PRNGKey(5), 512)
+    mean = np.asarray(jnp.mean(jax.vmap(one)(keys), axis=0))
+    scale = np.abs(np.asarray(x)).max(-1, keepdims=True) / INT8_MAX
+    # CLT: per-element sd <= scale/sqrt(12*512) ~ 0.013*scale; 6 sigma
+    assert np.abs(mean - np.asarray(x)).max() <= 0.08 * scale.max()
+    # and a single draw stays inside the 1-step bound
+    one_err = np.abs(np.asarray(one(keys[0])) - np.asarray(x))
+    assert (one_err <= scale + 1e-7).all()
+
+
+def test_stochastic_requires_key_and_mode_validates():
+    x = jnp.ones((2, 32))
+    with pytest.raises(ValueError, match="PRNG key"):
+        quantize_block(x, block=32, mode="stochastic")
+    with pytest.raises(ValueError, match="rounding mode"):
+        quantize_block(x, block=32, mode="bogus")
+
+
+def test_wire_bytes_and_keys():
+    # 128-elem blocks: 1 byte/elem + 4-byte scale per block
+    assert wire_bytes(256, block=128) == 256 + 8
+    assert wire_bytes(100, block=128) == 100 + 4      # one padded block
+    # keys fold traced salts without tracing errors
+    k1 = stochastic_key(3, jnp.int32(1), jnp.int32(2))
+    k2 = stochastic_key(3, jnp.int32(1), jnp.int32(3))
+    assert (np.asarray(k1) != np.asarray(k2)).any()
+    a = data_salt(jnp.ones((4, 4)))
+    b = data_salt(2 * jnp.ones((4, 4)))
+    assert int(a) != int(b)
