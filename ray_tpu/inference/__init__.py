@@ -15,13 +15,13 @@ from ray_tpu.inference.config import (InferConfig,  # noqa: F401
                                       infer_config, default_buckets)
 from ray_tpu.inference.engine import InferenceEngine  # noqa: F401
 from ray_tpu.inference.kv_cache import (KVCache,  # noqa: F401
-                                        PageAllocator)
+                                        PageAllocator, PrefixIndex)
 from ray_tpu.inference.sampling import SamplingParams  # noqa: F401
-from ray_tpu.inference.scheduler import (Request,  # noqa: F401
-                                         SlotScheduler)
+from ray_tpu.inference.scheduler import (QueueFullError,  # noqa: F401
+                                         Request, SlotScheduler)
 
 __all__ = [
     "InferConfig", "infer_config", "default_buckets",
-    "InferenceEngine", "KVCache", "PageAllocator",
-    "SamplingParams", "Request", "SlotScheduler",
+    "InferenceEngine", "KVCache", "PageAllocator", "PrefixIndex",
+    "SamplingParams", "QueueFullError", "Request", "SlotScheduler",
 ]

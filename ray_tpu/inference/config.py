@@ -48,6 +48,20 @@ class InferConfig:
       logits error (parity-tested against the ``model``-dtype cache).
       Default stays ``model`` until the on-chip A/B
       (``scratch/r11_quant.py``).
+    - ``RAY_TPU_INFER_PREFIX`` (default ``1``): content-addressed
+      prefix caching — full prompt pages register in a host-side
+      chained-hash index and later requests sharing the prefix install
+      the hit pages with refcount bumps, prefilling only the uncached
+      suffix (one cached-context prefill executable per suffix bucket;
+      zero steady-state recompiles still hold).  Pure host-side page-
+      table metadata plus an XLA masked-einsum attention path — exact
+      in model dtype (parity-tested), so it defaults on; ``0`` reverts
+      to full-prompt prefill for every request.
+    - ``RAY_TPU_INFER_MAX_QUEUE`` (default ``0`` = unbounded): cap on
+      the scheduler's waiting queue.  Over-cap submits raise a typed
+      :class:`~ray_tpu.inference.scheduler.QueueFullError` (load
+      shedding) that the serve deployment surfaces as the stream's
+      error instead of queueing unboundedly.
     """
     slots: int = 8
     page_size: int = 128
@@ -55,6 +69,8 @@ class InferConfig:
     buckets: Tuple[int, ...] = ()
     decode_impl: str = "auto"
     kv_dtype: str = "model"
+    prefix: bool = True
+    max_queue: int = 0
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -78,6 +94,11 @@ def infer_config(refresh: bool = False) -> InferConfig:
             print(f"RAY_TPU_KV_DTYPE={kv_dtype!r} unknown; "
                   "using 'model'", file=sys.stderr)
             kv_dtype = "model"
+        max_queue = int(env("RAY_TPU_INFER_MAX_QUEUE", "0"))
+        if max_queue < 0:
+            print(f"RAY_TPU_INFER_MAX_QUEUE={max_queue} negative; "
+                  "using 0 (unbounded)", file=sys.stderr)
+            max_queue = 0
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
@@ -85,6 +106,8 @@ def infer_config(refresh: bool = False) -> InferConfig:
             buckets=buckets,
             decode_impl=impl,
             kv_dtype=kv_dtype,
+            prefix=env("RAY_TPU_INFER_PREFIX", "1") != "0",
+            max_queue=max_queue,
         )
     return _CONFIG
 

@@ -13,6 +13,17 @@ serving killer):
   sequences into those slots (host-side scheduler, Podracer-style
   colocated with the compiled steps) without changing the shape.
 
+**Prefix caching** (``RAY_TPU_INFER_PREFIX``, r12) removes the shared
+part of the prefill itself: full prompt pages register in a host-side
+content-addressed index, admission installs hits into the page-table
+row with refcount bumps, and only the uncached suffix runs through a
+*cached-context prefill* executable — suffix self-attention plus
+attention over the gathered cached pages, one executable per suffix
+bucket with the cached length as a traced scalar, so the compile
+surface does not grow with traffic.  Sharing is host metadata plus one
+more bucketed step; the compiled prefill/decode steps above never
+change shape.
+
 Both step functions are AOT-compiled (``jit(...).lower().compile()``)
 into an explicit compile cache with hit/miss counters — an unexpected
 shape *raises* instead of silently recompiling, and the zero-recompile
@@ -48,6 +59,45 @@ from ray_tpu.inference.config import default_buckets, infer_config
 from ray_tpu.inference.sampling import SamplingParams, sample_tokens
 from ray_tpu.inference.scheduler import Request, SlotScheduler
 from ray_tpu.models import gpt as gpt_mod
+from ray_tpu.ops.attention import _NEG_INF
+
+
+def _cached_context_attention(q, kctx, vctx, ks, vs, cached_len,
+                              scale: Optional[float] = None):
+    """Suffix queries over (cached prefix pages + causal suffix self).
+
+    q/ks/vs: [1, S, H, D] — the suffix's (post-RoPE) queries and its
+    own full-precision keys/values; kctx/vctx: [1, C, H, D] — the
+    slot's gathered page context (only positions < ``cached_len`` are
+    the shared prefix; everything else, including the just-written
+    suffix copy and garbage pages, is masked out).  One softmax over
+    the concatenated [ctx | self] score axis keeps the math identical
+    to attention over the full ``cached + suffix`` sequence.  Masked-
+    einsum XLA path — runs anywhere, shards nowhere special; the
+    Pallas strip-mined variant is the on-chip follow-up.
+    """
+    B, S, H, D = q.shape
+    C = kctx.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, kctx,
+                    preferred_element_type=jnp.float32) * scale
+    ss = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+                    preferred_element_type=jnp.float32) * scale
+    ctx_mask = (jnp.arange(C) < cached_len)[None, None, None, :]
+    causal = (jnp.arange(S)[:, None]
+              >= jnp.arange(S)[None, :])[None, None]
+    s = jnp.concatenate([jnp.where(ctx_mask, sc, _NEG_INF),
+                         jnp.where(causal, ss, _NEG_INF)], axis=-1)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, -1, keepdims=True)                  # [B, H, S, 1]
+    o = (jnp.einsum("bhqk,bkhd->bqhd", p[..., :C].astype(vctx.dtype),
+                    vctx, preferred_element_type=jnp.float32)
+         + jnp.einsum("bhqk,bkhd->bqhd", p[..., C:].astype(vs.dtype),
+                      vs, preferred_element_type=jnp.float32))
+    l = jnp.swapaxes(l, 1, 2)                          # [B, S, H, 1]
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 class InferenceEngine:
@@ -81,6 +131,8 @@ class InferenceEngine:
                  buckets: Optional[Tuple[int, ...]] = None,
                  decode_impl: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
+                 prefix: Optional[bool] = None,
+                 max_queue: Optional[int] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
                  executable_cache: Optional[Dict[Any, Any]] = None):
@@ -94,6 +146,9 @@ class InferenceEngine:
                           else icfg.page_size)
         self.decode_impl = decode_impl or icfg.decode_impl
         self.kv_dtype = kv_dtype or icfg.kv_dtype
+        self.prefix = icfg.prefix if prefix is None else bool(prefix)
+        self.max_queue = (icfg.max_queue if max_queue is None
+                          else max_queue)
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"unknown kv_dtype {self.kv_dtype!r} "
                              "(check RAY_TPU_KV_DTYPE)")
@@ -103,6 +158,10 @@ class InferenceEngine:
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got "
                              f"{self.page_size}")
+        if self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got "
+                             f"{self.max_queue} "
+                             "(check RAY_TPU_INFER_MAX_QUEUE)")
         self.buckets = tuple(sorted(
             b for b in (buckets or icfg.buckets
                         or default_buckets(cfg.max_seq))
@@ -113,7 +172,8 @@ class InferenceEngine:
         self.max_pages_per_slot = max_pages_per_slot
         self.scheduler = SlotScheduler(
             slots=self.slots, page_size=self.page_size,
-            num_pages=num_pages, max_pages_per_slot=max_pages_per_slot)
+            num_pages=num_pages, max_pages_per_slot=max_pages_per_slot,
+            prefix=self.prefix, max_queue=self.max_queue)
         self.cache = kvc.KVCache(
             n_layers=cfg.n_layers, num_pages=num_pages,
             page_size=self.page_size, n_heads=cfg.n_heads,
@@ -128,8 +188,10 @@ class InferenceEngine:
         self._exec_key = (cfg, self.slots, self.page_size, num_pages,
                           max_pages_per_slot, self.decode_impl,
                           self.kv_dtype)
-        self.compile_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
-        self.hit_counts: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self.compile_counts: Dict[str, int] = {
+            "prefill": 0, "prefill_cached": 0, "decode": 0}
+        self.hit_counts: Dict[str, int] = {
+            "prefill": 0, "prefill_cached": 0, "decode": 0}
         self._requests: Dict[int, Request] = {}
         self._next_rid = 0
         self._cancelled: set = set()
@@ -174,6 +236,13 @@ class InferenceEngine:
                           eos_token=eos_token)
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
+            depth = len(self.scheduler.waiting)
+        if self.telemetry.enabled:
+            # gauge moves on enqueue too (outside the lock — metric
+            # I/O must not serialize against step()'s admissions):
+            # under overload there ARE no admissions, so an
+            # admission-only gauge would read 0 through the backlog
+            self.telemetry.record_queue_depth(depth)
         return rid
 
     def cancel(self, rid: int) -> None:
@@ -219,6 +288,8 @@ class InferenceEngine:
             "kv_dtype": self.kv_dtype,
             "kv_bytes_per_slot": self.cache.bytes_per_slot(
                 self.max_pages_per_slot),
+            "max_queue": self.max_queue,
+            "prefix": self.scheduler.prefix_stats(),
         }
 
     # ------------------------------------------------------ engine tick
@@ -260,30 +331,49 @@ class InferenceEngine:
         sched = self.scheduler
         slot = req.slot
         plen = len(req.prompt)
-        bucket = self._bucket_for(plen)
+        cached = req.cached_tokens
+        # the two prefill flavors differ only in executable + scalar
+        # args: cold runs the whole prompt, a prefix hit runs just the
+        # suffix (attending over the already-cached pages — zero
+        # compute for the shared prefix)
+        if cached:
+            fill = req.prompt[cached:]
+            kind, build = "prefill_cached", self._build_prefill_cached
+            scalars = (np.int32(cached), np.int32(len(fill)))
+        else:
+            fill = req.prompt
+            kind, build = "prefill", self._build_prefill
+            scalars = (np.int32(plen),)
+        bucket = self._bucket_for(len(fill))
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :plen] = req.prompt
+        tokens[0, :len(fill)] = fill
         t0 = time.monotonic()
-        with tracing.span("infer/prefill", rid=req.rid, bucket=bucket):
-            fn = self._get_compiled(
-                ("prefill", bucket), self._build_prefill,
-                (self.params, *self.cache.state, tokens,
-                 np.int32(plen), sched.page_table[slot]),
-                kind="prefill")
-            logits, *state = fn(
-                self.params, *self.cache.state, tokens,
-                np.int32(plen), sched.page_table[slot])
+        with tracing.span(f"infer/{kind}", rid=req.rid, bucket=bucket,
+                          cached=cached):
+            args = (self.params, *self.cache.state, tokens, *scalars,
+                    sched.page_table[slot])
+            fn = self._get_compiled((kind, bucket), build, args,
+                                    kind=kind)
+            logits, *state = fn(*args)
             self.cache.state = tuple(state)
             tok = self._sample_slots(logits, [req])[0]
+        # the prompt's K/V are now fully in cache: its full pages are
+        # immutable from here on and safe to hand to other requests
+        sched.register_prefix(req)
         if self.debug_logits:
             self.logits_trace.setdefault(req.rid, []).append(
                 np.asarray(logits[0]))
         sched.lengths[slot] = plen
         now = time.monotonic()
         if self.telemetry.enabled:
+            self.telemetry.record_queue(
+                req.admitted_ts - req.submitted_ts,
+                depth=len(sched.waiting))
             self.telemetry.record_prefill(now - t0, prompt_tokens=plen,
-                                          bucket=bucket)
-            self.telemetry.record_ttft(now - req.submitted_ts)
+                                          bucket=bucket,
+                                          cached_tokens=cached)
+            self.telemetry.record_ttft(now - req.submitted_ts,
+                                       prefix_hit=cached > 0)
         self._deliver(req, int(tok), events)
 
     # ----------------------------------------------------------- decode
@@ -487,6 +577,95 @@ class InferenceEngine:
             return flash_attention(q, k, v, causal=True)
         from ray_tpu.parallel.ring_attention import local_attention
         return local_attention(q, k, v, causal=True)
+
+    def _build_prefill_cached(self):
+        """Suffix-only prefill over a prefix-cached context.
+
+        The prompt's first ``cached_len`` tokens are already in the
+        slot's pages (prefix-index hits: written by an earlier request
+        with an identical prefix — byte-identical content, and for
+        int8 caches bit-identical codes because cache writes round
+        deterministically).  Only the suffix runs through the model:
+        its queries attend over the gathered cached pages (length-
+        masked) *plus* causally over the suffix itself, merged in one
+        softmax — the masked-einsum XLA formulation (a Pallas variant
+        is an on-chip follow-up; see docs/PERF.md r12).
+
+        ``cached_len``/``suffix_len`` are traced scalars, so one
+        executable per *suffix bucket* serves every cached length —
+        the zero-steady-state-recompile counters still hold.
+        """
+        cfg = self.cfg
+        page_size = self.page_size
+        quantized = self.kv_dtype == "int8"
+
+        def prefill_cached(params, *args):
+            """(params, *cache_state, tokens [1, S_bucket] (suffix,
+            padded), cached_len scalar (prefix tokens already in
+            cache), suffix_len scalar (valid suffix), page_row
+            [max_pages]) -> (last-suffix-token logits [1, V] f32,
+            *cache_state)."""
+            *cache_state, tokens, cached_len, suffix_len, page_row = args
+            S = tokens.shape[1]
+            positions = cached_len + jnp.arange(S)   # absolute
+
+            def attn_hook(q, k, v, cache):
+                row = page_row[None]                 # [1, max_pages]
+                if quantized:
+                    ck, cv, cks, cvs = cache
+                    kq, ks_ = self._quantize_kv(k[0])
+                    vq, vs_ = self._quantize_kv(v[0])
+                    ck = kvc.write_prefill_at(ck, kq, page_row,
+                                              cached_len, suffix_len,
+                                              page_size)
+                    cv = kvc.write_prefill_at(cv, vq, page_row,
+                                              cached_len, suffix_len,
+                                              page_size)
+                    cks = kvc.write_prefill_at(cks, ks_, page_row,
+                                               cached_len, suffix_len,
+                                               page_size)
+                    cvs = kvc.write_prefill_at(cvs, vs_, page_row,
+                                               cached_len, suffix_len,
+                                               page_size)
+                    new_cache = (ck, cv, cks, cvs)
+                    kctx = kvc.gather_pages(ck, row)
+                    vctx = kvc.gather_pages(cv, row)
+                    ksc = kvc.gather_pages(cks, row)
+                    vsc = kvc.gather_pages(cvs, row)
+                    kctx = (kctx.astype(jnp.float32)
+                            * ksc[..., None]).astype(q.dtype)
+                    vctx = (vctx.astype(jnp.float32)
+                            * vsc[..., None]).astype(q.dtype)
+                else:
+                    ck, cv = cache
+                    ck = kvc.write_prefill_at(ck, k[0], page_row,
+                                              cached_len, suffix_len,
+                                              page_size)
+                    cv = kvc.write_prefill_at(cv, v[0], page_row,
+                                              cached_len, suffix_len,
+                                              page_size)
+                    new_cache = (ck, cv)
+                    kctx = kvc.gather_pages(ck, row)
+                    vctx = kvc.gather_pages(cv, row)
+                # suffix self-attention reads the full-precision k/v
+                # (like the cold prefill); only the cached prefix is
+                # read back through the (possibly quantized) cache
+                o = _cached_context_attention(q, kctx, vctx, k, v,
+                                              cached_len)
+                return o, new_cache
+
+            x = self._embed(params, tokens, positions)
+            x, cache_state = self._layer_scan(params, x,
+                                              tuple(cache_state),
+                                              positions, attn_hook)
+            h = jnp.take(x[0], suffix_len - 1, axis=0)[None, None]
+            logits = jnp.einsum("bsd,dv->bsv", h,
+                                gpt_mod.lm_head(params, cfg))
+            return (logits[:, 0].astype(jnp.float32),) + cache_state
+
+        n_state = len(self.cache.state)
+        return jax.jit(prefill_cached,
+                       donate_argnums=tuple(range(1, 1 + n_state)))
 
     def _build_decode(self):
         cfg = self.cfg

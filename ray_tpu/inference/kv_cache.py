@@ -19,7 +19,20 @@ masked by per-slot lengths in ``decode_attention``.
 Device-side update/gather helpers are plain functional jnp ops (scatter
 via ``.at[]``, gather via advanced indexing) so they trace into the
 engine's compiled steps; the host-side :class:`PageAllocator` owns the
-free list and the leak invariants (``tests/test_inference.py``).
+refcounts, free structures and the leak invariants
+(``tests/test_inference.py``).
+
+**Prefix sharing (r12).**  Full pages are immutable — decode appends
+only ever land in the private tail page past the prompt — so a full
+prompt page can be *shared* across requests byte-for-byte.
+:class:`PrefixIndex` registers full pages under chained content hashes
+and :class:`PageAllocator` refcounts every reference; refcount-0
+registered pages park in an LRU idle pool that ``alloc`` evicts from
+only after the free list runs dry, so the idle cache is reusable
+prefix storage rather than dead HBM.  Sharing is pure host-side page-
+table metadata: the compiled steps never see it, and ``int8`` caches
+share bit-identically because cache writes use deterministic
+rounding.
 
 ``kv_dtype="int8"`` stores the K/V arrays block-scale-quantized
 (``ray_tpu.quant``): codes in int8, one f32 scale per (page, position,
@@ -36,41 +49,185 @@ asserted, not assumed.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import collections
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax.numpy as jnp
 
 GARBAGE_PAGE = 0
 
 
-class PageAllocator:
-    """Host-side free list over the page pool (page 0 never handed out)."""
+class PrefixIndex:
+    """Content-addressed index over *full, immutable* KV pages.
 
-    def __init__(self, num_pages: int):
+    A page is registered under its chained hash
+    ``h = H(parent_h, page_tokens)`` — the hash covers the page's own
+    tokens *and* (through the parent link) every token before it, so a
+    hash hit means the whole prefix up to and including this page is
+    byte-identical.  Admission walks a prompt's full pages through
+    :meth:`lookup` front-to-back and stops at the first miss; every hit
+    is installed into the slot's page-table row with a refcount bump
+    and zero prefill compute.
+
+    Pure host metadata: hash -> page and page -> hash maps.  Lifecycle
+    (refcounts, the idle-LRU pool, eviction) lives in
+    :class:`PageAllocator`, which calls :meth:`forget` when it evicts a
+    registered page to reuse its storage.
+    """
+
+    ROOT = b""
+
+    def __init__(self):
+        self._by_hash: Dict[bytes, int] = {}
+        self._by_page: Dict[int, bytes] = {}
+
+    @staticmethod
+    def chain(parent: bytes, tokens: Sequence[int]) -> bytes:
+        """``H(parent_h, page_tokens)`` — 128-bit blake2b keeps token-
+        collision risk negligible while the digest stays dict-cheap."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        return h.digest()
+
+    def lookup(self, chain_hash: bytes) -> Optional[int]:
+        return self._by_hash.get(chain_hash)
+
+    def register(self, chain_hash: bytes, page: int) -> bool:
+        """Map ``chain_hash -> page``; refuses (returns False) if either
+        side is already registered — first registration wins, so two
+        copies of the same content never alias in the index."""
+        if chain_hash in self._by_hash or page in self._by_page:
+            return False
+        self._by_hash[chain_hash] = page
+        self._by_page[page] = chain_hash
+        return True
+
+    def has(self, page: int) -> bool:
+        return page in self._by_page
+
+    def forget(self, page: int) -> None:
+        h = self._by_page.pop(page, None)
+        if h is not None:
+            del self._by_hash[h]
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+
+class PageAllocator:
+    """Refcounted acquire/release allocator over the page pool (page 0
+    never handed out).
+
+    Every allocated page carries a refcount: :meth:`alloc` hands out
+    pages at refcount 1, a prefix hit :meth:`acquire`\\ s an extra
+    reference, and :meth:`release` drops one — storage only becomes
+    reusable at refcount 0.  A refcount-0 page *registered in the
+    prefix index* is not freed: it parks in an LRU idle pool, its KV
+    content intact, so the whole idle cache doubles as prefix storage.
+    ``alloc`` takes truly-free pages first and only then evicts idle
+    pages oldest-first (unregistering them via ``index.forget``), so
+    allocation never fails while idle capacity remains.
+
+    Free/double-free checks are O(1): the free list keeps a companion
+    set, and refcounts live in a dict — a retire burst of R requests
+    costs O(pages), not the O(R * pages^2) the old ``p in list`` scan
+    paid.
+    """
+
+    def __init__(self, num_pages: int,
+                 index: Optional[PrefixIndex] = None):
         if num_pages < 2:
             raise ValueError(f"need >= 2 pages (1 garbage + 1 usable), "
                              f"got {num_pages}")
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._refcount: Dict[int, int] = {}
+        # refcount-0 registered pages, insertion order = LRU -> MRU
+        self._idle: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._index = index
+        self.evictions = 0
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        """Pages available to ``alloc``: truly free + evictable idle."""
+        return len(self._free) + len(self._idle)
+
+    @property
+    def idle_count(self) -> int:
+        return len(self._idle)
+
+    def refcount(self, page: int) -> int:
+        return self._refcount.get(page, 0)
+
+    def is_idle(self, page: int) -> bool:
+        """Registered at refcount 0 (parked in the LRU pool)."""
+        return page in self._idle
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` pages, or None (caller keeps the request waiting)."""
-        if n > len(self._free):
+        """``n`` pages at refcount 1, or None (caller keeps the request
+        waiting).  Prefers the free list; evicts idle prefix pages
+        LRU-first only once it runs dry."""
+        if n > self.free_count:
             return None
-        pages = [self._free.pop() for _ in range(n)]
+        pages = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+                self._free_set.discard(p)
+            else:
+                p, _ = self._idle.popitem(last=False)   # oldest idle
+                self.evictions += 1
+                if self._index is not None:
+                    self._index.forget(p)
+            self._refcount[p] = 1
+            pages.append(p)
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def acquire(self, page: int) -> None:
+        """Take one more reference on a live or idle page (prefix hit).
+
+        An idle page revives — leaves the LRU pool with its content
+        still valid — which is exactly why admission acquires its hits
+        *before* allocating fresh pages: the fresh allocation's own
+        eviction must not grab a page we are about to share."""
+        if page == GARBAGE_PAGE:
+            raise ValueError("acquiring the reserved garbage page")
+        if page in self._idle:
+            del self._idle[page]
+            self._refcount[page] = 1
+            return
+        if page not in self._refcount:
+            raise ValueError(f"acquiring unallocated page {page}")
+        self._refcount[page] += 1
+
+    def release(self, pages: List[int]) -> None:
+        """Drop one reference per page.  At refcount 0 a registered
+        page parks in the idle pool (MRU end); an unregistered one
+        returns to the free list."""
         for p in pages:
             if p == GARBAGE_PAGE:
                 raise ValueError("freeing the reserved garbage page")
-            if p in self._free:
+            rc = self._refcount.get(p)
+            if rc is None:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            if rc > 1:
+                self._refcount[p] = rc - 1
+                continue
+            del self._refcount[p]
+            if self._index is not None and self._index.has(p):
+                self._idle[p] = None
+            else:
+                self._free.append(p)
+                self._free_set.add(p)
+
+    # r10-compatible spelling; refcounted release is the real semantics
+    free = release
 
 
 class KVCache:
@@ -144,15 +301,40 @@ class KVCache:
 
 
 def write_prefill(pages, new, page_row, page_size: int):
-    """Scatter a prompt's K (or V) into one slot's pages.
+    """Scatter a prompt's K (or V) into one slot's pages — the cold
+    (start-0, whole-bucket) case of :func:`write_prefill_at`.
 
     pages: [P, page_size, H, D] (one layer); new: [S, H, D] (bucket-
-    padded — tail positions land in whatever ``page_row`` maps them to,
-    the garbage page for unreserved tail entries); page_row: [max_pages]
-    int32.  Returns the updated pages array."""
+    padded — with ``valid_len = S`` tail positions land in whatever
+    ``page_row`` maps them to, the garbage page for unreserved tail
+    entries); page_row: [max_pages] int32.  Returns the updated pages
+    array."""
+    return write_prefill_at(pages, new, page_row, 0, new.shape[0],
+                            page_size)
+
+
+def write_prefill_at(pages, new, page_row, start, valid_len,
+                     page_size: int):
+    """Scatter a *suffix*'s K (or V) at absolute positions
+    ``start .. start+S`` of one slot's pages (the cached-context
+    prefill: positions below ``start`` are prefix-cache hits that must
+    not be touched).
+
+    pages: [P, page_size, *rest] (one layer); new: [S, *rest] (bucket-
+    padded suffix); page_row: [max_pages] int32; start/valid_len:
+    traced scalars.  Rows past ``valid_len`` route to the garbage page
+    *explicitly* — a suffix bucket can overhang the slot's reserved
+    pages (start + bucket > max_pages * page_size), where the cold
+    prefill's garbage-padded ``page_row`` tail no longer covers them.
+    Returns the updated pages array."""
     S = new.shape[0]
-    pos = jnp.arange(S)
-    return pages.at[page_row[pos // page_size], pos % page_size].set(new)
+    idx = jnp.arange(S)
+    pos = start + idx
+    page = jnp.where(
+        idx < valid_len,
+        page_row[jnp.clip(pos // page_size, 0, page_row.shape[0] - 1)],
+        GARBAGE_PAGE)
+    return pages.at[page, pos % page_size].set(new)
 
 
 def write_decode(pages, new, page_table, lengths, page_size: int):

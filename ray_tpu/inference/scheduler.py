@@ -9,15 +9,34 @@ functions).  Requests move ``waiting -> active(slot) -> finished``:
   reservation-at-admission means a running sequence can never run out
   of cache mid-decode, so there is no preemption path to get wrong.
   Admission blocks (request stays queued) until both a slot and the
-  pages are free.
-- **retire** (EOS / max-new-tokens): pages return to the free list, the
-  page-table row resets to the garbage page, the slot frees.
+  pages are free.  With prefix caching on, admission first walks the
+  prompt's full pages through the :class:`~.kv_cache.PrefixIndex`:
+  hits are installed into the page-table row with refcount bumps and
+  **zero prefill compute**; only the pages past the last hit are
+  freshly allocated, and the engine prefills only the uncached suffix.
+- **retire** (EOS / max-new-tokens): the request's page references are
+  released — shared pages survive under their other owners' refcounts,
+  registered refcount-0 pages park in the allocator's idle pool, the
+  rest return to the free list; the page-table row resets to the
+  garbage page and the slot frees.
+
+Decode writes only ever land in pages the slot *exclusively* owns (the
+private tail past the prompt), so copy-on-write reduces to a
+never-write-shared invariant: a hit page is always a full prompt page
+strictly before the final prompt token, and the suffix prefill's first
+write position is ``cached_tokens`` — on a page boundary past every
+shared page.
+
+**Load shedding**: ``max_queue`` (``RAY_TPU_INFER_MAX_QUEUE``) caps the
+waiting queue; over-cap submits raise :class:`QueueFullError` — a typed
+rejection the serve deployment surfaces as the stream's error — instead
+of queueing unboundedly.
 
 The page table and per-slot lengths live here as numpy arrays and are
 passed into the fixed-shape compiled steps each call; the engine owns
-the device-side cache arrays.  Invariants (no slot/page leaks across
-any admit/retire interleaving) are fuzzed in
-``tests/test_inference.py``.
+the device-side cache arrays.  Invariants (no slot/page leaks, no page
+freed while referenced, across any admit/hit/retire/evict interleaving)
+are fuzzed in ``tests/test_inference.py``.
 """
 
 from __future__ import annotations
@@ -25,13 +44,19 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
 from ray_tpu.inference.kv_cache import (GARBAGE_PAGE, PageAllocator,
-                                        pages_needed)
+                                        PrefixIndex, pages_needed)
 from ray_tpu.inference.sampling import SamplingParams
+
+
+class QueueFullError(RuntimeError):
+    """Typed admission rejection: the waiting queue is at
+    ``RAY_TPU_INFER_MAX_QUEUE`` — shed load (retry later / another
+    replica) instead of queueing unboundedly."""
 
 
 @dataclasses.dataclass
@@ -46,22 +71,39 @@ class Request:
     slot: Optional[int] = None
     pages: Optional[List[int]] = None
     submitted_ts: float = dataclasses.field(default_factory=time.monotonic)
+    admitted_ts: Optional[float] = None
     done: bool = False
+    # prefix-cache state: chained hashes of the prompt's full pages
+    # (None until the first admission attempt computes them — they are
+    # immutable per request, so retries reuse them), how many were
+    # index hits, and the token count the hits cover (skipped prefill)
+    chain_hashes: Optional[List[bytes]] = None
+    n_hit_pages: int = 0
+    cached_tokens: int = 0
 
 
 class SlotScheduler:
     def __init__(self, *, slots: int, page_size: int, num_pages: int,
-                 max_pages_per_slot: int):
+                 max_pages_per_slot: int, prefix: bool = False,
+                 max_queue: int = 0):
         self.slots = slots
         self.page_size = page_size
         self.max_pages_per_slot = max_pages_per_slot
-        self.allocator = PageAllocator(num_pages)
+        self.prefix_index = PrefixIndex() if prefix else None
+        self.allocator = PageAllocator(num_pages,
+                                       index=self.prefix_index)
+        self.max_queue = max_queue
         self.page_table = np.full((slots, max_pages_per_slot),
                                   GARBAGE_PAGE, np.int32)
         self.lengths = np.zeros((slots,), np.int32)   # tokens in cache
         self.free_slots: List[int] = list(range(slots - 1, -1, -1))
         self.active: Dict[int, Request] = {}          # slot -> request
         self.waiting: Deque[Request] = collections.deque()
+        # prefix-hit accounting (tokens = pages * page_size: the
+        # prefill compute the hits skipped)
+        self.prefix_hit_pages = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_requests_hit = 0
 
     # ------------------------------------------------------------ admit
     def submit(self, req: Request) -> None:
@@ -80,7 +122,48 @@ class SlotScheduler:
                 f"request {req.rid}: needs {need} pages but the pool "
                 f"only has {self.allocator.num_pages - 1} "
                 f"(raise RAY_TPU_INFER_PAGES or shrink the request)")
+        if self.max_queue and len(self.waiting) >= self.max_queue:
+            raise QueueFullError(
+                f"request {req.rid}: waiting queue at its cap of "
+                f"{self.max_queue} (RAY_TPU_INFER_MAX_QUEUE) — "
+                "shedding load instead of queueing unboundedly")
         self.waiting.append(req)
+
+    def _prefix_walk(self, req: Request) -> List[int]:
+        """Walk the prompt's full pages through the index and return
+        the hit pages — a prefix of the full pages, stopped at the
+        first miss.  The chained hashes are immutable per request, so
+        the first attempt computes and caches them on the request and
+        pool-pressure retries only re-do the (cheap) lookups — which
+        *must* re-run: pages registered since the last attempt can
+        turn misses into hits.
+
+        Registrable pages are those fully covered by the prompt
+        (boundary <= prompt length: decode writes start at position
+        ``plen``, so they are immutable).  *Hit-eligible* pages stop
+        one token earlier — the page holding the final prompt token is
+        never taken as a hit even when full, because that token's
+        logits seed the first sampled token, so at least one suffix
+        token must always prefill."""
+        if self.prefix_index is None:
+            req.chain_hashes = []
+            return []
+        if req.chain_hashes is None:
+            ps = self.page_size
+            h = PrefixIndex.ROOT
+            req.chain_hashes = []
+            for i in range(len(req.prompt) // ps):
+                h = PrefixIndex.chain(h,
+                                      req.prompt[i * ps:(i + 1) * ps])
+                req.chain_hashes.append(h)
+        hits: List[int] = []
+        eligible = (len(req.prompt) - 1) // self.page_size
+        for h_i in req.chain_hashes[:eligible]:
+            page = self.prefix_index.lookup(h_i)
+            if page is None:
+                break
+            hits.append(page)
+        return hits
 
     def try_admit(self) -> Optional[Request]:
         """Move the queue head into a free slot, or None (FIFO: a large
@@ -91,22 +174,53 @@ class SlotScheduler:
         req = self.waiting[0]
         need = pages_needed(len(req.prompt) + req.max_new_tokens,
                             self.page_size)
-        pages = self.allocator.alloc(need)
-        if pages is None:
+        hits = self._prefix_walk(req)
+        # exact feasibility check before touching any state: acquiring
+        # the hits removes the idle ones from the allocatable pool, so
+        # the fresh allocation needs that much headroom beyond them —
+        # failing here keeps a blocked head from churning refcounts
+        # and idle-LRU order on every tick
+        idle_hits = sum(1 for p in hits if self.allocator.is_idle(p))
+        if need - len(hits) > self.allocator.free_count - idle_hits:
             return None
+        # acquire hits BEFORE allocating fresh pages: an idle hit must
+        # not be evicted by our own allocation's LRU sweep
+        for p in hits:
+            self.allocator.acquire(p)
+        fresh = self.allocator.alloc(need - len(hits))
+        assert fresh is not None        # guaranteed by the check above
         self.waiting.popleft()
         slot = self.free_slots.pop()
+        pages = hits + fresh
         req.slot, req.pages = slot, pages
+        req.n_hit_pages = len(hits)
+        req.cached_tokens = len(hits) * self.page_size
+        req.admitted_ts = time.monotonic()
         self.page_table[slot, :] = GARBAGE_PAGE
         self.page_table[slot, :len(pages)] = pages
         self.lengths[slot] = 0
         self.active[slot] = req
+        if hits:
+            self.prefix_hit_pages += len(hits)
+            self.prefix_hit_tokens += req.cached_tokens
+            self.prefix_requests_hit += 1
         return req
+
+    def register_prefix(self, req: Request) -> None:
+        """Register the request's freshly-prefilled full prompt pages
+        in the index (the engine calls this *after* the prefill
+        executable has written their K/V — content must be in cache
+        before a hash can hand the page to another request)."""
+        if self.prefix_index is None:
+            return
+        for i in range(req.n_hit_pages, len(req.chain_hashes)):
+            self.prefix_index.register(req.chain_hashes[i],
+                                       req.pages[i])
 
     # ----------------------------------------------------------- retire
     def retire(self, slot: int) -> Request:
         req = self.active.pop(slot)
-        self.allocator.free(req.pages)
+        self.allocator.release(req.pages)
         req.pages = None
         req.slot = None
         req.done = True
@@ -119,3 +233,16 @@ class SlotScheduler:
     @property
     def has_work(self) -> bool:
         return bool(self.waiting or self.active)
+
+    def prefix_stats(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.prefix_index is not None,
+            "hit_pages": self.prefix_hit_pages,
+            "hit_tokens": self.prefix_hit_tokens,
+            "requests_hit": self.prefix_requests_hit,
+            "registered_pages": (len(self.prefix_index)
+                                 if self.prefix_index is not None
+                                 else 0),
+            "idle_pages": self.allocator.idle_count,
+            "evictions": self.allocator.evictions,
+        }

@@ -74,6 +74,14 @@ class GPTDeployment:
     Request payload (one dict): ``{"tokens": [...], "max_new_tokens":
     int, "temperature": float, "top_k": int, "top_p": float, "seed":
     int, "eos_token": int | None}`` — yields generated token ids.
+
+    **Load shedding**: with ``RAY_TPU_INFER_MAX_QUEUE`` set, an
+    over-cap submit raises
+    :class:`~ray_tpu.inference.scheduler.QueueFullError` from the
+    request's async generator — the streaming path delivers it as the
+    stream's error at first iteration, so the client sees an
+    immediate typed rejection (retry / another replica) instead of a
+    request parked in an unbounded queue.
     """
 
     def __init__(self, model: str = "tiny",

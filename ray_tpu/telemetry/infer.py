@@ -11,8 +11,9 @@ Sinks mirror r09:
   exporter already merges into the unified host timeline;
 - Prometheus series through the control-plane metrics when a ray_tpu
   session is up (``infer_ttft_seconds`` / ``infer_decode_step_seconds``
-  histograms, ``infer_decode_tokens_per_sec`` gauge), throttled and
-  dead-on-first-failure exactly like the train recorder;
+  / ``infer_queue_wait_seconds`` histograms,
+  ``infer_decode_tokens_per_sec`` / ``infer_queue_depth`` gauges),
+  throttled and dead-on-first-failure exactly like the train recorder;
 - :meth:`summary` is the ``telemetry`` block of ``bench.py --infer``
   and ``ray_perf`` JSON.
 
@@ -47,23 +48,35 @@ class InferTelemetry:
         self.prefills: List[Dict[str, Any]] = []
         self.decodes: List[Dict[str, Any]] = []
         self.ttfts: List[float] = []
+        # TTFT split by prefix-cache outcome: a hit request's first
+        # token only pays the suffix prefill, so the two populations
+        # have different distributions worth reporting separately
+        self.ttfts_hit: List[float] = []
+        self.ttfts_miss: List[float] = []
+        self.queue_waits: List[float] = []
         self.prefill_count = 0
         self.decode_count = 0
         self.requests_done = 0
         self.decode_tokens = 0
+        self.prompt_tokens = 0
+        self.prefix_hit_tokens = 0
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
         self._metrics_last = 0.0
+        self._queue_last = 0.0
 
     # ---------------------------------------------------------- records
     def record_prefill(self, wall_s: float, *, prompt_tokens: int,
-                       bucket: int) -> None:
+                       bucket: int, cached_tokens: int = 0) -> None:
         if not self.enabled:
             return
         self.prefill_count += 1
+        self.prompt_tokens += prompt_tokens
+        self.prefix_hit_tokens += cached_tokens
         self.prefills.append({"wall_s": wall_s,
                               "prompt_tokens": prompt_tokens,
+                              "cached_tokens": cached_tokens,
                               "bucket": bucket})
         del self.prefills[:-self._MAX_RECORDS]
 
@@ -76,12 +89,41 @@ class InferTelemetry:
         del self.decodes[:-self._MAX_RECORDS]
         self._emit_decode(wall_s, active)
 
-    def record_ttft(self, ttft_s: float) -> None:
+    def record_ttft(self, ttft_s: float, *,
+                    prefix_hit: bool = False) -> None:
         if not self.enabled:
             return
         self.ttfts.append(ttft_s)
         del self.ttfts[:-self._MAX_RECORDS]
+        split = self.ttfts_hit if prefix_hit else self.ttfts_miss
+        split.append(ttft_s)
+        del split[:-self._MAX_RECORDS]
         self._emit_ttft(ttft_s)
+
+    def record_queue(self, wait_s: float, *, depth: int) -> None:
+        """Admission-time record: how long the request waited in the
+        queue and how deep the queue stands behind it (the load-
+        shedding signals: ``RAY_TPU_INFER_MAX_QUEUE`` caps the depth,
+        these series say how close traffic runs to the cap)."""
+        if not self.enabled:
+            return
+        self.queue_waits.append(wait_s)
+        del self.queue_waits[:-self._MAX_RECORDS]
+        self._emit_queue(wait_s, depth)
+
+    def record_queue_depth(self, depth: int) -> None:
+        """Submit-time gauge update: admissions stall exactly when the
+        queue is backing up, so the depth gauge must also move on
+        enqueue or it reads 0 through the whole overload.  Throttled
+        like the decode emitter — high-QPS submits must not pay a
+        metric emission each."""
+        if not self.enabled or self._metrics_dead:
+            return
+        now = time.monotonic()
+        if now - self._queue_last < self._EMIT_INTERVAL_S:
+            return
+        self._queue_last = now
+        self._emit_queue(None, depth)
 
     def record_request_done(self) -> None:
         if self.enabled:
@@ -112,9 +154,23 @@ class InferTelemetry:
             "decode_tokens": self.decode_tokens,
             **self.cache_info,
         }
+        out["prompt_tokens"] = self.prompt_tokens
+        out["prefill_tokens_skipped"] = self.prefix_hit_tokens
+        if self.prompt_tokens:
+            out["prefix_hit_rate"] = (self.prefix_hit_tokens
+                                      / self.prompt_tokens)
         if self.ttfts:
             out["ttft_s"] = statistics.median(self.ttfts)
+            out["ttft_mean_s"] = statistics.fmean(self.ttfts)
             out["ttft_max_s"] = max(self.ttfts)
+        if self.ttfts_hit:
+            out["ttft_prefix_hit_s"] = statistics.median(self.ttfts_hit)
+        if self.ttfts_miss:
+            out["ttft_prefix_miss_s"] = statistics.median(
+                self.ttfts_miss)
+        if self.queue_waits:
+            out["queue_wait_s"] = statistics.median(self.queue_waits)
+            out["queue_wait_max_s"] = max(self.queue_waits)
         if self.prefills:
             out["prefill_s"] = statistics.median(
                 r["wall_s"] for r in self.prefills)
@@ -149,6 +205,14 @@ class InferTelemetry:
                     boundaries=_STEP_BOUNDARIES, tag_keys=tags),
                 "tok": Gauge("infer_decode_tokens_per_sec",
                              "decode throughput", tag_keys=tags),
+                "queue_wait": Histogram(
+                    "infer_queue_wait_seconds",
+                    "time from request submit to slot admission",
+                    boundaries=_TTFT_BOUNDARIES, tag_keys=tags),
+                "queue_depth": Gauge(
+                    "infer_queue_depth",
+                    "requests waiting for a decode slot",
+                    tag_keys=tags),
             }
         return self._metrics
 
@@ -160,6 +224,19 @@ class InferTelemetry:
             if metrics is not None:
                 metrics["ttft"].observe(ttft_s,
                                         tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_queue(self, wait_s, depth: int):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                tags = {"label": self.label}
+                if wait_s is not None:
+                    metrics["queue_wait"].observe(wait_s, tags=tags)
+                metrics["queue_depth"].set(depth, tags=tags)
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

@@ -1,0 +1,171 @@
+"""Round-12 on-chip driver: prefix-cached serving A/B.
+
+Usage: python scratch/r12_prefix.py <variant>
+
+Variants:
+  prefix — the shared-system-prompt open-loop trace (bench.py --infer's
+           shape) at GPT-2 124M bf16, RAY_TPU_INFER_PREFIX on vs off:
+           prefill tokens computed, mean/median TTFT, decode tokens/s,
+           and the compile counters proving zero steady-state
+           recompiles in both arms.  Decides nothing (the knob is
+           already default-on — the XLA cached-context prefill is
+           parity-exact in model dtype); the open question for the
+           chip is how much of the masked-einsum cached-context
+           attention's win a Pallas strip variant would add on top.
+  evict  — cache-pressure arm: a page pool sized ~1.5x one slot's
+           context plus heavy shared-prefix traffic, so idle prefix
+           pages are continuously evicted LRU-first — measures the
+           hit rate the idle pool retains under pressure and that
+           admission latency stays flat (the allocator's O(1)
+           acquire/release under a retire burst).
+
+Carried arms (no chip session yet; every r06-r11 row in docs/PERF.md is
+still pending, so the first session runs everything from here): kv8 /
+commq / bytes plus all r6-r10 arms — delegated verbatim to
+scratch/r11_quant.py.
+"""
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "prefix"
+
+_R11_ARMS = ("kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R11_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r11_quant.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r12_prefix.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("prefix", "evict"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.inference import InferenceEngine, SamplingParams  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig, init_params  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16)
+    slots, page, requests, max_new = 8, 128, 64, 64
+    shared_pages = 3                          # 384-token system prompt
+    suffix_lens = [32 + 23 * i % 224 for i in range(requests)]
+    gap_s = 0.01
+else:
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    slots, page, requests, max_new = 4, 16, 16, 8
+    shared_pages = 3                          # 48-token system prompt
+    suffix_lens = [9, 17, 5, 23, 12, 30, 7, 14]
+    gap_s = 0.005
+
+params = init_params(cfg, jax.random.PRNGKey(0))
+shared_len = shared_pages * page
+rng = jax.random.PRNGKey(1)
+rng, sub = jax.random.split(rng)
+shared = jax.random.randint(sub, (shared_len,), 0,
+                            cfg.vocab_size).tolist()
+prompts = []
+for i in range(requests):
+    rng, sub = jax.random.split(rng)
+    n = suffix_lens[i % len(suffix_lens)]
+    prompts.append(shared + jax.random.randint(
+        sub, (n,), 0, cfg.vocab_size).tolist())
+
+
+def open_loop(engine, gap):
+    t0 = time.perf_counter()
+    submitted = 0
+    while submitted < len(prompts) or engine.has_work():
+        now = time.perf_counter() - t0
+        while submitted < len(prompts) and submitted * gap <= now:
+            engine.submit(prompts[submitted], max_new_tokens=max_new,
+                          sampling=SamplingParams())
+            submitted += 1
+        if engine.has_work():
+            engine.step()
+        else:
+            time.sleep(0.001)
+    return time.perf_counter() - t0
+
+
+if VARIANT == "prefix":
+    executables = {}
+    for arm_prefix in (False, True):
+        # warmup engine pays the compiles into the shared cache; the
+        # measured engine is pure steady state
+        warm = InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, prefix=arm_prefix,
+                               telemetry=False, max_queue=0,
+                               executable_cache=executables)
+        open_loop(warm, 0.0)
+        del warm    # free the warmup KV cache before measuring
+        engine = InferenceEngine(cfg, params, slots=slots,
+                                 page_size=page, prefix=arm_prefix,
+                                 telemetry=True, max_queue=0,
+                                 executable_cache=executables)
+        wall = open_loop(engine, gap_s)
+        tel = engine.telemetry.summary()
+        st = engine.stats()
+        print(json.dumps({
+            "arm": f"prefix-{'on' if arm_prefix else 'off'}",
+            "prefix": arm_prefix,
+            "wall_s": round(wall, 3),
+            "prompt_tokens": tel.get("prompt_tokens"),
+            "prefill_tokens_skipped":
+                tel.get("prefill_tokens_skipped"),
+            "prefix_hit_rate": round(tel.get("prefix_hit_rate", 0.0),
+                                     4),
+            "ttft_mean_s": round(tel.get("ttft_mean_s", 0.0), 4),
+            "ttft_s": round(tel.get("ttft_s", 0.0), 4),
+            "decode_tokens_per_sec":
+                tel.get("decode_tokens_per_sec"),
+            "prefill_s": tel.get("prefill_s"),
+            "compiles": st["compiles"],
+            "hits": st["hits"],
+            "prefix_stats": st["prefix"],
+        }), flush=True)
+    sys.exit(0)
+
+# evict — tight pool: barely more than one request's reservation plus
+# the shared prefix, so every request's unique suffix pages roll
+# through the idle pool and out again LRU-first.  The shared prefix
+# (touched by every admission, so always at the MRU end) must survive
+# — high hit rate WITH nonzero evictions — and admission stays O(1)
+# under the continuous retire/evict churn.
+need_max = -(-(max(len(p) for p in prompts) + 4) // page)
+tight_pages = need_max + shared_pages + 1       # +1 garbage
+engine = InferenceEngine(cfg, params, slots=1, page_size=page,
+                         num_pages=tight_pages, max_queue=0,
+                         prefix=True, telemetry=True)
+t0 = time.perf_counter()
+for rep in range(3):
+    for p in prompts:
+        engine.submit(p, max_new_tokens=4,
+                      sampling=SamplingParams())
+        while engine.has_work():
+            engine.step()
+wall = time.perf_counter() - t0
+st = engine.stats()
+tel = engine.telemetry.summary()
+print(json.dumps({
+    "arm": "evict", "num_pages": tight_pages,
+    "wall_s": round(wall, 3),
+    "prefix_hit_rate": round(tel.get("prefix_hit_rate", 0.0), 4),
+    "prefill_tokens_skipped": tel.get("prefill_tokens_skipped"),
+    "prefix_stats": st["prefix"],
+}), flush=True)

@@ -186,7 +186,9 @@ def test_int8_kv_cache_parity_and_bytes(tiny_f32):
     # greedy trajectories agree on the tiny model (not guaranteed at
     # scale — the logits assertion above is the real contract)
     assert outs[q8][1] == outs[base][1]
-    assert q8.stats()["compiles"] == {"prefill": 1, "decode": 1}
+    assert q8.stats()["compiles"] == {"prefill": 1,
+                                      "prefill_cached": 0,
+                                      "decode": 1}
 
     # ragged co-batching stays invisible under quantization too
     p2 = _prompt(14, cfg.vocab_size, seed=12)
@@ -214,6 +216,292 @@ def test_kv_dtype_env_knob(tiny_f32, monkeypatch):
     finally:
         monkeypatch.delenv("RAY_TPU_KV_DTYPE")
         infer_config(refresh=True)
+
+
+# ---------------------------------------------------------- prefix cache
+def test_page_allocator_refcount_and_eviction():
+    """r12 refcounted allocator: shared pages free only at refcount 0,
+    registered refcount-0 pages park in an LRU idle pool, and alloc
+    evicts idle pages LRU-first (unregistering them) before failing."""
+    from ray_tpu.inference import PageAllocator, PrefixIndex
+    idx = PrefixIndex()
+    alloc = PageAllocator(6, index=idx)        # pages 1..5 usable
+    a = alloc.alloc(2)
+    h = PrefixIndex.chain(PrefixIndex.ROOT, [1, 2, 3])
+    assert idx.register(h, a[0])
+    # shared reference: releasing one of two refs keeps the page live
+    alloc.acquire(a[0])
+    assert alloc.refcount(a[0]) == 2
+    alloc.release([a[0]])
+    assert alloc.refcount(a[0]) == 1 and alloc.free_count == 3
+    # refcount 0: registered page idles (still a lookup hit),
+    # unregistered page goes back to the free list
+    alloc.release(a)
+    assert alloc.refcount(a[0]) == 0
+    assert alloc.idle_count == 1 and alloc.free_count == 5
+    assert idx.lookup(h) == a[0]
+    # a hit revives the idle page
+    alloc.acquire(a[0])
+    assert alloc.idle_count == 0 and alloc.refcount(a[0]) == 1
+    alloc.release([a[0]])
+    # exhausting the free list evicts the idle page and forgets it
+    b = alloc.alloc(5)
+    assert b is not None and len(set(b)) == 5
+    assert alloc.evictions == 1 and idx.lookup(h) is None
+    assert alloc.alloc(1) is None              # truly exhausted
+    with pytest.raises(ValueError):
+        alloc.acquire(0)                       # the garbage page
+    alloc.release(b)
+    with pytest.raises(ValueError):
+        alloc.release([b[0]])                  # double free stays O(1)
+
+
+def test_scheduler_refcount_fuzz():
+    """Fuzz admit/hit/retire/evict interleavings at the scheduler
+    level (no compiled steps — register_prefix is called as the engine
+    would, after 'prefill'): no page freed while referenced, refcounts
+    exactly match the active references, every page always in exactly
+    one of {free, idle, allocated}, and nothing leaks at drain."""
+    import collections
+
+    from ray_tpu.inference import Request, SamplingParams, SlotScheduler
+    rng = np.random.RandomState(42)
+    ps = 8
+    sched = SlotScheduler(slots=3, page_size=ps, num_pages=24,
+                          max_pages_per_slot=8, prefix=True)
+    alloc = sched.allocator
+    # a small pool of shared prefixes drives real hit/shared-page load
+    prefixes = [list(rng.randint(0, 97, 2 * ps)) for _ in range(3)]
+    rid = 0
+    for step in range(300):
+        op = rng.rand()
+        if op < 0.5 and len(sched.waiting) < 4:
+            prompt = list(prefixes[rng.randint(3)]) if rng.rand() < 0.7 \
+                else list(rng.randint(0, 97, 2 * ps))
+            prompt = prompt + list(
+                rng.randint(0, 97, int(rng.randint(1, 2 * ps))))
+            sched.submit(Request(rid=rid, prompt=prompt,
+                                 max_new_tokens=int(rng.randint(1, 8)),
+                                 sampling=SamplingParams()))
+            rid += 1
+        elif op < 0.8:
+            req = sched.try_admit()
+            if req is not None:
+                sched.register_prefix(req)     # "prefill finished"
+        elif sched.active:
+            slot = list(sched.active)[rng.randint(len(sched.active))]
+            sched.retire(slot)
+        # --- invariants, every step ---
+        expected = collections.Counter()
+        for req in sched.active.values():
+            for p in req.pages:
+                expected[p] += 1
+        # refcounts exactly track active references...
+        assert dict(expected) == {p: c for p, c in
+                                  alloc._refcount.items()}, step
+        # ...no referenced page is free/idle, and the three pools
+        # partition the usable pages
+        free = alloc._free_set
+        idle = set(alloc._idle)
+        held = set(alloc._refcount)
+        assert len(alloc._free) == len(free)
+        assert not (free & idle) and not (free & held) \
+            and not (idle & held)
+        assert free | idle | held == set(range(1, 24))
+        # idle pages are exactly the registered refcount-0 pages
+        for p in idle:
+            assert sched.prefix_index.has(p)
+    while sched.active:
+        sched.retire(next(iter(sched.active)))
+    assert not alloc._refcount
+    assert alloc.free_count == 23              # nothing leaked
+
+
+def test_prefix_hit_decode_parity(tiny_f32):
+    """The tentpole contract: a prefix-hit request (suffix-only
+    prefill over shared cached pages) produces the same trajectory and
+    step-by-step decode logits as the identical request running cold —
+    including a prompt whose length is an exact page multiple (the
+    final prompt token must still prefill)."""
+    cfg, params = tiny_f32
+    for plen, seed in ((37, 21), (48, 22)):    # 48 = 3 full pages
+        engine = _make_engine(cfg, params, debug_logits=True)
+        prompt = _prompt(plen, cfg.vocab_size, seed=seed)
+        r_cold = engine.submit(prompt, max_new_tokens=5)
+        while engine.has_work():
+            engine.step()
+        r_hit = engine.submit(prompt, max_new_tokens=5)
+        while engine.has_work():
+            engine.step()
+        st = engine.stats()
+        # the hit skipped every full page strictly before the last
+        # prompt token, at zero prefill compute
+        assert st["prefix"]["hit_tokens"] == 16 * ((plen - 1) // 16)
+        assert st["prefix"]["requests_hit"] == 1
+        assert engine._requests[r_hit].generated == \
+            engine._requests[r_cold].generated
+        np.testing.assert_allclose(
+            np.stack(engine.logits_trace[r_hit]),
+            np.stack(engine.logits_trace[r_cold]),
+            rtol=2e-4, atol=2e-4)
+
+
+def test_prefix_hit_decode_parity_int8(tiny_f32):
+    """Prefix hits under ``kv_dtype="int8"``: deterministic rounding
+    makes shared pages bit-identical, so a hit request's logits stay
+    within the int8 budget of its own cold run (the cached prefix is
+    read back quantized where the cold prefill read full precision)."""
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, debug_logits=True,
+                          kv_dtype="int8")
+    prompt = _prompt(37, cfg.vocab_size, seed=23)
+    r_cold = engine.submit(prompt, max_new_tokens=5)
+    while engine.has_work():
+        engine.step()
+    r_hit = engine.submit(prompt, max_new_tokens=5)
+    while engine.has_work():
+        engine.step()
+    assert engine.stats()["prefix"]["hit_tokens"] == 32
+    np.testing.assert_allclose(
+        np.stack(engine.logits_trace[r_hit]),
+        np.stack(engine.logits_trace[r_cold]),
+        rtol=0.05, atol=0.05)
+
+
+def test_prefix_mixed_traffic_zero_recompiles(tiny_f32):
+    """Mixed hit/miss traffic: varying cached lengths ride ONE cached-
+    prefill executable per suffix bucket (cached_len is a traced
+    scalar), so the compile counters stay flat — and a hit request
+    co-batched with strangers still matches its solo cold run."""
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, executable_cache={})
+    shared = _prompt(32, cfg.vocab_size, seed=31)       # 2 full pages
+    mkreq = lambda n, s: shared + _prompt(n, cfg.vocab_size, seed=s)
+    solo = _make_engine(cfg, params).generate(
+        [mkreq(7, 33)], max_new_tokens=4)[0]
+    out = {}
+    # cold registrant, then hits with different suffix lengths, plus a
+    # no-share stranger co-batched between them
+    rids = [engine.submit(mkreq(5, 32), max_new_tokens=4),
+            engine.submit(mkreq(7, 33), max_new_tokens=4),
+            engine.submit(_prompt(40, cfg.vocab_size, seed=34),
+                          max_new_tokens=4),   # same 64 bucket, no share
+            engine.submit(mkreq(12, 35), max_new_tokens=4)]
+    for r in rids:
+        out[r] = []
+    while engine.has_work():
+        for r, tok, _d in engine.step():
+            out[r].append(tok)
+    st = engine.stats()
+    assert st["compiles"] == {"prefill": 1, "prefill_cached": 1,
+                              "decode": 1}
+    assert st["prefix"]["requests_hit"] == 2
+    assert st["prefix"]["hit_tokens"] == 2 * 32
+    assert out[rids[1]] == solo
+
+
+def test_prefix_shared_pages_refcounted_concurrently(tiny_f32):
+    """Two live requests sharing prefix pages: the shared pages carry
+    refcount 2 while both decode, survive the first retire, and only
+    return to the idle pool after the second — then a third request
+    revives them from idle."""
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params)
+    sched = engine.scheduler
+    free0 = sched.allocator.free_count
+    shared = _prompt(32, cfg.vocab_size, seed=41)
+    r1 = engine.submit(shared + _prompt(3, cfg.vocab_size, seed=42),
+                       max_new_tokens=8)
+    engine.step()        # r1 prefilled + registered
+    r2 = engine.submit(shared + _prompt(5, cfg.vocab_size, seed=43),
+                       max_new_tokens=3)
+    engine.step()        # r2 admitted as a hit, both now active
+    reqs = {r.rid: r for r in sched.active.values()}
+    shared_pages = reqs[r1].pages[:2]
+    assert reqs[r2].pages[:2] == shared_pages      # same storage
+    assert reqs[r2].cached_tokens == 32
+    for p in shared_pages:
+        assert sched.allocator.refcount(p) == 2
+    while engine.has_work():
+        engine.step()    # r2 retires first (max_new 3), then r1
+    assert sched.allocator.free_count == free0     # idle counts as free
+    assert sched.allocator.idle_count > 0
+    r3 = engine.submit(shared + _prompt(4, cfg.vocab_size, seed=44),
+                       max_new_tokens=3)
+    engine.step()
+    (req3,) = sched.active.values()
+    assert req3.rid == r3 and req3.cached_tokens == 32
+    while engine.has_work():
+        engine.step()
+    assert sched.allocator.free_count == free0
+    assert engine.stats()["prefix"]["requests_hit"] == 2
+
+
+def test_prefix_disabled_knob(tiny_f32):
+    """prefix=False (RAY_TPU_INFER_PREFIX=0): identical prompts never
+    share — no index, no hits, no cached-prefill compiles."""
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, prefix=False,
+                          executable_cache={})
+    prompt = _prompt(37, cfg.vocab_size, seed=51)
+    engine.generate([prompt], max_new_tokens=2)
+    engine.generate([prompt], max_new_tokens=2)
+    st = engine.stats()
+    assert st["prefix"] == {
+        "enabled": False, "hit_pages": 0, "hit_tokens": 0,
+        "requests_hit": 0, "registered_pages": 0, "idle_pages": 0,
+        "evictions": 0}
+    assert st["compiles"]["prefill_cached"] == 0
+    assert st["hits"]["prefill"] == 1          # second run = pure hit
+
+
+# ----------------------------------------------------------- load shedding
+def test_max_queue_load_shedding(tiny_f32):
+    """RAY_TPU_INFER_MAX_QUEUE: over-cap submits raise the typed
+    QueueFullError instead of queueing unboundedly, and draining the
+    queue re-opens admission."""
+    from ray_tpu.inference import QueueFullError
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, slots=1, max_queue=2)
+    engine.submit(_prompt(5, cfg.vocab_size), max_new_tokens=2)
+    engine.submit(_prompt(6, cfg.vocab_size), max_new_tokens=2)
+    assert engine.stats()["waiting"] == 2      # head admits at step()
+    with pytest.raises(QueueFullError, match="MAX_QUEUE"):
+        engine.submit(_prompt(7, cfg.vocab_size), max_new_tokens=2)
+    assert len(engine._requests) == 2          # rejected leaves no trace
+    engine.step()                              # head takes the slot
+    assert engine.stats()["waiting"] == 1      # cap re-opens
+    engine.submit(_prompt(8, cfg.vocab_size), max_new_tokens=2)
+    while engine.has_work():
+        engine.step()
+    assert not engine._requests
+
+
+def test_gpt_deployment_queue_full_is_stream_error(tiny_f32):
+    """The serve deployment surfaces the typed rejection as the
+    stream's error (consumer sees QueueFullError at first iteration),
+    not a silently parked request."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference import QueueFullError
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 1, "page_size": 16, "buckets": (32,),
+                       "max_queue": 1, "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+    dep.engine.submit([1, 2, 3], max_new_tokens=4)   # fills the queue
+
+    async def run():
+        agen = dep({"tokens": [7, 8, 9], "max_new_tokens": 4})
+        return [tok async for tok in agen]
+
+    with pytest.raises(QueueFullError):
+        asyncio.run(asyncio.wait_for(run(), timeout=30))
+    assert not dep._queues
 
 
 # --------------------------------------------------------------- batching
@@ -266,7 +554,8 @@ def test_zero_steady_state_recompiles(tiny_f32):
     while engine.has_work():
         engine.step()
     stats = engine.stats()
-    assert stats["compiles"] == {"prefill": 1, "decode": 1}
+    assert stats["compiles"] == {"prefill": 1, "prefill_cached": 0,
+                                 "decode": 1}
     assert stats["hits"]["prefill"] == 3
     assert stats["hits"]["decode"] > 0
 
@@ -384,11 +673,21 @@ def test_infer_config_env_knobs(monkeypatch):
     assert cfg.decode_impl == "xla"
     monkeypatch.setenv("RAY_TPU_INFER_DECODE", "bogus")
     assert infer_config(refresh=True).decode_impl == "auto"
+    # r12 knobs: prefix cache + load-shedding queue cap
+    assert infer_config().prefix and infer_config().max_queue == 0
+    monkeypatch.setenv("RAY_TPU_INFER_PREFIX", "0")
+    monkeypatch.setenv("RAY_TPU_INFER_MAX_QUEUE", "7")
+    cfg = infer_config(refresh=True)
+    assert not cfg.prefix and cfg.max_queue == 7
+    monkeypatch.setenv("RAY_TPU_INFER_MAX_QUEUE", "-3")
+    assert infer_config(refresh=True).max_queue == 0   # loud fallback
     monkeypatch.delenv("RAY_TPU_INFER_SLOTS")
     monkeypatch.delenv("RAY_TPU_INFER_PAGE_SIZE")
     monkeypatch.delenv("RAY_TPU_INFER_PAGES")
     monkeypatch.delenv("RAY_TPU_INFER_BUCKETS")
     monkeypatch.delenv("RAY_TPU_INFER_DECODE")
+    monkeypatch.delenv("RAY_TPU_INFER_PREFIX")
+    monkeypatch.delenv("RAY_TPU_INFER_MAX_QUEUE")
     infer_config(refresh=True)
 
 
@@ -401,6 +700,21 @@ def test_infer_telemetry_summary(tiny_f32):
     assert out["prefills"] == 1 and out["decode_steps"] == 2
     assert out["ttft_s"] > 0 and out["decode_step_s"] > 0
     assert out["decode_tokens_per_sec"] > 0
+    # r12: prefix-hit accounting, TTFT split and queue-wait series
+    assert out["prompt_tokens"] == 5
+    assert out["prefill_tokens_skipped"] == 0
+    assert out["prefix_hit_rate"] == 0.0
+    assert out["ttft_mean_s"] > 0
+    assert out["ttft_prefix_miss_s"] > 0 and "ttft_prefix_hit_s" not in out
+    assert out["queue_wait_s"] >= 0
+    # a second identical request: skipped tokens and the hit-side TTFT
+    # series appear (prompt has no full page at len 5 -> use a long one)
+    long = _prompt(37, cfg.vocab_size, seed=9)
+    engine.generate([long], max_new_tokens=2)
+    engine.generate([long], max_new_tokens=2)
+    out = engine.telemetry.summary()
+    assert out["prefill_tokens_skipped"] == 32
+    assert out["ttft_prefix_hit_s"] > 0
     # r11: the true cache footprint rides the summary block
     assert out["kv_dtype"] == "model"
     assert out["kv_bytes_per_slot"] > 0
