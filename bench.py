@@ -152,6 +152,7 @@ def _bench_mesh_body(axes):
     # three rows per mesh: the two schedules plus the int8-wire overlap
     # arm, so MULTICHIP_r*.json carries gspmd-vs-overlap-vs-quantized
     # with per-collective wire dtypes side by side
+    from ray_tpu.ops.substrate import run_ladder
     for want, want_quant in (("gspmd", "none"), ("overlap", "none"),
                              ("overlap", "int8")):
         fallback = None
@@ -160,24 +161,26 @@ def _bench_mesh_body(axes):
         mode = fns["comm_mode"]
         if want_quant == "int8" and mode != "overlap":
             continue     # overlap fell back: no distinct quantized arm
-        try:
-            state = fns["init_fn"](jax.random.PRNGKey(0))
+
+        def attempt(f):
+            # None = the fallback rung: rebuild on the gspmd schedule
+            if f is None:
+                f = training.build_gpt_train(cfg, mesh,
+                                             comm_mode="gspmd")
+            state = f["init_fn"](jax.random.PRNGKey(0))
             for _ in range(2):
-                state, metrics = fns["step_fn"](state, batch_data)
+                state, metrics = f["step_fn"](state, batch_data)
                 float(metrics["loss"])
-        except Exception as e:
-            # extend the headline bench's loud fallback ladder: an
-            # overlap compile/run failure degrades to gspmd, visibly
-            if mode == "gspmd":
-                raise
-            print(f"comm_mode=overlap step failed ({e!r}); "
-                  "falling back: gspmd schedule", file=sys.stderr)
+            return f, state, metrics
+
+        # the substrate's shared loud fallback ladder: an overlap
+        # compile/run failure degrades to gspmd, visibly
+        rungs = [(None, fns)]
+        if mode != "gspmd":
+            rungs.append(("gspmd schedule", None))
+        (fns, state, metrics), _, taken = run_ladder(attempt, rungs)
+        if taken:
             fallback, mode = want, "gspmd"
-            fns = training.build_gpt_train(cfg, mesh, comm_mode="gspmd")
-            state = fns["init_fn"](jax.random.PRNGKey(0))
-            for _ in range(2):
-                state, metrics = fns["step_fn"](state, batch_data)
-                float(metrics["loss"])
         # raw jit step for the timed loop (same executable the wrapped
         # warmup compiled — the light wrapper delegates to it), then a
         # short instrumented window for the telemetry steady stats
@@ -423,16 +426,23 @@ def main():
 
     from ray_tpu.ops.attention import uses_pack2
     from ray_tpu.ops.flash_ce import uses_flash_ce
+    from ray_tpu.ops.fused_norm import out_proj_norm_plan
+    from ray_tpu.ops.substrate import run_ladder
     mesh = make_mesh(dp=len(devices), devices=devices)
     # mirrors of the kernels' own dispatch gates (head_dim/even heads/
-    # tileability for pack2; mode/model-dim for flash-CE), so the
-    # reported fields match what actually runs.  flash-CE only engages
-    # on a single-device mesh (pallas_call has no SPMD rule).
+    # tileability for pack2; mode/model-dim for flash-CE; norm/bias/
+    # shape for the fused norm epilogues), so the reported fields match
+    # what actually runs.  flash-CE only engages on a single-device
+    # mesh (pallas_call has no SPMD rule).
     attn_pack2 = uses_pack2(seq, seq, cfg.n_heads, cfg.head_dim)
     ce_flash = (not quick
                 and uses_flash_ce(batch * seq, cfg.d_model,
                                   cfg.vocab_size,
                                   n_devices=len(devices)))
+    fuse_norm = bool(out_proj_norm_plan(
+        batch * seq, cfg.n_heads * cfg.head_dim, cfg.d_model,
+        norm=cfg.norm, has_bias=cfg.use_bias, n_devices=len(devices),
+        seq=seq))
     # pin "flash" so a fallback can turn it off ("xla") without env
     # games; None respects the env-resolved config (e.g. RAY_TPU_CE=
     # fused stays measurable through the bench).  Quick mode pins
@@ -451,7 +461,7 @@ def main():
             return "fused"
         return "noremat" if cfg.ce_chunk < 0 else "chunked"
 
-    def build(cfg, pack2, ce_pin):
+    def build(cfg, pack2, ce_pin, fuse):
         # bench owns its recorder (AOT mode: exact compile split + HBM
         # memory_analysis) instead of the builders' default light wrap.
         # profile_dir is forced off: the xplane capture starts at
@@ -459,7 +469,8 @@ def main():
         # headline loop (use scratch/r9_telemetry.py for captures).
         import ray_tpu.telemetry as tel_mod
         fns = training.build_gpt_train(cfg, mesh, attn_pack2=pack2,
-                                       ce_mode=ce_pin, telemetry=False)
+                                       ce_mode=ce_pin, fuse_norm=fuse,
+                                       telemetry=False)
         fns = tel_mod.instrument(
             fns, cfg, mesh, comm_mode=fns["comm_mode"],
             ce_mode=ce_pin, label="bench", aot=True,
@@ -467,46 +478,47 @@ def main():
                 enabled=tel_mod.telemetry_config().enabled))
         return fns, fns["init_fn"](jax.random.PRNGKey(0))
 
-    fns, state = build(cfg, attn_pack2, ce_pin)
     batch_data = training.synthetic_lm_batch(
         jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
 
-    # warmup / compile (float() forces a device round-trip: the axon
-    # tunnel's block_until_ready does not actually block).  Both Pallas
-    # schedules are interpret-mode-tested by the preamble, but a Mosaic
-    # compile failure on new hardware must degrade loudly, not kill the
-    # headline number.  Fallback ladder, most-capable first — each rung
-    # isolates one suspect, so a pack2-only failure still measures with
-    # flash-CE restored rather than riding the CE degradation down:
-    # flash-CE off -> pack2 off (flash back) -> both off -> chunked CE.
-    fallbacks = []
+    def attempt(args):
+        # build + warmup/compile (float() forces a device round-trip:
+        # the axon tunnel's block_until_ready does not actually block)
+        fns, state = build(*args)
+        for _ in range(2):
+            state, metrics = fns["step_fn"](state, batch_data)
+            float(metrics["loss"])
+        return fns, state
+
+    # Every Pallas schedule is interpret-mode-tested by the preamble,
+    # but a Mosaic compile failure on new hardware must degrade loudly,
+    # not kill the headline number.  The substrate's shared ladder,
+    # most-capable first — each rung isolates one suspect, so e.g. a
+    # fused-norm-only failure still measures with pack2 + flash-CE
+    # intact rather than riding the whole chain down: fused norms off
+    # -> flash-CE off -> pack2 off (flash back) -> both off ->
+    # chunked CE.
+    rungs = [(None, (cfg, attn_pack2, ce_pin, fuse_norm))]
+    if fuse_norm:
+        rungs.append(("fused norm epilogues off",
+                      (cfg, attn_pack2, ce_pin, False)))
     if ce_flash:
-        fallbacks.append(("flash-CE -> no-remat CE",
-                          (cfg, attn_pack2, "xla")))
+        rungs.append(("flash-CE -> no-remat CE",
+                      (cfg, attn_pack2, "xla", False)))
     if attn_pack2:
         if ce_flash:
-            fallbacks.append(
+            rungs.append(
                 ("single-head attention kernels, flash-CE restored",
-                 (cfg, False, "flash")))
-        fallbacks.append(("single-head attention kernels, no flash-CE",
-                          (cfg, False, "xla" if ce_flash else ce_pin)))
+                 (cfg, False, "flash", False)))
+        rungs.append(("single-head attention kernels, no flash-CE",
+                      (cfg, False, "xla" if ce_flash else ce_pin,
+                       False)))
     if cfg.ce_chunk < 0:
-        fallbacks.append(("chunked CE (last resort)",
-                          (dataclasses.replace(cfg, ce_chunk=4096),
-                           False, "xla")))
-    while True:
-        try:
-            for _ in range(2):
-                state, metrics = fns["step_fn"](state, batch_data)
-                float(metrics["loss"])
-            break
-        except Exception as e:
-            if not fallbacks:
-                raise
-            what, (cfg, attn_pack2, ce_pin) = fallbacks.pop(0)
-            print(f"step failed to compile/run ({e!r}); "
-                  f"falling back: {what}", file=sys.stderr)
-            fns, state = build(cfg, attn_pack2, ce_pin)
+        rungs.append(("chunked CE (last resort)",
+                      (dataclasses.replace(cfg, ce_chunk=4096),
+                       False, "xla", False)))
+    (fns, state), (cfg, attn_pack2, ce_pin, fuse_norm), _ = \
+        run_ladder(attempt, rungs)
 
     # the timed headline loop must NOT run through the telemetry
     # wrapper: its per-step blocking sync would serialize host dispatch
@@ -554,9 +566,11 @@ def main():
         "final_loss": round(float(metrics["loss"]), 4),
         # which schedules the step actually ran (false/"noremat" also
         # if a Pallas compile fell back above): two-head lane-packed
-        # attention, and the CE path (flash/noremat/chunked)
+        # attention, the CE path (flash/noremat/chunked), and the
+        # fused norm epilogues (out-proj + ln_f-in-flash-CE)
         "attn_pack2": attn_pack2,
         "ce": ce_name(cfg, ce_pin),
+        "fuse_norm": fuse_norm,
         # comm-schedule fields, so headline and --mesh records stay
         # comparable (headline is a dp-mesh GSPMD run; the overlap
         # schedule is --mesh territory)
@@ -581,7 +595,8 @@ def main():
         # needs no xplane trace.  Skip a custom arm when the step
         # itself fell back (its compile failure would re-raise here and
         # eat the headline exit code).
-        from ray_tpu._private.ray_perf import attention_perf, ce_perf
+        from ray_tpu._private.ray_perf import (attention_perf, ce_perf,
+                                               fused_norm_perf)
         arms = (True, False) if attn_pack2 else (False,)
         for pack2 in arms:
             comp = attention_perf(batch=batch, seq=seq,
@@ -595,6 +610,15 @@ def main():
             comp = ce_perf(n_tokens=batch * seq, d_model=cfg.d_model,
                            vocab=cfg.vocab_size, mode=mode)
             comp["metric"] = "ce_fwd_bwd"
+            print(json.dumps(comp))
+        norm_arms = (True, False) if fuse_norm else (False,)
+        for fused in norm_arms:
+            comp = fused_norm_perf(n_tokens=batch * seq,
+                                   heads=cfg.n_heads,
+                                   head_dim=cfg.head_dim,
+                                   d_model=cfg.d_model, fused=fused)
+            comp["metric"] = "fused_norm_epilogue"
+            comp["fuse_norm"] = fused
             print(json.dumps(comp))
 
 

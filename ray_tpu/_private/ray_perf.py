@@ -184,6 +184,69 @@ def ce_perf(n_tokens: int = 24576, d_model: int = 768,
     return result
 
 
+def fused_norm_perf(n_tokens: int = 24576, heads: int = 12,
+                    head_dim: int = 64, d_model: int = 768,
+                    steps: int = 30,
+                    fused: bool = True) -> Dict[str, float]:
+    """Isolated out-proj + residual + norm epilogue microbenchmark
+    (``--fuse-norm``).
+
+    Times ``steps`` jitted grad evaluations of the attention-block
+    epilogue — out-proj matmul, residual add, pre-FFN rmsnorm — in the
+    fused Pallas formulation (``ops/fused_norm.matmul_residual_norm``)
+    vs the unfused XLA one, with cotangents flowing into *both*
+    outputs (residual stream + normed hidden) like the real block.
+    The A/B for the ~13 ms out-proj-fusion + ~10.7 ms
+    [d]-reduction-dispatch headroom ``docs/PERF.md`` r13 tracks.  On
+    CPU the kernel runs in Pallas interpret mode — numbers are only
+    meaningful on a real chip, but the entry stays runnable anywhere.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.fused_norm import (matmul_residual_norm,
+                                        xla_matmul_residual_norm)
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    K = heads * head_dim
+    ka, kw, kr, ks, c1, c2 = jax.random.split(jax.random.PRNGKey(0), 6)
+    a = jax.random.normal(ka, (n_tokens, K), dtype)
+    w = jax.random.normal(kw, (K, d_model), dtype) * K ** -0.5
+    resid = jax.random.normal(kr, (n_tokens, d_model), dtype)
+    scale = jnp.ones((d_model,), dtype)
+    wr = jax.random.normal(c1, (n_tokens, d_model), dtype)
+    wy = jax.random.normal(c2, (n_tokens, d_model), dtype)
+    op = matmul_residual_norm if fused else xla_matmul_residual_norm
+
+    def loss(a, w, resid, scale):
+        r, y = op(a, w, resid, scale)
+        return (jnp.sum(r.astype(jnp.float32) * wr.astype(jnp.float32))
+                + jnp.sum(y.astype(jnp.float32) * wy.astype(jnp.float32)))
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))
+    g = grad_fn(a, w, resid, scale)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = grad_fn(a, w, resid, scale)
+    jax.block_until_ready(g)
+    dt = (time.perf_counter() - t0) / steps
+
+    # 1 fwd matmul + 2 bwd (da, dw); the norm itself is VPU/HBM work
+    flops = 3 * 2 * n_tokens * K * d_model
+    result = {
+        "name": f"out-proj+norm epilogue fused={fused}",
+        "ms_per_step": dt * 1e3,
+        "tokens_per_sec": n_tokens / dt,
+        "effective_tflops": flops / dt / 1e12,
+    }
+    print(f"{result['name']}: {result['ms_per_step']:.2f} ms  "
+          f"{result['tokens_per_sec']:,.0f} tok/s  "
+          f"{result['effective_tflops']:.1f} eff TFLOPs")
+    return result
+
+
 def decode_perf(batch: int = 8, ctx: int = 1024, heads: int = 12,
                 head_dim: int = 64, steps: int = 50,
                 impl: str = "auto") -> Dict[str, float]:
@@ -468,6 +531,10 @@ if __name__ == "__main__":
         # loss-head A/B: streamed-logits Pallas CE vs no-remat XLA
         ce_perf(mode="flash")
         ce_perf(mode="noremat")
+    elif "--fuse-norm" in sys.argv:
+        # norm-epilogue A/B: fused Pallas out-proj+residual+norm vs XLA
+        fused_norm_perf(fused=True)
+        fused_norm_perf(fused=False)
     elif "--collective" in sys.argv:
         # TP-schedule A/B: ring all-gather-matmul vs barrier gather
         collective_perf()

@@ -285,7 +285,7 @@ def _moe_ffn(lp, x, cfg: GPTConfig):
 
 
 def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
-                cache=None):
+                cache=None, fuse_norm=None):
     """One transformer block: ``(layer params, hidden [B,S,d]) -> (hidden,
     moe aux)``.  Shared by the stacked ``lax.scan`` in ``forward_hidden``,
     the per-stage scan in the pipeline-parallel trainer
@@ -299,9 +299,19 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
     ``attn_fn(q, k, v, cache=cache)`` with the *rotated* k (cache
     entries store post-RoPE keys, so decode never re-rotates history)
     and must return ``(attn_out, new_cache)``; the block then returns
-    ``(hidden, aux, new_cache)`` instead of the 2-tuple."""
+    ``(hidden, aux, new_cache)`` instead of the 2-tuple.
+
+    ``fuse_norm`` pins the fused out-proj epilogue (out-proj matmul +
+    residual add + pre-FFN rmsnorm in one Pallas kernel,
+    ``ray_tpu.ops.fused_norm``) for A/B drivers; default follows
+    ``RAY_TPU_FUSE_NORM``.  The dispatch gate
+    (``fused_norm.out_proj_norm_plan``) declines layernorm, biases,
+    sharded meshes and the S=1 decode step — those keep the XLA
+    einsum + ``_norm`` path unchanged."""
+    from ray_tpu.ops import fused_norm as fnorm
     constrain = functools.partial(shd.constrain, mesh=mesh)
     eps = norm_eps(cfg)
+    h2 = None
     with jax.named_scope("gpt/attn"):
         h = _norm(x, lp["ln1"], cfg.norm, bias=lp.get("ln1_b"), eps=eps)
         # (a fused [d, 3Hk] qkv projection was A/B'd on the v5e bench
@@ -334,13 +344,32 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
         else:
             attn = attn_fn(q, k, v)
         attn = constrain(attn, ("batch", "seq", "heads", None))
-        proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
-        if "bo" in lp:
-            proj = proj + lp["bo"]
-        x = x + proj
+        B, S, Hn, hd = attn.shape
+        d = x.shape[-1]
+        plan = fnorm.out_proj_norm_plan(
+            B * S, Hn * hd, d, norm=cfg.norm,
+            has_bias=("bo" in lp) or ("ln2_b" in lp),
+            n_devices=getattr(mesh, "size", 1) if mesh is not None else 1,
+            seq=S, enabled=fuse_norm)
+        if plan:
+            # out-proj + residual add + pre-FFN norm in one kernel:
+            # the residual stream is written once and the ln2 stats
+            # never run as their own XLA fusion
+            r2, y2 = fnorm.matmul_residual_norm(
+                attn.reshape(B * S, Hn * hd),
+                lp["wo"].reshape(Hn * hd, d),
+                x.reshape(B * S, d), lp["ln2"], eps=eps)
+            x = r2.reshape(B, S, d)
+            h2 = y2.reshape(B, S, d)
+        else:
+            proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+            if "bo" in lp:
+                proj = proj + lp["bo"]
+            x = x + proj
     with jax.named_scope("gpt/ffn"):
-        h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"),
-                   eps=eps)
+        if h2 is None:
+            h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"),
+                       eps=eps)
         if cfg.n_experts > 0:
             ffn_out, aux = _moe_ffn(lp, h2, cfg)
         else:
@@ -375,7 +404,7 @@ def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
 
 
 def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
-                     ce_mode: Optional[str] = None):
+                     ce_mode: Optional[str] = None, norm_scale=None):
     """(final *normed* hidden [B,S,d], targets [B,S]) -> mean NLL
     (CE glue shared by the dense and pipeline-parallel trainers).
 
@@ -383,9 +412,33 @@ def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
     process-wide ``ray_tpu.ops.flash_ce.ce_config``); ``mesh`` gates
     the Pallas paths to single-device meshes (a ``pallas_call`` has no
     SPMD rule, so on a sharded mesh the XLA formulations run instead —
-    lifting that with a shard_map wrapper is an open item)."""
+    lifting that with a shard_map wrapper is an open item).
+
+    ``norm_scale``: when given, ``x`` is the RAW residual stream (the
+    final hidden *before* ``ln_f``) and the norm fuses into the
+    flash-CE vocab-matmul prologue (``flash_ce.flash_ce_norm_sum``) —
+    the normed tensor never materializes and the norm-scale grad comes
+    back through per-row-block partials.  If the fused gate declines,
+    the norm runs here in XLA and the regular CE dispatch follows (the
+    loud end of the fallback chain — ``ce/norm_xla`` in timelines)."""
     B, S, d = x.shape
+    n_dev = getattr(mesh, "size", 1) if mesh is not None else 1
     with jax.named_scope("gpt/ce"):
+        if norm_scale is not None:
+            from ray_tpu.ops import flash_ce
+            # enabled=True: passing norm_scale IS the caller's knob
+            # decision — only the kernel-capability half re-gates here
+            if flash_ce.uses_flash_ce_norm(
+                    B * S, d, cfg.vocab_size, mode=ce_mode,
+                    n_devices=n_dev, norm=cfg.norm,
+                    has_bias=cfg.use_bias, enabled=True):
+                s, n = flash_ce.flash_ce_norm_sum(
+                    x.reshape(B * S, d), lm_head(params, cfg),
+                    targets.reshape(B * S), norm_scale,
+                    eps=norm_eps(cfg))
+                return s / jnp.maximum(n, 1.0)
+            x = _norm(x, norm_scale, cfg.norm,
+                      bias=params.get("ln_f_b"), eps=norm_eps(cfg))
         s, n = _chunked_ce(x.reshape(B * S, d), lm_head(params, cfg),
                            targets.reshape(B * S),
                            chunk=getattr(cfg, "ce_chunk", _CE_CHUNK),
@@ -394,11 +447,18 @@ def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
 
 
 def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
-                   attn_fn: Optional[Callable] = None, mesh=None):
+                   attn_fn: Optional[Callable] = None, mesh=None,
+                   fuse_norm: Optional[bool] = None,
+                   final_norm: bool = True):
     """tokens [B, S] int32 -> (final hidden [B, S, d], moe aux loss).
 
     ``attn_fn(q, k, v) -> out`` defaults to causal local attention; pass a
     ring-attention fn (``make_ring_attention_fn``) for sp>1 meshes.
+
+    ``fuse_norm`` pins the fused norm epilogues (see ``layer_apply``);
+    ``final_norm=False`` skips the closing ``ln_f`` and returns the raw
+    residual stream — for ``loss_fn``'s fused-CE path, which computes
+    that norm inside the vocab-matmul kernel instead.
     """
     B, S = tokens.shape
     if attn_fn is None:
@@ -409,7 +469,8 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
 
     def layer_body(x, lp):
         return layer_apply(lp, x, cfg, positions=positions,
-                           attn_fn=attn_fn, mesh=mesh)
+                           attn_fn=attn_fn, mesh=mesh,
+                           fuse_norm=fuse_norm)
 
     if cfg.remat:
         layer_body = jax.checkpoint(layer_body)
@@ -419,14 +480,14 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
             lp = jax.tree.map(lambda a: a[i], params["layers"])
             x, aux = layer_body(x, lp)
             aux_total = aux_total + aux
+    else:
+        x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
+                            params["layers"])
+        aux_total = jnp.sum(auxes)
+    if final_norm:
         x = _norm(x, params["ln_f"], cfg.norm,
                   bias=params.get("ln_f_b"), eps=norm_eps(cfg))
-        return x, aux_total
-    x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
-                        params["layers"])
-    x = _norm(x, params["ln_f"], cfg.norm, bias=params.get("ln_f_b"),
-              eps=norm_eps(cfg))
-    return x, jnp.sum(auxes)
+    return x, aux_total
 
 
 def lm_head(params, cfg: GPTConfig):
@@ -435,11 +496,12 @@ def lm_head(params, cfg: GPTConfig):
 
 
 def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
-            attn_fn: Optional[Callable] = None, mesh=None):
+            attn_fn: Optional[Callable] = None, mesh=None,
+            fuse_norm: Optional[bool] = None):
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
     x, aux = forward_hidden(params, tokens, cfg, attn_fn=attn_fn,
-                            mesh=mesh)
+                            mesh=mesh, fuse_norm=fuse_norm)
     logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params, cfg))
     logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits.astype(jnp.float32), aux
@@ -512,12 +574,28 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK, mesh=None,
 
 
 def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
-            aux_weight: float = 0.01, ce_mode: Optional[str] = None):
-    """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss."""
+            aux_weight: float = 0.01, ce_mode: Optional[str] = None,
+            fuse_norm: Optional[bool] = None):
+    """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss.
+
+    ``fuse_norm`` pins the fused norm epilogues (default:
+    ``RAY_TPU_FUSE_NORM``): the per-layer out-proj epilogue in
+    ``layer_apply``, plus — when the flash-CE-with-norm gate passes —
+    skipping the XLA ``ln_f`` entirely and folding it into the
+    vocab-matmul kernel's prologue."""
+    from ray_tpu.ops import flash_ce
+    B, S = batch["tokens"].shape
+    n_dev = getattr(mesh, "size", 1) if mesh is not None else 1
+    ce_norm = flash_ce.uses_flash_ce_norm(
+        B * S, cfg.d_model, cfg.vocab_size, mode=ce_mode,
+        n_devices=n_dev, norm=cfg.norm, has_bias=cfg.use_bias,
+        enabled=fuse_norm)
     x, aux = forward_hidden(params, batch["tokens"], cfg, attn_fn=attn_fn,
-                            mesh=mesh)
-    loss = loss_from_hidden(params, x, batch["targets"], cfg, mesh=mesh,
-                            ce_mode=ce_mode)
+                            mesh=mesh, fuse_norm=fuse_norm,
+                            final_norm=not ce_norm)
+    loss = loss_from_hidden(
+        params, x, batch["targets"], cfg, mesh=mesh, ce_mode=ce_mode,
+        norm_scale=params["ln_f"] if ce_norm else None)
     return loss + aux_weight * aux
 
 

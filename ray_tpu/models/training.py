@@ -96,6 +96,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     ce_mode: Optional[str] = None,
                     comm_mode: Optional[str] = None,
                     comm_quant: Optional[str] = None,
+                    fuse_norm: Optional[bool] = None,
                     telemetry: Optional[bool] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
@@ -120,7 +121,13 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     block-scaled int8 (``ray_tpu.quant``, stochastic-rounding ring RS);
     it is dropped loudly when the effective comm_mode is "gspmd"
     (GSPMD owns its collectives), and the effective value is returned
-    as ``fns["comm_quant"]``.  The overlap step/loss
+    as ``fns["comm_quant"]``.  ``fuse_norm`` pins the fused norm
+    epilogues ("on"/"off" via bool; default:
+    ``ray_tpu.ops.fused_norm.fuse_config`` from ``RAY_TPU_FUSE_NORM``)
+    — the out-proj residual/norm epilogue kernel in every block and
+    the ``ln_f``-in-flash-CE prologue, both of which decline loudly
+    (reasoned gates) on sharded meshes and unsupported shapes.
+    The overlap step/loss
     use their own block formulation (einsum attention, vocab-parallel
     CE), so ``attn_pack2``/``ce_mode`` only affect the GSPMD-side
     ``forward_fn`` there.  ``telemetry`` (default: env
@@ -178,7 +185,8 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
 
     def loss(params, batch):
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
-                               mesh=mesh, ce_mode=ce_mode)
+                               mesh=mesh, ce_mode=ce_mode,
+                               fuse_norm=fuse_norm)
 
     overlap_fns = (ovl.build_overlap_step_fns(cfg, mesh, quant=comm_quant)
                    if comm_mode == "overlap" else None)
@@ -219,7 +227,8 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                        out_shardings=None)
     def forward_logits(params, batch):
         logits, _ = gpt_mod.forward(params, batch["tokens"], cfg,
-                                    attn_fn=attn_fn, mesh=mesh)
+                                    attn_fn=attn_fn, mesh=mesh,
+                                    fuse_norm=fuse_norm)
         return logits
 
     fns = {
@@ -297,9 +306,15 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
 
         def stage_fn(sp, a):
             def body(c, lp):
+                # fuse_norm pinned off: this body traces inside
+                # pipeline_apply's shard_map with no mesh in scope, so
+                # the epilogue gate would see n_devices=1 and put a
+                # pallas_call (no SPMD rule) under the multi-chip
+                # pipeline at lane-aligned shapes
                 y, _aux = gpt_mod.layer_apply(lp, c, cfg,
                                               positions=positions,
-                                              attn_fn=attn)
+                                              attn_fn=attn,
+                                              fuse_norm=False)
                 return y, None
             if cfg.remat:
                 body = jax.checkpoint(body)

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -42,12 +41,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
-STATS_LANES = 8   # lse/delta stored [B, H, num_q, bq, 8] for tiling
-
-# jax renamed TPUCompilerParams -> CompilerParams around 0.5; support both
-_CompilerParams = getattr(pltpu, "CompilerParams", None) \
-    or getattr(pltpu, "TPUCompilerParams")
+# shared kernel infrastructure lives in ops/substrate.py (one home for
+# the interpret policy, the CompilerParams rename shim, the lane-padded
+# row-stats convention, and env-knob readers); the historical private
+# names stay importable — flash_ce/tests grew up on them
+from ray_tpu.ops.substrate import (NEG_INF as _NEG_INF, STATS_LANES,
+                                   CompilerParams as _CompilerParams,
+                                   env_flag, env_int,
+                                   use_interpret as _use_interpret)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,19 +84,14 @@ def attention_config(refresh: bool = False) -> AttentionConfig:
     drivers that flip flags after import."""
     global _CONFIG
     if _CONFIG is None or refresh:
-        env = os.environ.get
         _CONFIG = AttentionConfig(
-            bwd_block_q=int(env("RAY_TPU_ATTN_BWD_BQ", "512")),
-            bwd_block_k=int(env("RAY_TPU_ATTN_BWD_BK", "512")),
-            pack2=env("RAY_TPU_ATTN_PACK2", "1") != "0",
-            pack2_block_q=int(env("RAY_TPU_ATTN_PACK2_BQ", "512")),
-            pack2_block_k=int(env("RAY_TPU_ATTN_PACK2_BK", "512")),
+            bwd_block_q=env_int("RAY_TPU_ATTN_BWD_BQ", 512),
+            bwd_block_k=env_int("RAY_TPU_ATTN_BWD_BK", 512),
+            pack2=env_flag("RAY_TPU_ATTN_PACK2"),
+            pack2_block_q=env_int("RAY_TPU_ATTN_PACK2_BQ", 512),
+            pack2_block_k=env_int("RAY_TPU_ATTN_PACK2_BK", 512),
         )
     return _CONFIG
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------------------

@@ -64,7 +64,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -73,10 +72,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # one home for the Pallas infrastructure shims: the jax-version
-# CompilerParams rename shim, interpret-mode policy, and the lane-padded
-# row-stats convention are shared with the attention kernels
-from ray_tpu.ops.attention import (_NEG_INF, STATS_LANES,
-                                   _CompilerParams, _use_interpret)
+# CompilerParams rename shim, interpret-mode policy, lane-padded
+# row-stats convention, block resolution and env-knob readers are
+# shared with the attention / fused-norm kernels via the substrate
+from ray_tpu.ops.substrate import (NEG_INF as _NEG_INF, STATS_LANES,
+                                   CompilerParams as _CompilerParams,
+                                   Support, env_int, env_str,
+                                   resolve_blocks,
+                                   stats_in as _stats_in, supported,
+                                   unsupported,
+                                   use_interpret as _use_interpret)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,19 +120,14 @@ def ce_config(refresh: bool = False) -> CEConfig:
     drivers that flip flags after import."""
     global _CONFIG
     if _CONFIG is None or refresh:
-        env = os.environ.get
         _CONFIG = CEConfig(
-            mode=env("RAY_TPU_CE", "flash"),
-            block_n=int(env("RAY_TPU_CE_BN", "1024")),
-            block_v=int(env("RAY_TPU_CE_BV", "1024")),
-            bwd_block_n=int(env("RAY_TPU_CE_BWD_BN", "1024")),
-            bwd_block_v=int(env("RAY_TPU_CE_BWD_BV", "512")),
+            mode=env_str("RAY_TPU_CE", "flash"),
+            block_n=env_int("RAY_TPU_CE_BN", 1024),
+            block_v=env_int("RAY_TPU_CE_BV", 1024),
+            bwd_block_n=env_int("RAY_TPU_CE_BWD_BN", 1024),
+            bwd_block_v=env_int("RAY_TPU_CE_BWD_BV", 512),
         )
     return _CONFIG
-
-
-def _round_up(n: int, m: int) -> int:
-    return -(-n // m) * m
 
 
 def supports(N: int, d: int, V: int) -> bool:
@@ -155,29 +155,29 @@ def uses_flash_ce(N: int, d: int, V: int, *,
     return mode == "flash" and n_devices <= 1 and supports(N, d, V)
 
 
-def _blocks(N: int, V: int, block_n: int, block_v: int):
-    """Resolve (bn, bv, Np, Vp): actual block sizes and padded dims.
-
-    Blocks shrink to the (tile-aligned) problem size for small shapes;
-    otherwise N/V round up to the block grid and the wrappers pad."""
-    bn = min(block_n, _round_up(N, 16))
-    bv = min(block_v, _round_up(V, 128))
-    return bn, bv, _round_up(N, bn), _round_up(V, bv)
-
-
-def _stats_in(a, num_n: int, bn: int):
-    """[Np] -> [num_n, bn, STATS_LANES] lane-broadcast stats layout."""
-    return jnp.broadcast_to(a[:, None], (num_n * bn, STATS_LANES)) \
-        .reshape(num_n, bn, STATS_LANES)
+# block resolution and the lane-broadcast stats layout are the
+# substrate's resolve_blocks/stats_in (this module wrote the originals;
+# the alias keeps the call sites unchanged)
+_blocks = resolve_blocks
 
 
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, true_ref,
-                m_sc, l_sc, t_sc, *, block_n: int, block_v: int,
-                num_v: int, v_real: Optional[int]):
+def _fwd_kernel(x_ref, h_ref, tgt_ref, *rest, block_n: int, block_v: int,
+                num_v: int, v_real: Optional[int],
+                norm_eps: Optional[float] = None):
+    """``norm_eps`` (static): the final-norm prologue — ``x_ref`` holds
+    the *raw* residual stream and the kernel computes
+    ``y = rmsnorm(x) * scale`` once per row block (at ``j == 0``, into
+    VMEM scratch every vocab tile then reuses), emitting the ``rstd``
+    statistics as an extra ``[N]``-sized residual.  The norm work rides
+    the matmul sweep instead of running as its own XLA fusion."""
+    if norm_eps is not None:
+        s_ref, lse_ref, true_ref, rstd_ref, m_sc, l_sc, t_sc, y_sc = rest
+    else:
+        lse_ref, true_ref, m_sc, l_sc, t_sc = rest
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -185,9 +185,17 @@ def _fwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, true_ref,
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
         t_sc[:] = jnp.zeros_like(t_sc)
+        if norm_eps is not None:
+            r32 = x_ref[...].astype(jnp.float32)
+            rstd = jax.lax.rsqrt(
+                jnp.mean(r32 * r32, -1, keepdims=True) + norm_eps)
+            y_sc[...] = (r32 * rstd * s_ref[...].astype(jnp.float32)
+                         ).astype(y_sc.dtype)
+            rstd_ref[0] = jnp.broadcast_to(rstd, rstd_ref.shape[1:])
 
+    x = x_ref[...] if norm_eps is None else y_sc[...]
     s = jax.lax.dot_general(
-        x_ref[...], h_ref[...], (((1,), (0,)), ((), ())),
+        x, h_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)              # [bn, bv]
     col = (j * block_v
            + jax.lax.broadcasted_iota(jnp.int32, (block_n, block_v), 1))
@@ -212,9 +220,14 @@ def _fwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, true_ref,
         true_ref[0] = jnp.broadcast_to(t_sc[:, :1], true_ref.shape[1:])
 
 
-def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int):
+def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int,
+                norm=None):
     """x [N, d], head [d, V], targets [N] int32 (-1 = masked) ->
-    (lse [N] f32, true_logit [N] f32) with no [N, V] materialization."""
+    (lse [N] f32, true_logit [N] f32) with no [N, V] materialization.
+
+    ``norm``: optional ``(scale [d], eps)`` — the final-norm prologue;
+    ``x`` is then the raw residual stream and the return gains
+    ``rstd [N] f32``."""
     N, d = x.shape
     V = head.shape[1]
     bn, bv, Np, Vp = _blocks(N, V, block_n, block_v)
@@ -229,10 +242,20 @@ def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int):
     stats_spec = pl.BlockSpec((1, bn, STATS_LANES), lambda i, j: (i, 0, 0))
     stats_shape = jax.ShapeDtypeStruct((num_n, bn, STATS_LANES),
                                        jnp.float32)
-    lse, true = pl.pallas_call(
+    norm_args, norm_in, norm_out, norm_shape, norm_sc = \
+        (), [], [], [], []
+    if norm is not None:
+        scale, eps = norm
+        norm_args = (scale[None, :],)
+        norm_in = [pl.BlockSpec((1, d), lambda i, j: (0, 0))]
+        norm_out = [stats_spec]
+        norm_shape = [stats_shape]
+        norm_sc = [pltpu.VMEM((bn, d), x.dtype)]
+    out = pl.pallas_call(
         functools.partial(_fwd_kernel, block_n=bn, block_v=bv,
                           num_v=num_v,
-                          v_real=V if Vp != V else None),
+                          v_real=V if Vp != V else None,
+                          norm_eps=norm[1] if norm else None),
         grid=(num_n, num_v),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -240,18 +263,20 @@ def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int):
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
             pl.BlockSpec((d, bv), lambda i, j: (0, j)),
             stats_spec,
+            *norm_in,
         ],
-        out_specs=[stats_spec, stats_spec],
-        out_shape=[stats_shape, stats_shape],
+        out_specs=[stats_spec, stats_spec, *norm_out],
+        out_shape=[stats_shape, stats_shape, *norm_shape],
         scratch_shapes=[
             pltpu.VMEM((bn, 128), jnp.float32),
             pltpu.VMEM((bn, 128), jnp.float32),
             pltpu.VMEM((bn, 128), jnp.float32),
+            *norm_sc,
         ],
         interpret=_use_interpret(),
-    )(x, head, tstats)
-    return (lse[:, :, 0].reshape(Np)[:N],
-            true[:, :, 0].reshape(Np)[:N])
+    )(x, head, tstats, *norm_args)
+    flat = tuple(o[:, :, 0].reshape(Np)[:N] for o in out)
+    return flat          # (lse, true[, rstd])
 
 
 # ---------------------------------------------------------------------------
@@ -259,15 +284,35 @@ def _fwd_pallas(x, head, targets, *, block_n: int, block_v: int):
 # ---------------------------------------------------------------------------
 
 def _bwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, srow_ref,
-                dx_ref, dhp_ref, dx_sc, *, block_n: int, block_v: int,
-                num_v: int, v_real: Optional[int]):
+                *rest, block_n: int, block_v: int,
+                num_v: int, v_real: Optional[int],
+                norm_eps: Optional[float] = None):
+    """``norm_eps`` (static): the final-norm prologue's backward —
+    ``x_ref`` holds the raw residual stream, the normed ``y`` is
+    recomputed into VMEM scratch from the saved ``rstd`` (both matmuls
+    contract against it), and at the end of the vocab sweep the
+    accumulated ``dy`` takes the norm backward *in-kernel*: ``dx``
+    becomes the residual-stream gradient and the norm-scale gradient
+    is emitted as a per-row-block ``[d]`` partial (summed in one XLA
+    pass by the wrapper) — no standalone ``[d]``-output reduction
+    dispatch survives."""
+    if norm_eps is not None:
+        (s_ref, rstd_ref, dx_ref, dhp_ref, dsp_ref,
+         dx_sc, y_sc) = rest
+    else:
+        dx_ref, dhp_ref, dx_sc = rest
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
         dx_sc[:] = jnp.zeros_like(dx_sc)
+        if norm_eps is not None:
+            r32 = x_ref[...].astype(jnp.float32)
+            rstd = rstd_ref[0][:, 0:1]
+            y_sc[...] = (r32 * rstd * s_ref[...].astype(jnp.float32)
+                         ).astype(y_sc.dtype)
 
-    x = x_ref[...]                                       # [bn, d]
+    x = x_ref[...] if norm_eps is None else y_sc[...]    # [bn, d]
     h = h_ref[...]                                       # [d, bv]
     s = jax.lax.dot_general(
         x, h, (((1,), (0,)), ((), ())),
@@ -291,26 +336,44 @@ def _bwd_kernel(x_ref, h_ref, tgt_ref, lse_ref, srow_ref,
 
     @pl.when(j == num_v - 1)
     def _finalize():
-        dx_ref[...] = dx_sc[:].astype(dx_ref.dtype)
+        if norm_eps is None:
+            dx_ref[...] = dx_sc[:].astype(dx_ref.dtype)
+        else:
+            dy = dx_sc[:]                                # [bn, d] f32
+            r32 = x_ref[...].astype(jnp.float32)
+            rstd = rstd_ref[0][:, 0:1]
+            xhat = r32 * rstd
+            dxhat = dy * s_ref[...].astype(jnp.float32)
+            m = jnp.mean(dxhat * xhat, -1, keepdims=True)
+            dx_ref[...] = (rstd * (dxhat - xhat * m)).astype(dx_ref.dtype)
+            dsp_ref[...] = jnp.sum(dy * xhat, 0, keepdims=True)
 
 
 def _bwd_pallas(x, head, targets, lse, gs, *, block_n: int,
-                block_v: int):
+                block_v: int, norm=None):
     """Strip-mined backward: (residuals, d(sum_nll)) -> (dx, dhead).
 
     dx accumulates across the vocab sweep in VMEM scratch; dhead is
     emitted as ``[num_n, d, V]`` per-row-block partials (each written
     exactly once, at matmul rate) and summed in one XLA pass — the
     write-once/read-once analogue of attention's dk/dv scratch, sized
-    for a head too large to ride along in VMEM."""
+    for a head too large to ride along in VMEM.
+
+    ``norm``: optional ``(scale [d], eps, rstd [N] f32)`` — the
+    final-norm prologue's backward; the return gains ``dscale [d]``
+    (from per-row-block partials, same one-XLA-pass sum as dhead) and
+    ``dx`` is the *residual-stream* gradient."""
     N, d = x.shape
     V = head.shape[1]
     bn, bv, Np, Vp = _blocks(N, V, block_n, block_v)
     num_n, num_v = Np // bn, Vp // bv
+    rstd = norm[2] if norm is not None else None
     if Np != N:
         x = jnp.pad(x, ((0, Np - N), (0, 0)))
         targets = jnp.pad(targets, (0, Np - N), constant_values=-1)
         lse = jnp.pad(lse, (0, Np - N))
+        if rstd is not None:
+            rstd = jnp.pad(rstd, (0, Np - N))
     if Vp != V:
         head = jnp.pad(head, ((0, 0), (0, Vp - V)))
     targets = targets.astype(jnp.int32)
@@ -321,10 +384,21 @@ def _bwd_pallas(x, head, targets, lse, gs, *, block_n: int,
     sstats = _stats_in(srow, num_n, bn)
 
     stats_spec = pl.BlockSpec((1, bn, STATS_LANES), lambda i, j: (i, 0, 0))
-    dx, dhp = pl.pallas_call(
+    norm_args, norm_in, norm_out, norm_shape, norm_sc = \
+        (), [], [], [], []
+    if norm is not None:
+        scale, eps = norm[0], norm[1]
+        norm_args = (scale[None, :], _stats_in(rstd, num_n, bn))
+        norm_in = [pl.BlockSpec((1, d), lambda i, j: (0, 0)),
+                   stats_spec]
+        norm_out = [pl.BlockSpec((1, d), lambda i, j: (i, 0))]
+        norm_shape = [jax.ShapeDtypeStruct((num_n, d), jnp.float32)]
+        norm_sc = [pltpu.VMEM((bn, d), x.dtype)]
+    out = pl.pallas_call(
         functools.partial(_bwd_kernel, block_n=bn, block_v=bv,
                           num_v=num_v,
-                          v_real=V if Vp != V else None),
+                          v_real=V if Vp != V else None,
+                          norm_eps=norm[1] if norm else None),
         grid=(num_n, num_v),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -334,20 +408,29 @@ def _bwd_pallas(x, head, targets, lse, gs, *, block_n: int,
             stats_spec,
             stats_spec,
             stats_spec,
+            *norm_in,
         ],
         out_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
             pl.BlockSpec((1, d, bv), lambda i, j: (i, 0, j)),
+            *norm_out,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Np, d), x.dtype),
             jax.ShapeDtypeStruct((num_n, d, Vp), head.dtype),
+            *norm_shape,
         ],
-        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32), *norm_sc],
         interpret=_use_interpret(),
-    )(x, head, tstats, lstats, sstats)
+    )(x, head, tstats, lstats, sstats, *norm_args)
+    dx, dhp = out[0], out[1]
     dhead = jnp.sum(dhp.astype(jnp.float32), axis=0)[:, :V]
-    return dx[:N], dhead.astype(head.dtype)
+    if norm is None:
+        return dx[:N], dhead.astype(head.dtype)
+    # per-row-block dscale partials summed in ONE XLA pass — this sum
+    # replaces the standalone [d]-output reduction dispatch
+    dscale = jnp.sum(out[2], axis=0)
+    return dx[:N], dhead.astype(head.dtype), dscale
 
 
 # ---------------------------------------------------------------------------
@@ -382,6 +465,106 @@ def _flash_ce_bwd(block_n, block_v, bwd_block_n, bwd_block_v, res, g):
 
 
 _flash_ce.defvjp(_flash_ce_fwd, _flash_ce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_ce_norm(x, head, targets, scale, eps, block_n, block_v,
+                   bwd_block_n, bwd_block_v):
+    out, _ = _flash_ce_norm_fwd(x, head, targets, scale, eps, block_n,
+                                block_v, bwd_block_n, bwd_block_v)
+    return out
+
+
+def _flash_ce_norm_fwd(x, head, targets, scale, eps, block_n, block_v,
+                       bwd_block_n, bwd_block_v):
+    lse, true, rstd = _fwd_pallas(x, head, targets, block_n=block_n,
+                                  block_v=block_v, norm=(scale, eps))
+    mask = (targets >= 0).astype(jnp.float32)
+    out = (jnp.sum((lse - true) * mask), jnp.sum(mask))
+    # residuals stay [N]-sized: the raw residual stream, the stats
+    # (lse + rstd) and the operands the grads contract against — the
+    # normed hidden is recomputed per tile, never saved
+    return out, (x, head, targets, scale, lse, rstd)
+
+
+def _flash_ce_norm_bwd(eps, block_n, block_v, bwd_block_n, bwd_block_v,
+                       res, g):
+    x, head, targets, scale, lse, rstd = res
+    gs, _ = g                                  # d/d(sum_nll); n is count
+    dx, dhead, dscale = _bwd_pallas(
+        x, head, targets, lse, jnp.asarray(gs),
+        block_n=bwd_block_n, block_v=bwd_block_v,
+        norm=(scale, eps, rstd))
+    return dx, dhead, None, dscale.astype(scale.dtype)
+
+
+_flash_ce_norm.defvjp(_flash_ce_norm_fwd, _flash_ce_norm_bwd)
+
+
+def uses_flash_ce_norm(N: int, d: int, V: int, *,
+                       mode: Optional[str] = None,
+                       n_devices: int = 1,
+                       norm: str = "rmsnorm",
+                       has_bias: bool = False,
+                       enabled: Optional[bool] = None) -> Support:
+    """Dispatch gate (with reason) for the final-norm-fused CE path.
+
+    The single source of the decision ``models.gpt.loss_fn`` makes
+    before skipping the XLA final norm — also the ``bench.py``
+    reporting mirror.  Requires the flash-CE path itself
+    (:func:`uses_flash_ce`'s conditions) plus the fused-norm knob and
+    a norm the prologue can fuse."""
+    from ray_tpu.ops.fused_norm import fuse_config
+    if enabled is None:
+        enabled = fuse_config().enabled
+    if not enabled:
+        return unsupported("disabled (RAY_TPU_FUSE_NORM=0)")
+    if norm != "rmsnorm":
+        return unsupported(f"norm={norm!r}: only rmsnorm fuses")
+    if has_bias:
+        return unsupported("bias norms (GPT-2 exact-architecture mode) "
+                           "stay on the XLA path")
+    if not uses_flash_ce(N, d, V, mode=mode, n_devices=n_devices):
+        return unsupported(
+            f"flash-CE path declined (mode={mode or ce_config().mode!r}, "
+            f"n_devices={n_devices}, N={N}, d={d}, V={V})")
+    return supported("flash-CE with fused final-norm prologue")
+
+
+def flash_ce_norm_sum(x, head, targets, norm_scale, *,
+                      eps: float = 1e-6,
+                      block_n: Optional[int] = None,
+                      block_v: Optional[int] = None,
+                      bwd_block_n: Optional[int] = None,
+                      bwd_block_v: Optional[int] = None):
+    """Final-norm-fused streamed-logits CE: ``(sum_nll, n_valid)``.
+
+    x [N, d] is the *raw* residual stream (the model's final hidden,
+    before its last norm); the kernel computes
+    ``rmsnorm(x) * norm_scale`` in the vocab matmul's prologue — the
+    normed tensor is never materialized in HBM, the norm statistics
+    ride as ``[N]``-sized residuals, and the norm-scale gradient comes
+    back through per-row-block partials.  Differentiable in
+    (x, head, norm_scale).  Shapes :func:`supports` declines fall back
+    to the unfused XLA formulation (norm then dense CE, same
+    numerics)."""
+    cfg = ce_config()
+    N, d = x.shape
+    V = head.shape[1]
+    if not supports(N, d, V):
+        with jax.named_scope("ce/norm_xla"):
+            x32 = x.astype(jnp.float32)
+            x32 = x32 * jax.lax.rsqrt(
+                jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+            y = (x32 * norm_scale.astype(jnp.float32)).astype(x.dtype)
+            return _xla_ce_sum(y, head, targets)
+    with jax.named_scope("ce/flash_norm"):
+        return _flash_ce_norm(x, head.astype(x.dtype), targets,
+                              norm_scale, eps,
+                              block_n or cfg.block_n,
+                              block_v or cfg.block_v,
+                              bwd_block_n or cfg.bwd_block_n,
+                              bwd_block_v or cfg.bwd_block_v)
 
 
 def _xla_ce_sum(x, head, targets):

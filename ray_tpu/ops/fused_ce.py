@@ -41,6 +41,22 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.substrate import Support, supported, unsupported
+
+
+def supports(N: int, d: int, V: int) -> Support:
+    """Dispatch gate (with reason) for the bf16-resident path.
+
+    Plain XLA, so unlike flash-CE there is no grid to tile and no
+    single-device gate — the one hard requirement is a real vocab axis
+    to reduce over.  Lives here so the substrate's reasoned-gate
+    convention covers every CE family member, not just the Pallas one."""
+    if N <= 0:
+        return unsupported(f"N={N} has no rows")
+    if V <= 1:
+        return unsupported(f"V={V} has no vocab axis to reduce")
+    return supported("bf16-resident XLA path (shards on any mesh)")
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=())
 def ce_sum_bf16(x, head, targets):

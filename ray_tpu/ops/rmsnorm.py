@@ -29,11 +29,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# interpret policy from the shared substrate — this module's private
+# copy was the drift example that motivated ops/substrate.py
+from ray_tpu.ops.substrate import use_interpret as _use_interpret
+
 _BLOCK_ROWS = 512
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _fwd_kernel(x_ref, s_ref, y_ref, rstd_ref, *, eps: float):

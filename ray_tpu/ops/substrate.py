@@ -1,0 +1,180 @@
+"""Shared substrate for the Pallas kernel families.
+
+Four kernel families grew up in this tree — flash attention (fwd/bwd +
+decode), two-head lane packing (pack2), flash-CE, and the fused norm
+epilogues — and by round 12 each carried its own copy of the same
+infrastructure: an interpret-mode policy, the jax-version
+``CompilerParams`` rename shim, lane-padded row-stats conventions,
+block/grid validation, env-knob config plumbing, and (in ``bench.py``)
+a hand-rolled compile-failure fallback ladder per kernel.  Copies
+drift; ``rmsnorm.py``'s private ``_use_interpret`` was the proof.
+
+This module is the single home for all of it.  A new kernel (quantized
+KV strips, ragged prefill, the next norm fusion) should be a page of
+code on top of these pieces, not a subsystem:
+
+- :func:`use_interpret` — the one interpret-mode policy (Pallas kernels
+  run interpreted off-TPU so the parity suite runs on CPU).
+- :data:`CompilerParams` — the ``TPUCompilerParams`` →
+  ``CompilerParams`` rename shim, resolved once.
+- :data:`NEG_INF` / :data:`STATS_LANES` — masking constant and the
+  lane-padded row-stats width shared by every online-softmax kernel.
+- :func:`round_up` / :func:`resolve_blocks` / :func:`stats_in` —
+  lane/sublane padding and the ``[num_n, bn, STATS_LANES]``
+  stats-block convention.
+- :class:`Support` — block/grid validation verdicts that carry a
+  *reason*, so dispatch gates can decline loudly and tests can assert
+  on why.
+- :func:`env_int` / :func:`env_str` / :func:`env_flag` — env-knob
+  readers for the per-family config dataclasses
+  (``attention_config()`` / ``ce_config()`` / ``fuse_config()``).
+- :func:`run_ladder` — the cumulative compile-failure fallback ladder
+  ``bench.py`` previously reimplemented per kernel: try the most
+  capable configuration, degrade loudly rung by rung on Mosaic
+  compile/run failures, never silently.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# masking constant for online-softmax kernels (finite: -inf would turn
+# fully-masked rows into NaN through exp/max arithmetic)
+NEG_INF = -1e30
+
+# per-row statistics (lse, delta, rstd, ...) are stored as
+# [.., rows, STATS_LANES] lane-broadcast blocks: a (rows, 8) block
+# satisfies the TPU tiling rule (sublane div 8, lane equal to array
+# dim) where a 1-D (rows,) column cannot
+STATS_LANES = 8
+
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; resolve
+# whichever this jaxlib ships, once, for every pallas_call in the tree
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
+def use_interpret() -> bool:
+    """Whether pallas_calls should run in interpret mode.
+
+    The one policy for every kernel family: interpret off-TPU so the
+    parity suite (and any CPU smoke run) executes the same kernel
+    bodies the chip will."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# lane/sublane padding helpers
+# ---------------------------------------------------------------------------
+
+def round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def resolve_blocks(N: int, V: int, block_n: int, block_v: int,
+                   *, row_align: int = 16,
+                   lane_align: int = 128) -> Tuple[int, int, int, int]:
+    """Resolve ``(bn, bv, Np, Vp)``: actual block sizes and padded dims.
+
+    Blocks shrink to the (tile-aligned) problem size for small shapes;
+    otherwise N/V round up to the block grid and the callers pad."""
+    bn = min(block_n, round_up(N, row_align))
+    bv = min(block_v, round_up(V, lane_align))
+    return bn, bv, round_up(N, bn), round_up(V, bv)
+
+
+def stats_in(a, num_n: int, bn: int):
+    """[Np] row stats -> [num_n, bn, STATS_LANES] lane-broadcast layout
+    (the input-side mirror of the kernels' stats output blocks)."""
+    return jnp.broadcast_to(a[:, None], (num_n * bn, STATS_LANES)) \
+        .reshape(num_n, bn, STATS_LANES)
+
+
+# ---------------------------------------------------------------------------
+# dispatch gates with reasons
+# ---------------------------------------------------------------------------
+
+class Support(NamedTuple):
+    """A dispatch-gate verdict that carries its reason.
+
+    Truthy iff the kernel path applies; ``reason`` states why not (or
+    which path was chosen) so fallbacks are loud and testable — the
+    dispatch tests assert on these strings, which keeps "silently took
+    the slow path" a failing state."""
+    ok: bool
+    reason: str = ""
+
+    def __bool__(self) -> bool:          # Support(...) gates directly
+        return self.ok
+
+
+def supported(reason: str = "") -> Support:
+    return Support(True, reason)
+
+
+def unsupported(reason: str) -> Support:
+    return Support(False, reason)
+
+
+# ---------------------------------------------------------------------------
+# env-knob config plumbing
+# ---------------------------------------------------------------------------
+
+def env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """Boolean env knob: unset -> ``default``; ``"0"`` is the one
+    falsey spelling (matches every existing ``RAY_TPU_*`` gate)."""
+    return os.environ.get(name, "1" if default else "0") != "0"
+
+
+# ---------------------------------------------------------------------------
+# compile-failure fallback ladder
+# ---------------------------------------------------------------------------
+
+def run_ladder(attempt: Callable[[Any], Any],
+               rungs: Sequence[Tuple[Optional[str], Any]],
+               *, log: Optional[Callable[[str], None]] = None
+               ) -> Tuple[Any, Any, List[str]]:
+    """Cumulative loud fallback ladder for Mosaic compile/run failures.
+
+    ``rungs`` is ``[(what, args), ...]``, most capable first — the
+    primary configuration (``what`` is ``None``) followed by the
+    fallback rungs, each isolating one suspect.  ``attempt(args)``
+    builds and warms one configuration, raising on failure.  Returns
+    ``(result, args, taken)`` where ``args`` is the configuration that
+    actually ran and ``taken`` lists the descriptions of every rung
+    that had to engage (empty = primary ran).
+
+    Every degradation is announced on stderr (or ``log``): a kernel
+    that cannot compile on new hardware must show up in the console and
+    the headline JSON, never as a silent perf/loss regression.
+    """
+    emit = log or (lambda msg: print(msg, file=sys.stderr))
+    remaining = list(rungs)
+    if not remaining:
+        raise ValueError("run_ladder needs at least the primary rung")
+    taken: List[str] = []
+    while True:
+        what, args = remaining.pop(0)
+        if what:
+            taken.append(what)
+        try:
+            return attempt(args), args, taken
+        except Exception as e:
+            if not remaining:
+                raise
+            emit(f"step failed to compile/run ({e!r}); "
+                 f"falling back: {remaining[0][0]}")

@@ -1,0 +1,142 @@
+"""Round-13 on-chip driver: fused norm epilogues A/B.
+
+Usage: python scratch/r13_fuse.py <variant>
+
+Variants:
+  fuse     — RAY_TPU_FUSE_NORM on vs off at the GPT-2 124M headline
+             recipe: steady step time (telemetry blocking-sync split),
+             final loss (must match to bf16 noise — the fusion is a
+             pure scheduling change), plus the isolated out-proj+norm
+             epilogue microbench (ray_perf --fuse-norm's arms).  The
+             claim under test is docs/PERF.md r13's ~2/3 of the 18 ms
+             dispatch-bound bullet.
+  subsmoke — substrate dispatch smoke: every kernel family reports its
+             gate + reason on the real backend at the headline shape
+             (pack2 / flash-CE / fused-norm epilogue / CE-norm
+             prologue / decode), then one fused train step runs to
+             prove the new kernels compile under Mosaic (the
+             interpret-mode parity suite cannot see Mosaic failures).
+
+Carried arms (no chip session yet; every r06-r12 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+prefix / evict plus all r6-r11 arms — delegated verbatim to
+scratch/r12_prefix.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "fuse"
+
+_R12_ARMS = ("prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R12_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r12_prefix.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r13_fuse.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("fuse", "subsmoke"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if on_tpu:
+    # the r05 headline recipe (see bench.py main): the A/B must move
+    # the same step the headline number comes from
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=False,
+                         unroll_layers=True, ce_chunk=-1)
+    batch, seq, steps = 24, 1024, 30
+else:
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=64, dtype=jnp.float32)
+    batch, seq, steps = 2, 64, 4
+
+mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+batch_data = training.synthetic_lm_batch(
+    jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+
+
+def run_arm(fuse):
+    fns = training.build_gpt_train(cfg, mesh, fuse_norm=fuse,
+                                   telemetry=True)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    for _ in range(2):                      # compile + settle
+        state, metrics = fns["step_fn"](state, batch_data)
+        float(metrics["loss"])
+    raw_step = fns.get("raw_step_fn", fns["step_fn"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = raw_step(state, batch_data)
+    float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    for _ in range(3):                      # telemetry window
+        state, metrics = fns["step_fn"](state, batch_data)
+    tel = fns["telemetry"].summary() if "telemetry" in fns else {}
+    return {
+        "arm": f"fuse_norm-{'on' if fuse else 'off'}",
+        "fuse_norm": fuse,
+        "step_ms": round(dt * 1e3, 3),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "final_loss": round(float(metrics["loss"]), 4),
+        "steady_step_s": tel.get("steady_step_s"),
+        "steady_dispatch_s": tel.get("steady_dispatch_s"),
+        "mfu": tel.get("mfu"),
+    }
+
+
+if VARIANT == "fuse":
+    for fuse in (False, True):
+        print(json.dumps(run_arm(fuse)), flush=True)
+    from ray_tpu._private.ray_perf import fused_norm_perf
+    for fused in (True, False):
+        comp = fused_norm_perf(n_tokens=batch * seq, heads=cfg.n_heads,
+                               head_dim=cfg.head_dim,
+                               d_model=cfg.d_model, fused=fused)
+        comp["arm"] = f"epilogue-microbench-fused-{fused}"
+        print(json.dumps(comp), flush=True)
+    sys.exit(0)
+
+# subsmoke — every family's dispatch gate + reason on this backend,
+# then one fused step so a Mosaic compile failure surfaces here, not
+# in the paid headline run
+from ray_tpu.ops.attention import decode_supports, uses_pack2  # noqa: E402
+from ray_tpu.ops.flash_ce import uses_flash_ce, uses_flash_ce_norm  # noqa: E402
+from ray_tpu.ops.fused_norm import out_proj_norm_plan  # noqa: E402
+
+N, K, d, V = batch * seq, cfg.n_heads * cfg.head_dim, cfg.d_model, \
+    cfg.vocab_size
+gates = {
+    "backend": jax.default_backend(),
+    "attn_pack2": bool(uses_pack2(seq, seq, cfg.n_heads, cfg.head_dim)),
+    "flash_ce": bool(uses_flash_ce(N, d, V)),
+    "decode": bool(decode_supports(cfg.max_seq, cfg.head_dim)),
+}
+for name, plan in (
+        ("out_proj_norm", out_proj_norm_plan(N, K, d, norm=cfg.norm,
+                                             has_bias=cfg.use_bias,
+                                             seq=seq)),
+        ("ce_norm", uses_flash_ce_norm(N, d, V, norm=cfg.norm,
+                                       has_bias=cfg.use_bias))):
+    gates[name] = {"ok": bool(plan), "reason": plan.reason}
+print(json.dumps(gates), flush=True)
+arm = run_arm(True)
+arm["arm"] = "subsmoke-fused-step"
+print(json.dumps(arm), flush=True)

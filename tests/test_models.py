@@ -128,3 +128,69 @@ def test_unrolled_layers_match_scan():
         losses.append(float(gpt.loss_fn(params, batch, cfg)))
     assert abs(losses[0] - losses[1]) < 1e-4
     assert abs(losses[0] - losses[2]) < 1e-4
+
+
+def _fuse_norm_parity_cfg():
+    """A shape where BOTH r13 fusions engage (d_model % 128 == 0 so
+    the out-proj epilogue tiles, flash-CE supported so ln_f fuses into
+    the vocab-matmul prologue) — asserted, or the parity tests prove
+    nothing."""
+    from ray_tpu.ops import flash_ce, fused_norm
+
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                    max_seq=32, dtype=jnp.float32)
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 2, 32,
+                                        cfg.vocab_size)
+    assert fused_norm.out_proj_norm_plan(2 * 32, 128, 128, seq=32,
+                                         enabled=True)
+    assert flash_ce.uses_flash_ce_norm(2 * 32, 128, 512, enabled=True)
+    return cfg, batch
+
+
+def test_gpt_train_fuse_norm_parity():
+    """r13 acceptance: loss/grad parity of the exact loss closure
+    build_gpt_train compiles — including the norm-scale grads
+    (ln1/ln2/ln_f) that come back through the fused kernels'
+    per-row-block partials — with RAY_TPU_FUSE_NORM pinned on vs
+    off."""
+    import numpy as np
+
+    from ray_tpu.models import gpt
+
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    cfg, batch = _fuse_norm_parity_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    grads, losses = {}, {}
+    for fuse in (True, False):
+        losses[fuse], grads[fuse] = jax.value_and_grad(
+            lambda p: gpt.loss_fn(p, batch, cfg, mesh=mesh,
+                                  fuse_norm=fuse))(params)
+    assert float(losses[True]) == pytest.approx(float(losses[False]),
+                                                abs=2e-5)
+    leaves_with_path = getattr(jax.tree, "leaves_with_path",
+                               jax.tree_util.tree_leaves_with_path)
+    for (path, a), b in zip(leaves_with_path(grads[True]),
+                            jax.tree.leaves(grads[False])):
+        na, nb = np.asarray(a), np.asarray(b)
+        denom = max(1e-8, float(np.abs(nb).max()))
+        err = float(np.abs(na - nb).max()) / denom
+        assert err < 1e-4, (jax.tree_util.keystr(path), err)
+
+
+@pytest.mark.slow  # two extra full train-step jits; grads covered above
+def test_gpt_train_fuse_norm_parity_through_builder():
+    """The same on/off parity through build_gpt_train(fuse_norm=...)'s
+    jitted step: identical loss and grad-norm metrics from the same
+    init."""
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    cfg, batch = _fuse_norm_parity_cfg()
+    metrics = {}
+    for fuse in (True, False):
+        fns = training.build_gpt_train(cfg, mesh, fuse_norm=fuse,
+                                       telemetry=False)
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        _, metrics[fuse] = fns["step_fn"](state, batch)
+    assert float(metrics[True]["loss"]) == pytest.approx(
+        float(metrics[False]["loss"]), abs=2e-5)
+    assert float(metrics[True]["grad_norm"]) == pytest.approx(
+        float(metrics[False]["grad_norm"]), rel=1e-4)
