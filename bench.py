@@ -382,6 +382,85 @@ def bench_infer():
     print(json.dumps(result))
 
 
+def bench_rl():
+    """RL-loop headline: open-loop actor/learner co-run.
+
+    ``python bench.py --rl``.  Runs the closed train<->infer loop
+    (``ray_tpu.rl.run_rl_loop``: rollout actors over the inference
+    engine, a REINFORCE/RLOO learner derived from
+    ``build_gpt_rl_train``, versioned weight publications, bounded
+    staleness) and prints ONE JSON line — rollout tokens/s as the
+    headline value, learner steps/s, weight-publish latency, mean/max
+    param-version lag, the end-to-end reward curve over the run (the
+    policy-improvement proof riding the artifact), and the actors'
+    compile counters (weight publication must show zero steady-state
+    recompiles).  Knobs come from ``RAY_TPU_RL_*`` (``rl_config``);
+    ``scratch/r14_rl.py`` automates the on-chip A/B arms.  On CPU the
+    model shrinks to a smoke configuration (numbers exercise the loop,
+    not the hardware).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.rl import rl_config, run_rl_loop
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    rlcfg = rl_config()
+    if quick:
+        cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=128, dtype=jnp.float32)
+        steps, lr = 10, 2e-2
+        engine_kwargs = {"slots": max(rlcfg.batch, 2), "page_size": 16,
+                         "buckets": (32,)}
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        steps, lr = 30, 1e-4
+        engine_kwargs = {}
+    result = run_rl_loop(cfg, steps=steps, rlcfg=rlcfg, seed=1, lr=lr,
+                         engine_kwargs=engine_kwargs)
+    tel = result["telemetry"]
+    curve = result["reward_curve"]
+    third = max(len(curve) // 3, 1)
+    record = {
+        "metric": "gpt_rl_rollout_tokens_per_sec",
+        "value": round(tel.get("rollout_tokens_per_sec", 0.0), 1),
+        "unit": "tokens/s",
+        "platform": platform,
+        "model_params": None if quick else 124_000_000,
+        "learner_steps": result["steps"],
+        "learner_steps_per_sec": round(
+            tel.get("learner_steps_per_sec", 0.0), 3),
+        "publish_s": round(tel.get("publish_s", 0.0), 5),
+        "version_lag_mean": tel.get("version_lag_mean", 0.0),
+        "version_lag_max": tel.get("version_lag_max", 0),
+        "drops_stale": result["drops_stale"],
+        "drops_overflow": result["drops_overflow"],
+        "actors": rlcfg.actors,
+        "rollout_batch": rlcfg.batch,
+        "horizon": rlcfg.horizon,
+        "baseline": rlcfg.baseline,
+        "publish_every": rlcfg.publish_every,
+        "param_version": result["param_version"],
+        "reward_curve": [round(float(r), 4) for r in curve],
+        "reward_first_third": round(float(
+            sum(curve[:third]) / third), 4),
+        "reward_last_third": round(float(
+            sum(curve[-third:]) / third), 4),
+        # the zero-recompile claim across every weight publication, in
+        # the artifact: each actor compiled at most once per bucket +
+        # once for decode, replicas after the first compiled nothing
+        "engine_compiles": [s["compiles"]
+                            for s in result["engine_stats"]],
+        "telemetry": tel,
+    }
+    print(json.dumps(record))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -392,6 +471,9 @@ def main():
 
     if "--infer" in sys.argv:
         bench_infer()
+        return
+    if "--rl" in sys.argv:
+        bench_rl()
         return
     mesh_arg = _mesh_arg()
     if mesh_arg is not None:

@@ -13,7 +13,8 @@ streams tokens through ``handle_request_streaming``
 
 from ray_tpu.inference.config import (InferConfig,  # noqa: F401
                                       infer_config, default_buckets)
-from ray_tpu.inference.engine import InferenceEngine  # noqa: F401
+from ray_tpu.inference.engine import (InferenceEngine,  # noqa: F401
+                                      StepEvent)
 from ray_tpu.inference.kv_cache import (KVCache,  # noqa: F401
                                         PageAllocator, PrefixIndex)
 from ray_tpu.inference.sampling import SamplingParams  # noqa: F401
@@ -22,6 +23,7 @@ from ray_tpu.inference.scheduler import (QueueFullError,  # noqa: F401
 
 __all__ = [
     "InferConfig", "infer_config", "default_buckets",
-    "InferenceEngine", "KVCache", "PageAllocator", "PrefixIndex",
+    "InferenceEngine", "StepEvent", "KVCache", "PageAllocator",
+    "PrefixIndex",
     "SamplingParams", "QueueFullError", "Request", "SlotScheduler",
 ]

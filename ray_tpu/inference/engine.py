@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -56,10 +56,30 @@ from jax import lax
 
 from ray_tpu.inference import kv_cache as kvc
 from ray_tpu.inference.config import default_buckets, infer_config
-from ray_tpu.inference.sampling import SamplingParams, sample_tokens
+from ray_tpu.inference.sampling import (SamplingParams,
+                                        sample_tokens_logprobs)
 from ray_tpu.inference.scheduler import Request, SlotScheduler
 from ray_tpu.models import gpt as gpt_mod
 from ray_tpu.ops.attention import _NEG_INF
+
+
+class StepEvent(tuple):
+    """One ``step()`` event: unpacks and compares as the classic
+    ``(rid, token, done)`` 3-tuple, with the sampled token's model
+    logprob riding along as an attribute (``ev.logprob``) so logprob
+    consumers (the serve stream's ``logprobs`` option, the RL rollout
+    actors) don't force a tuple-shape change on every caller."""
+
+    def __new__(cls, rid: int, token: int, done: bool, logprob: float):
+        self = super().__new__(cls, (rid, token, done))
+        self.logprob = logprob
+        return self
+
+    def __getnewargs__(self):
+        # tuple's default reduce would replay __new__ with the bare
+        # 3-tuple; events cross process boundaries here (object store,
+        # remote rollout actors), so pickle must carry all four args
+        return (self[0], self[1], self[2], self.logprob)
 
 
 def _cached_context_attention(q, kctx, vctx, ks, vs, cached_len,
@@ -196,6 +216,11 @@ class InferenceEngine:
         self._next_rid = 0
         self._cancelled: set = set()
         self._lock = threading.Lock()   # submit() vs step() admissions
+        # versioned params (the RL weight-publication contract): the
+        # construction snapshot is version 0 and may alias caller-held
+        # arrays, so the first set_params() does not delete it
+        self.param_version = 0
+        self._owns_params = False
         self.debug_logits = debug_logits
         # rid -> [logits row per generated token], appended in event
         # order (parity tests only; off by default)
@@ -272,6 +297,50 @@ class InferenceEngine:
                 req.done = True
                 self._requests.pop(req.rid, None)
 
+    def set_params(self, params, *, version: Optional[int] = None) -> int:
+        """Hot-swap the engine's parameters to a new snapshot.
+
+        ``params`` is a *host-side* pytree (the object-store snapshot
+        form the RL learner publishes — numpy leaves); it is copied to
+        the device and the **previous** snapshot's buffers are deleted
+        eagerly (the donated-buffer swap: steady-state weight
+        publication holds one resident copy plus the in-flight
+        transfer, never an unbounded trail of dead snapshots waiting
+        for GC).  Params are call arguments of the AOT executables, so
+        a swap at unchanged shapes/dtypes costs **zero recompiles** —
+        the compile counters are the acceptance test.
+
+        Like :meth:`cancel`'s contract, the swap must not race a
+        concurrent :meth:`step`: call it between engine ticks (the RL
+        rollout actors swap between ``generate()`` calls; a serve
+        replica would route it through the pump's executor thread).
+
+        The swap also **invalidates the prefix cache**: registered
+        pages hold K/V computed under the old params, and the index
+        is keyed by token content alone — without the flush, a
+        post-swap request sharing a cached prefix would attend over
+        stale context and its logprobs would silently stop matching
+        ``forward(new_params)`` (the on-policy contract).
+
+        Returns the new ``param_version`` (monotonic; explicit
+        ``version`` pins it — publications carry the learner's own
+        counter so actor-side lag is measured in learner versions)."""
+        self.scheduler.flush_prefix()
+        new = jax.device_put(params)
+        jax.block_until_ready(new)
+        old, self.params = self.params, new
+        if self._owns_params:
+            new_ids = {id(leaf) for leaf in jax.tree.leaves(new)}
+            for leaf in jax.tree.leaves(old):
+                if (isinstance(leaf, jax.Array)
+                        and id(leaf) not in new_ids
+                        and not leaf.is_deleted()):
+                    leaf.delete()
+        self._owns_params = True
+        self.param_version = (self.param_version + 1 if version is None
+                              else int(version))
+        return self.param_version
+
     def has_work(self) -> bool:
         with self._lock:
             return self.scheduler.has_work
@@ -289,13 +358,16 @@ class InferenceEngine:
             "kv_bytes_per_slot": self.cache.bytes_per_slot(
                 self.max_pages_per_slot),
             "max_queue": self.max_queue,
+            "param_version": self.param_version,
             "prefix": self.scheduler.prefix_stats(),
         }
 
     # ------------------------------------------------------ engine tick
-    def step(self) -> List[Tuple[int, int, bool]]:
-        """One engine tick -> [(rid, token, done), ...] events."""
-        events: List[Tuple[int, int, bool]] = []
+    def step(self) -> List[StepEvent]:
+        """One engine tick -> [(rid, token, done), ...] events (each a
+        :class:`StepEvent`: 3-tuple-compatible, ``.logprob`` rides
+        along)."""
+        events: List[StepEvent] = []
         self._process_cancels()
         while True:
             with self._lock:
@@ -309,14 +381,26 @@ class InferenceEngine:
 
     def generate(self, prompts, max_new_tokens: int = 16,
                  sampling: Optional[SamplingParams] = None,
-                 eos_token: Optional[int] = None) -> List[List[int]]:
-        """Run-to-completion over a batch of prompts (ordered results)."""
+                 eos_token: Optional[int] = None,
+                 return_logprobs: bool = False
+                 ) -> Union[List[List[int]],
+                            Tuple[List[List[int]], List[List[float]]]]:
+        """Run-to-completion over a batch of prompts (ordered results).
+
+        With ``return_logprobs`` the result is ``(token lists, logprob
+        lists)`` — each generated token's model logprob, aligned with
+        the token lists (the RL rollout form)."""
         rids = [self.submit(p, max_new_tokens, sampling, eos_token)
                 for p in prompts]
         out: Dict[int, List[int]] = {r: [] for r in rids}
+        lps: Dict[int, List[float]] = {r: [] for r in rids}
         while self.has_work():
-            for rid, tok, _done in self.step():
+            for ev in self.step():
+                rid, tok, _done = ev
                 out[rid].append(tok)
+                lps[rid].append(ev.logprob)
+        if return_logprobs:
+            return ([out[r] for r in rids], [lps[r] for r in rids])
         return [out[r] for r in rids]
 
     # ---------------------------------------------------------- prefill
@@ -356,7 +440,8 @@ class InferenceEngine:
                                     kind=kind)
             logits, *state = fn(*args)
             self.cache.state = tuple(state)
-            tok = self._sample_slots(logits, [req])[0]
+            toks, logps = self._sample_slots(logits, [req])
+            tok, logp = toks[0], logps[0]
         # the prompt's K/V are now fully in cache: its full pages are
         # immutable from here on and safe to hand to other requests
         sched.register_prefix(req)
@@ -374,7 +459,7 @@ class InferenceEngine:
                                           cached_tokens=cached)
             self.telemetry.record_ttft(now - req.submitted_ts,
                                        prefix_hit=cached > 0)
-        self._deliver(req, int(tok), events)
+        self._deliver(req, int(tok), float(logp), events)
 
     # ----------------------------------------------------------- decode
     def _decode(self, events) -> None:
@@ -397,7 +482,7 @@ class InferenceEngine:
                 self.params, *self.cache.state, tokens,
                 sched.lengths, sched.page_table)
             self.cache.state = tuple(state)
-            sampled = self._sample_slots(logits, reqs)
+            sampled, logps = self._sample_slots(logits, reqs)
         wall = time.monotonic() - t0
         if self.telemetry.enabled:
             self.telemetry.record_decode(wall, active=len(active))
@@ -409,10 +494,13 @@ class InferenceEngine:
             if self.debug_logits:
                 self.logits_trace.setdefault(req.rid, []).append(
                     host_logits[slot])
-            self._deliver(req, int(sampled[slot]), events)
+            self._deliver(req, int(sampled[slot]),
+                          float(logps[slot]), events)
 
-    def _deliver(self, req: Request, tok: int, events) -> None:
+    def _deliver(self, req: Request, tok: int, logp: float,
+                 events) -> None:
         req.generated.append(tok)
+        req.logprobs.append(logp)
         done = (len(req.generated) >= req.max_new_tokens
                 or (req.eos_token is not None and tok == req.eos_token))
         if done:
@@ -424,14 +512,14 @@ class InferenceEngine:
                 # finished requests must not accumulate (debug engines
                 # keep them so parity tests can read trajectories)
                 self._requests.pop(req.rid, None)
-        events.append((req.rid, tok, done))
+        events.append(StepEvent(req.rid, tok, done, logp))
 
     # --------------------------------------------------------- sampling
-    def _sample_slots(self, logits,
-                      reqs: List[Optional[Request]]) -> np.ndarray:
+    def _sample_slots(self, logits, reqs: List[Optional[Request]]
+                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Sample one token per logits row — the full [slots, V] decode
         batch (None rows are inactive, result discarded) or a prefill's
-        single [1, V] row."""
+        single [1, V] row.  Returns ``(tokens, model logprobs)``."""
         null = SamplingParams()
         seeds = np.array([(r.sampling.seed if r else 0) for r in reqs],
                          np.int32)
@@ -444,8 +532,9 @@ class InferenceEngine:
                           np.int32)
         top_ps = np.array([(r.sampling.top_p if r else 1.0)
                            for r in reqs], np.float32)
-        return np.asarray(sample_tokens(logits, seeds, counts, temps,
-                                        top_ks, top_ps))
+        toks, logps = sample_tokens_logprobs(logits, seeds, counts,
+                                             temps, top_ks, top_ps)
+        return np.asarray(toks), np.asarray(logps)
 
     # ---------------------------------------------------- compile cache
     def _get_compiled(self, key, build_fn, example_args, *, kind: str):

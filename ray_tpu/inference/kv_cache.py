@@ -114,6 +114,15 @@ class PrefixIndex:
         if h is not None:
             del self._by_hash[h]
 
+    def clear(self) -> int:
+        """Forget every registration (prefix-cache invalidation: the
+        cached K/V no longer matches the params after a weight swap).
+        Returns how many entries were dropped."""
+        n = len(self._by_hash)
+        self._by_hash.clear()
+        self._by_page.clear()
+        return n
+
     def __len__(self) -> int:
         return len(self._by_hash)
 
@@ -168,6 +177,20 @@ class PageAllocator:
     def is_idle(self, page: int) -> bool:
         """Registered at refcount 0 (parked in the LRU pool)."""
         return page in self._idle
+
+    def flush_idle(self) -> int:
+        """Return every idle page to the free list, forgetting its
+        index entry — the bulk invalidation path (a weight swap makes
+        all cached K/V stale at once; piecemeal LRU eviction would
+        keep serving it until pressure happened to evict)."""
+        n = len(self._idle)
+        for page in self._idle:
+            if self._index is not None:
+                self._index.forget(page)
+            self._free.append(page)
+            self._free_set.add(page)
+        self._idle.clear()
+        return n
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` pages at refcount 1, or None (caller keeps the request

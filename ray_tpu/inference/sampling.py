@@ -13,6 +13,17 @@ one compile): temperature scaling, per-row top-k threshold, top-p
 nucleus mask computed on the sorted distribution and mapped back by
 probability threshold, then a Gumbel argmax; ``temperature <= 0``
 selects the plain argmax instead.
+
+The sampler also surfaces the chosen token's **model logprob** —
+``log_softmax`` of the *raw* f32 logits at the sampled id, before any
+temperature/top-k/top-p shaping.  That is the quantity both consumers
+want: serve users get the model's own confidence in the streamed
+token, and the RL actors (``ray_tpu.rl``) need ``log pi(a|s)`` under
+the distribution the learner differentiates (the policy-gradient step
+trains the plain softmax; at ``temperature=1, top_k=0, top_p=1`` the
+behavior distribution and the model distribution coincide, so
+REINFORCE stays on-policy).  Parity-tested against a teacher-forced
+``log_softmax(forward(...))`` recompute in ``tests/test_inference.py``.
 """
 
 from __future__ import annotations
@@ -41,6 +52,7 @@ def _sample_one(logits, seed, count, temp, top_k, top_p):
     V = logits.shape[-1]
     l = logits.astype(jnp.float32)
     greedy = jnp.argmax(l, -1).astype(jnp.int32)
+    model_logp = jax.nn.log_softmax(l)     # raw-logit distribution
     z = l / jnp.maximum(temp, 1e-6)
     # top-k: threshold at the k-th largest logit (0 = off)
     kth = jnp.sort(z)[::-1][jnp.clip(top_k - 1, 0, V - 1)]
@@ -58,12 +70,25 @@ def _sample_one(logits, seed, count, temp, top_k, top_p):
     g = -jnp.log(-jnp.log(
         jax.random.uniform(key, (V,), minval=1e-20, maxval=1.0)))
     sampled = jnp.argmax(z + g, -1).astype(jnp.int32)
-    return jnp.where(temp <= 0.0, greedy, sampled)
+    tok = jnp.where(temp <= 0.0, greedy, sampled)
+    return tok, model_logp[tok]
+
+
+@functools.partial(jax.jit)
+def sample_tokens_logprobs(logits, seeds, counts, temps, top_ks,
+                           top_ps):
+    """logits [B, V] f32; seeds/counts [B] i32; temps/top_ps [B] f32;
+    top_ks [B] i32 -> (token ids [B] i32, chosen-token model logprobs
+    [B] f32), row-independent.  The logprob is ``log_softmax`` of the
+    raw logits at the chosen id (see module docstring)."""
+    return jax.vmap(_sample_one)(logits, seeds, counts, temps, top_ks,
+                                 top_ps)
 
 
 @functools.partial(jax.jit)
 def sample_tokens(logits, seeds, counts, temps, top_ks, top_ps):
     """logits [B, V] f32; seeds/counts [B] i32; temps/top_ps [B] f32;
     top_ks [B] i32 -> sampled token ids [B] i32 (row-independent)."""
-    return jax.vmap(_sample_one)(logits, seeds, counts, temps, top_ks,
-                                 top_ps)
+    tok, _logp = jax.vmap(_sample_one)(logits, seeds, counts, temps,
+                                       top_ks, top_ps)
+    return tok

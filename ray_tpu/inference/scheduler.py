@@ -68,6 +68,11 @@ class Request:
     eos_token: Optional[int] = None
     # lifecycle state (owned by the scheduler/engine)
     generated: List[int] = dataclasses.field(default_factory=list)
+    # chosen-token model logprobs, one per generated token (see
+    # ``sampling``: log_softmax of the raw f32 logits at the sampled
+    # id — the quantity the RL actors and the serve logprobs option
+    # consume)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     pages: Optional[List[int]] = None
     submitted_ts: float = dataclasses.field(default_factory=time.monotonic)
@@ -216,6 +221,22 @@ class SlotScheduler:
         for i in range(req.n_hit_pages, len(req.chain_hashes)):
             self.prefix_index.register(req.chain_hashes[i],
                                        req.pages[i])
+
+    def flush_prefix(self) -> None:
+        """Invalidate the whole prefix cache (weight swap: every
+        cached K/V page was computed under the OLD params, and the
+        index is keyed by token content alone, so a post-swap lookup
+        would happily serve stale attention context).  Idle pages go
+        back to the free list; pages still referenced by active
+        sequences stay allocated (those sequences are mid-flight under
+        the old weights by the caller's choice) but are unregistered,
+        so no *new* request can share them — they free normally at
+        retire.  Queued requests re-run their (now-missing) lookups at
+        the next admission attempt."""
+        if self.prefix_index is None:
+            return
+        self.allocator.flush_idle()
+        self.prefix_index.clear()
 
     # ----------------------------------------------------------- retire
     def retire(self, slot: int) -> Request:

@@ -73,7 +73,11 @@ class GPTDeployment:
 
     Request payload (one dict): ``{"tokens": [...], "max_new_tokens":
     int, "temperature": float, "top_k": int, "top_p": float, "seed":
-    int, "eos_token": int | None}`` — yields generated token ids.
+    int, "eos_token": int | None, "logprobs": bool}`` — yields
+    generated token ids; with ``"logprobs": True`` each item is
+    ``{"token": int, "logprob": float}`` instead (the sampled token's
+    model logprob — ``log_softmax`` of the raw logits, parity-tested
+    against a teacher-forced recompute in ``tests/test_inference.py``).
 
     **Load shedding**: with ``RAY_TPU_INFER_MAX_QUEUE`` set, an
     over-cap submit raises
@@ -99,6 +103,7 @@ class GPTDeployment:
             top_k=int(request.get("top_k", 0)),
             top_p=float(request.get("top_p", 1.0)),
             seed=int(request.get("seed", 0)))
+        want_logprobs = bool(request.get("logprobs", False))
         rid = self.engine.submit(
             request["tokens"],
             max_new_tokens=int(request.get("max_new_tokens", 16)),
@@ -112,8 +117,9 @@ class GPTDeployment:
                 item = await queue.get()
                 if isinstance(item, BaseException):
                     raise item       # pump died: surface, don't hang
-                token, done = item
-                yield token
+                token, done, logprob = item
+                yield ({"token": token, "logprob": logprob}
+                       if want_logprobs else token)
                 if done:
                     return
         finally:
@@ -139,10 +145,11 @@ class GPTDeployment:
             while self.engine.has_work():
                 events = await loop.run_in_executor(None,
                                                     self.engine.step)
-                for rid, token, done in events:
+                for ev in events:
+                    rid, token, done = ev
                     queue = self._queues.get(rid)
                     if queue is not None:
-                        queue.put_nowait((token, done))
+                        queue.put_nowait((token, done, ev.logprob))
         except BaseException as e:  # noqa: BLE001 — deliver, then die
             for queue in self._queues.values():
                 queue.put_nowait(e)

@@ -247,6 +247,167 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                              ce_mode=ce_mode, telemetry=telemetry)
 
 
+def rl_advantages(rewards, baseline: str = "rloo"):
+    """Per-trajectory advantages from scalar rewards ([B] -> [B]).
+
+    - ``rloo``: leave-one-out baseline (RLOO): each trajectory's
+      baseline is the mean reward of the *other* B-1 trajectories in
+      its batch — unbiased, variance-reduced, no value network
+      (``adv_b = (B * r_b - sum r) / (B - 1)``; falls back to ``none``
+      at B=1, where there is no "other").
+    - ``mean``: batch-mean baseline (biased at small B — the sample
+      mean includes r_b — but the familiar REINFORCE-with-baseline).
+    - ``none``: raw rewards (plain REINFORCE).
+    """
+    B = rewards.shape[0]
+    r = rewards.astype(jnp.float32)
+    if baseline == "rloo" and B > 1:
+        return (B * r - jnp.sum(r)) / (B - 1)
+    if baseline == "mean":
+        return r - jnp.mean(r)
+    if baseline in ("rloo", "none"):
+        return r
+    raise ValueError(f"unknown baseline {baseline!r}; "
+                     "expected 'rloo', 'mean' or 'none'")
+
+
+def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
+                       optimizer=None,
+                       baseline: str = "rloo",
+                       attn_pack2: Optional[bool] = None
+                       ) -> Dict[str, Callable]:
+    """Policy-gradient (REINFORCE/RLOO) step builder for the GPT family
+    — the learner half of the ``ray_tpu.rl`` actor/learner split,
+    derived from :func:`build_gpt_train`: same param/optimizer
+    shardings, same attention dispatch, same donated
+    :class:`TrainState`, but the loss is the score-function policy
+    gradient over sampled trajectories instead of teacher-forced CE.
+
+    Batch (fixed shapes -> one compile):
+
+    - ``tokens``  [B, S] int32 — prompt + sampled completion, padded;
+    - ``targets`` [B, S] int32 — the *action* labels: ``targets[b, t]``
+      is the token sampled at position ``t+1`` when that token is part
+      of the completion, else ``-1`` (the CE masking convention — only
+      generated tokens carry gradient, prompt/pad positions do not);
+    - ``rewards`` [B] f32 — one scalar per trajectory.
+
+    Loss: ``-(1/B) * sum_b adv_b * sum_t logp(targets[b,t])`` — the
+    per-sequence-sum REINFORCE estimator with :func:`rl_advantages`
+    baselines computed inside the jitted step.  Logprobs come from a
+    plain f32 ``log_softmax`` over the forward logits, the same
+    distribution the actors' sampler reports (``inference.sampling``),
+    so actor-side logprobs and learner-side gradients price the same
+    policy; the flash-CE streamed-logits formulation has no
+    advantage-weighted variant yet, so the [B, S, V] logits
+    materialize here (fine at rollout batch sizes — an on-chip
+    follow-up can fuse the weighted gather).
+
+    Metrics per step: ``pg_loss``, ``reward_mean``/``reward_max``,
+    ``logp_mean`` (per action token), ``entropy`` (mean action-position
+    entropy — a collapse canary), ``grad_norm``, ``action_tokens``,
+    ``step``.  The returned dict also carries ``pg_grad_fn`` (jitted
+    ``(params, batch) -> ((loss, metrics), grads)``) for the
+    hand-computed-gradient parity test and for LearnerGroup hosting
+    (gradients leave jit, get allreduced, come back through
+    ``apply_grads_fn``).
+    """
+    from ray_tpu.ops.attention import make_flash_attention_fn
+
+    rl_advantages(jnp.zeros((2,)), baseline)   # validate loudly, once
+    # NOT default_optimizer(): its warmup schedule starts at lr 0, so
+    # an RL run's first (often only) handful of steps would be no-ops
+    tx = optimizer or optax.chain(optax.clip_by_global_norm(1.0),
+                                  optax.adam(3e-4))
+    logical = gpt_mod.param_logical_axes(cfg)
+    param_sh = shd.tree_shardings(mesh, logical)
+    if mesh.shape.get("sp", 1) > 1:
+        attn_fn = make_ring_attention_fn(mesh, causal=True)
+    else:
+        attn_fn = make_flash_attention_fn(
+            mesh, causal=True,
+            rope_theta=cfg.rope_theta if cfg.pos == "rope" else None,
+            pack2=attn_pack2)
+    seq_sh = _batch_sharding(mesh)                      # [B, S] leaves
+    traj_sh = NamedSharding(mesh, P(shd.data_axes(mesh)))  # [B] leaves
+    batch_sh = {"tokens": seq_sh, "targets": seq_sh,
+                "rewards": traj_sh}
+
+    def pg_loss(params, batch):
+        tokens, targets = batch["tokens"], batch["targets"]
+        B, S = tokens.shape
+        logits, _aux = gpt_mod.forward(params, tokens, cfg,
+                                       attn_fn=attn_fn, mesh=mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)      # [B, S, V] f32
+        chosen = jnp.take_along_axis(
+            logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+        mask = (targets >= 0).astype(jnp.float32)
+        adv = rl_advantages(batch["rewards"], baseline)
+        seq_logp = jnp.sum(chosen * mask, axis=-1)      # [B]
+        loss = -jnp.mean(adv * seq_logp)
+        n_act = jnp.maximum(jnp.sum(mask), 1.0)
+        ent = -jnp.sum(jnp.sum(jnp.exp(logp) * logp, -1) * mask) / n_act
+        metrics = {
+            "pg_loss": loss,
+            "reward_mean": jnp.mean(batch["rewards"]),
+            "reward_max": jnp.max(batch["rewards"]),
+            "logp_mean": jnp.sum(chosen * mask) / n_act,
+            "entropy": ent,
+            "action_tokens": jnp.sum(mask),
+        }
+        return loss, metrics
+
+    def init(key) -> TrainState:
+        params = gpt_mod.init_params(cfg, key)
+        return TrainState(params, tx.init(params),
+                          jnp.zeros((), jnp.int32))
+
+    st_sh = _state_shardings(init, param_sh, mesh)
+    init_jit = jax.jit(init, out_shardings=st_sh)
+
+    @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
+                       out_shardings=(st_sh, None), donate_argnums=(0,))
+    def step(state: TrainState, batch):
+        (loss_val, metrics), grads = jax.value_and_grad(
+            pg_loss, has_aux=True)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state,
+                                       state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics.update(step=state.step + 1,
+                       grad_norm=optax.global_norm(grads))
+        return (TrainState(params, opt_state, state.step + 1), metrics)
+
+    @functools.partial(jax.jit,
+                       in_shardings=(st_sh.params, batch_sh))
+    def grad_fn(params, batch):
+        return jax.value_and_grad(pg_loss, has_aux=True)(params, batch)
+
+    # split apply for the LearnerGroup DDP path (grads leave jit for
+    # the host allreduce ring and come back — the PPOLearner pattern)
+    @jax.jit
+    def apply_grads(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    @functools.partial(jax.jit,
+                       in_shardings=(st_sh.params, batch_sh))
+    def loss_eval(params, batch):
+        return pg_loss(params, batch)[0]
+
+    return {
+        "init_fn": init_jit,
+        "step_fn": step,
+        "loss_fn": loss_eval,
+        "pg_grad_fn": grad_fn,
+        "apply_grads_fn": apply_grads,
+        "optimizer": tx,
+        "state_shardings": st_sh,
+        "batch_sharding": batch_sh,
+        "attn_fn": attn_fn,
+        "baseline": baseline,
+    }
+
+
 def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                        num_microbatches: Optional[int] = None,
                        optimizer=None,

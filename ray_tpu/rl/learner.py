@@ -1,0 +1,181 @@
+"""RL learners over :func:`ray_tpu.models.training.build_gpt_rl_train`.
+
+Two hosting modes for the same jitted policy-gradient step:
+
+- :class:`InProcessLearner` — the host-sim/bench path: one sharded
+  (or single-device) TrainState advanced in-process, donation intact.
+- :class:`GPTPolicyLearner` — the **LearnerGroup protocol** class
+  (``init_state(key)`` / ``update(params, opt_state, batch,
+  allreduce=)``), so ``rllib/core/learner_group.py`` hosts GPT policy
+  learners exactly like its PPO learners: N learner actors, gradients
+  ring-allreduced between ``pg_grad_fn`` and ``apply_grads_fn``,
+  identical optimizer steps everywhere.  ``learner_cls=
+  "ray_tpu.rl.learner.GPTPolicyLearner"`` with the pickled
+  ``GPTConfig`` as the module is all the group needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RLLearnerConfig:
+    """LearnerGroup-side config for :class:`GPTPolicyLearner` (the
+    pickle-friendly counterpart of the driver's knobs)."""
+    lr: float = 1e-3
+    grad_clip: float = 1.0
+    baseline: str = "rloo"
+    seed: int = 0
+
+
+def _rl_optimizer(lr: float, grad_clip: float):
+    import optax
+    return optax.chain(optax.clip_by_global_norm(grad_clip),
+                       optax.adam(lr))
+
+
+def _np_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    return {"tokens": np.asarray(batch["tokens"], np.int32),
+            "targets": np.asarray(batch["targets"], np.int32),
+            "rewards": np.asarray(batch["rewards"], np.float32)}
+
+
+class InProcessLearner:
+    """One learner replica advanced in-process (host-sim / bench)."""
+
+    def __init__(self, cfg, *, mesh=None, baseline: str = "rloo",
+                 lr: float = 1e-3, grad_clip: float = 1.0,
+                 optimizer=None, seed: int = 0):
+        import jax
+
+        from ray_tpu.models import training
+        from ray_tpu.parallel.mesh import make_mesh
+        if mesh is None:
+            mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+        self.cfg = cfg
+        self.mesh = mesh
+        self.fns = training.build_gpt_rl_train(
+            cfg, mesh, baseline=baseline,
+            optimizer=optimizer or _rl_optimizer(lr, grad_clip))
+        self.state = self.fns["init_fn"](jax.random.PRNGKey(seed))
+        self.steps = 0
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.state, metrics = self.fns["step_fn"](self.state,
+                                                  _np_batch(batch))
+        self.steps += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def params_host(self):
+        """The publication form: a host (numpy) pytree snapshot —
+        what ``WeightStore.publish`` ships and ``engine.set_params``
+        copies in (the device TrainState stays resident here)."""
+        import jax
+        return jax.tree.map(np.asarray, self.state.params)
+
+
+class GPTPolicyLearner:
+    """LearnerGroup-hosted GPT policy-gradient learner.
+
+    Protocol parity with ``rllib.algorithms.ppo.PPOLearner``: the
+    group's ``_LearnerActor`` holds (params, opt_state) and calls
+    ``update`` per trajectory-batch shard; with ``allreduce`` set
+    (num_learners > 1) gradients leave jit, ride the host collective
+    ring, and come back through the jitted apply — every learner takes
+    the identical step.
+    """
+
+    def __init__(self, module, config: RLLearnerConfig):
+        import jax
+
+        from ray_tpu.models import training
+        from ray_tpu.parallel.mesh import make_mesh
+        self.cfg = module                     # a pickled GPTConfig
+        self.config = config
+        mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+        self.tx = _rl_optimizer(config.lr, config.grad_clip)
+        self.fns = training.build_gpt_rl_train(
+            self.cfg, mesh, baseline=config.baseline,
+            optimizer=self.tx)
+        self._steps = 0
+
+    def init_state(self, key):
+        state = self.fns["init_fn"](key)
+        return state.params, state.opt_state
+
+    def update(self, params, opt_state,
+               train_batch: Dict[str, np.ndarray],
+               allreduce: Optional[Callable] = None):
+        batch = _np_batch(train_batch)
+        (loss, metrics), grads = self.fns["pg_grad_fn"](params, batch)
+        if allreduce is not None:
+            grads = allreduce(grads)
+        params, opt_state = self.fns["apply_grads_fn"](params,
+                                                       opt_state, grads)
+        self._steps += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        out["total_loss"] = float(loss)
+        out["step"] = float(self._steps)
+        return params, opt_state, out
+
+
+class LearnerGroupAdapter:
+    """Drives a :class:`~ray_tpu.rllib.core.learner_group.LearnerGroup`
+    of :class:`GPTPolicyLearner` actors behind the same ``update`` /
+    ``params_host`` surface as :class:`InProcessLearner`, so
+    ``run_rl_loop`` is hosting-agnostic.  ``publish_ref()`` exposes the
+    group's versioned object-store snapshot (``publish_params``) so
+    weight publication skips the driver round-trip.
+
+    The baseline is applied **here, over the full batch**, and the
+    hosted learners run baseline-free on the resulting advantages:
+    the group shards the batch on axis 0 before the learners see it,
+    so an in-learner RLOO would use per-shard leave-one-out baselines
+    — a different (and at shard size 1, silently baseline-free)
+    estimator than the in-process path.  Driver-side advantages keep
+    the DDP-hosted gradient equal to the single-learner one for the
+    identical batch."""
+
+    def __init__(self, cfg, *, num_learners: int = 1,
+                 baseline: str = "rloo", lr: float = 1e-3,
+                 grad_clip: float = 1.0, seed: int = 0):
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+        self.baseline = baseline
+        self.group = LearnerGroup(
+            module=cfg,
+            config=RLLearnerConfig(lr=lr, grad_clip=grad_clip,
+                                   baseline="none", seed=seed),
+            num_learners=num_learners,
+            learner_cls="ray_tpu.rl.learner.GPTPolicyLearner")
+        self.steps = 0
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        from ray_tpu.models.training import rl_advantages
+        batch = _np_batch(batch)
+        rewards = batch["rewards"]
+        batch["rewards"] = np.asarray(
+            rl_advantages(rewards, self.baseline), np.float32)
+        metrics = self.group.update(batch)
+        # the learners saw advantages in the rewards slot, so their
+        # reward_mean/max report advantage stats (~0 under rloo/mean);
+        # restore the true-reward figures so both hosting modes emit
+        # the same metric schema
+        metrics["reward_mean"] = float(np.mean(rewards))
+        metrics["reward_max"] = float(np.max(rewards))
+        self.steps += 1
+        return metrics
+
+    def params_host(self):
+        return self.group.get_params()
+
+    def publish_ref(self):
+        """(version, ObjectRef) from the group — the object-store
+        publication path."""
+        return self.group.publish_params()
+
+    def stop(self):
+        self.group.stop()

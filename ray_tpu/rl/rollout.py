@@ -1,0 +1,160 @@
+"""Rollout actors: the inference engine as an RL trajectory generator.
+
+The Sebulba half of the Podracer split (arXiv:2104.06272): an actor
+replica owns one :class:`~ray_tpu.inference.InferenceEngine` — the
+same paged-cache, bucketed-AOT, continuous-batching engine serving
+traffic — and turns (prompt, params@version) into trajectory batches:
+sampled completions, the sampler's own chosen-token logprobs
+(``log pi(a|s)``, parity-tested against a teacher-forced recompute),
+and a programmatic reward.  Weight publications from the learner
+hot-swap in through :meth:`~ray_tpu.inference.InferenceEngine.set_params`
+— params are call args of the AOT executables, so a swap costs zero
+recompiles and the donated-buffer semantics keep exactly one resident
+snapshot per actor (both asserted in ``tests/test_rl.py``).
+
+Actor replicas of the same geometry share one executable cache: the
+N-th replica compiles nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.inference import InferenceEngine, SamplingParams
+from ray_tpu.rl.reward import batch_rewards
+
+
+@dataclasses.dataclass
+class TrajectoryBatch:
+    """One rollout batch, in the learner's batch layout.
+
+    ``tokens``/``targets`` follow :func:`ray_tpu.models.training.
+    build_gpt_rl_train`'s contract: ``targets[b, t]`` is the token the
+    policy *sampled* at position ``t+1`` when that position is part of
+    the completion, ``-1`` everywhere else (prompt and pad positions
+    carry no gradient).  ``param_version`` tags which published
+    snapshot generated the batch — the staleness bound prices batches
+    in these versions.
+    """
+    tokens: np.ndarray          # [B, S] int32
+    targets: np.ndarray         # [B, S] int32  (-1 = masked)
+    rewards: np.ndarray         # [B] f32
+    logprobs: List[List[float]]  # actor-side per-token model logprobs
+    completions: List[List[int]]
+    param_version: int
+    actor_id: int = 0
+    gen_tokens: int = 0
+    wall_s: float = 0.0
+
+    def as_learner_batch(self) -> Dict[str, np.ndarray]:
+        return {"tokens": self.tokens, "targets": self.targets,
+                "rewards": self.rewards}
+
+
+def trajectories_to_batch(prompts: Sequence[Sequence[int]],
+                          completions: List[List[int]],
+                          seq_len: int) -> Dict[str, np.ndarray]:
+    """Pack (prompt, completion) pairs into fixed [B, seq_len] arrays.
+
+    Fixed shapes are the whole point: every rollout batch compiles the
+    learner step exactly once.  Pad token is 0 — masked targets make
+    its value irrelevant."""
+    B = len(prompts)
+    tokens = np.zeros((B, seq_len), np.int32)
+    targets = np.full((B, seq_len), -1, np.int32)
+    for b, (prompt, comp) in enumerate(zip(prompts, completions)):
+        if not prompt:
+            # lo=0 would slice targets[b, -1:...] and assign nothing:
+            # an all-masked row trains as a silent no-op — refuse
+            raise ValueError(f"trajectory {b}: empty prompt (the "
+                             "first action needs a context position)")
+        seq = list(prompt) + list(comp)
+        if len(seq) > seq_len:
+            raise ValueError(f"trajectory {b}: prompt+completion = "
+                             f"{len(seq)} tokens > seq_len {seq_len}")
+        tokens[b, :len(seq)] = seq
+        # position t predicts token t+1; only sampled tokens are
+        # actions
+        lo, hi = len(prompt), len(seq)
+        targets[b, lo - 1:hi - 1] = seq[lo:hi]
+    return {"tokens": tokens, "targets": targets}
+
+
+class RolloutActor:
+    """One rollout replica: engine + reward + version bookkeeping."""
+
+    def __init__(self, cfg, params, *, actor_id: int = 0,
+                 temperature: float = 1.0,
+                 eos_token: Optional[int] = None,
+                 engine_kwargs: Optional[Dict[str, Any]] = None):
+        self.actor_id = actor_id
+        self.temperature = float(temperature)
+        self.eos_token = eos_token
+        self.engine = InferenceEngine(cfg, params,
+                                      **(engine_kwargs or {}))
+        self._rollouts = 0
+
+    @property
+    def param_version(self) -> int:
+        return self.engine.param_version
+
+    def sync(self, version: int, params) -> None:
+        """Hot-swap to a published snapshot (no-op when current)."""
+        if version != self.engine.param_version:
+            self.engine.set_params(params, version=version)
+
+    def rollout(self, prompts: Sequence[Sequence[int]], *,
+                horizon: int, seq_len: int,
+                reward_fn: Callable[[Sequence[int]], float],
+                seed: int = 0) -> TrajectoryBatch:
+        """Generate one trajectory batch under the current params.
+
+        Per-trajectory sampling seeds derive from ``(seed, row)``
+        through the engine's per-sequence PRNG, so a rollout is a pure
+        function of (params, prompts, seed) — co-batching, slot
+        assignment and actor count never change the trajectories
+        (the engine's solo-vs-batched invariant)."""
+        t0 = time.monotonic()
+        rids = [self.engine.submit(
+            p, max_new_tokens=horizon,
+            sampling=SamplingParams(temperature=self.temperature,
+                                    seed=seed + i),
+            eos_token=self.eos_token)
+            for i, p in enumerate(prompts)]
+        toks: Dict[int, List[int]] = {r: [] for r in rids}
+        lps: Dict[int, List[float]] = {r: [] for r in rids}
+        while self.engine.has_work():
+            for ev in self.engine.step():
+                rid, tok, _done = ev
+                toks[rid].append(tok)
+                lps[rid].append(ev.logprob)
+        completions = [toks[r] for r in rids]
+        logprobs = [lps[r] for r in rids]
+        wall = time.monotonic() - t0
+        arrays = trajectories_to_batch(prompts, completions, seq_len)
+        rewards = batch_rewards(reward_fn, completions)
+        self._rollouts += 1
+        return TrajectoryBatch(
+            tokens=arrays["tokens"], targets=arrays["targets"],
+            rewards=rewards, logprobs=logprobs,
+            completions=completions,
+            param_version=self.engine.param_version,
+            actor_id=self.actor_id,
+            gen_tokens=sum(len(c) for c in completions),
+            wall_s=wall)
+
+    def idle(self) -> bool:
+        """True when the engine holds no slots/pages/requests — the
+        clean-shutdown invariant the loop asserts.  Every page must be
+        back in the allocator's free pool (prefix-cache idle pages
+        count as free — the r12 accounting), every slot free, nothing
+        queued."""
+        sched = self.engine.scheduler
+        return (not sched.active and not sched.waiting
+                and len(sched.free_slots) == self.engine.slots
+                and sched.allocator.free_count
+                == sched.allocator.num_pages - 1)

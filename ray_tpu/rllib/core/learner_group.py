@@ -10,6 +10,12 @@ and params never diverge.
 TPU note: each learner actor can also pin its own chip slice and build a
 local mesh (``num_tpus_per_learner``); gradients then move intra-learner
 over ICI inside jit and inter-learner through the collective ring.
+
+The group is also the learner host of the ``ray_tpu.rl`` actor/learner
+loop (``learner_cls="ray_tpu.rl.learner.GPTPolicyLearner"``): batches
+there are trajectory batches (``tokens``/``targets``/``rewards``, no
+``obs``), and :meth:`LearnerGroup.publish_params` hands out the
+versioned object-store weight snapshots the rollout actors hot-swap.
 """
 
 from __future__ import annotations
@@ -98,6 +104,19 @@ class LearnerGroup:
                 backend, learner_cls)
             for rank in range(num_learners)]
         ray_tpu.get([a.ping.remote() for a in self.actors], timeout=300)
+        self._param_version = 0
+
+    @staticmethod
+    def _batch_len(train_batch: Dict[str, np.ndarray]) -> int:
+        """Leading batch dimension: ``obs`` for env batches (the PPO
+        family), else the first array leaf — RL trajectory batches
+        carry ``tokens``/``targets``/``rewards`` and no ``obs``."""
+        if "obs" in train_batch:
+            return len(train_batch["obs"])
+        for v in train_batch.values():
+            if getattr(v, "ndim", 0) >= 1:
+                return v.shape[0]
+        raise ValueError("train batch has no array leaves to shard")
 
     def update(self, train_batch: Dict[str, np.ndarray]
                ) -> Dict[str, float]:
@@ -106,7 +125,7 @@ class LearnerGroup:
         the batch is trimmed to a multiple of the world size.  Arrays
         whose leading dim differs from the batch's (e.g. PPO's scalar
         bootstrap_value) are dropped from the shards."""
-        n = len(train_batch["obs"])
+        n = self._batch_len(train_batch)
         usable = n - n % self.world
         per = usable // self.world
         if per == 0:
@@ -140,6 +159,16 @@ class LearnerGroup:
         """ObjectRef of rank-0 params — pass straight into downstream
         task args (auto-dereferenced) to skip a driver round-trip."""
         return self.actors[0].get_params.remote()
+
+    def publish_params(self):
+        """-> ``(version, ObjectRef)``: a *versioned* weight snapshot
+        through the object store — the RL weight-publication contract.
+        Learners stay in lockstep (identical allreduced steps), so
+        rank 0's params ARE the group's params; the monotonic version
+        is what rollout actors pin via ``engine.set_params(...,
+        version=...)`` and what the staleness bound prices lag in."""
+        self._param_version += 1
+        return self._param_version, self.get_params_ref()
 
     def stop(self):
         for a in self.actors:

@@ -29,6 +29,7 @@ from ray_tpu.telemetry.flops import (chip_peak_tflops,  # noqa: F401
                                      gpt_fwd_flops_per_token,
                                      gpt_train_flops_per_token, mfu)
 from ray_tpu.telemetry.infer import InferTelemetry  # noqa: F401
+from ray_tpu.telemetry.rl import RLTelemetry  # noqa: F401
 from ray_tpu.telemetry.step import (StepTelemetry,  # noqa: F401
                                     instrument, recorders)
 
@@ -36,6 +37,7 @@ __all__ = [
     "TelemetryConfig", "telemetry_config",
     "StepTelemetry", "instrument", "recorders",
     "InferTelemetry",
+    "RLTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",
     "gpt_train_flops_per_token", "mfu",

@@ -1,0 +1,130 @@
+"""Round-14 on-chip driver: the actor/learner RL loop A/B.
+
+Usage: python scratch/r14_rl.py <variant>
+
+Variants:
+  rl       — the closed train<->infer loop at the GPT-2 124M recipe:
+             bench.py --rl headline (rollout tok/s, learner steps/s,
+             publish latency, version lag, reward curve) under the
+             default knobs, then a publish-cadence A/B
+             (RAY_TPU_RL_PUBLISH_EVERY = 1 vs 4: how much rollout
+             throughput the actors win back when they stop paying a
+             hot-swap per learner step, vs how much staleness it
+             costs) and a 2-actor arm (the second replica must show
+             zero compiles — the shared-executable-cache claim on
+             real Mosaic binaries).
+  swap     — weight-publication microbench in isolation: N set_params
+             swaps on a live engine mid-decode, reporting per-swap
+             latency, the compile counters before/after (must be
+             unchanged) and the device-memory high-water mark (the
+             donated-buffer claim: one resident snapshot, no
+             steady-state growth).
+
+Carried arms (no chip session yet; every r06-r13 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+fuse / subsmoke plus all r6-r12 arms — delegated verbatim to
+scratch/r13_fuse.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "rl"
+
+_R13_ARMS = ("fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R13_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r13_fuse.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r14_rl.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("rl", "swap"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig, init_params  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16)
+    engine_kwargs = {}
+    swaps, lr = 8, 1e-4
+else:
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=128, dtype=jnp.float32)
+    engine_kwargs = {"slots": 4, "page_size": 16, "buckets": (32,)}
+    swaps, lr = 4, 1e-2
+
+
+if VARIANT == "rl":
+    env = dict(os.environ)
+    bench = os.path.join(os.path.dirname(HERE), "bench.py")
+    for arm, overrides in (
+            ("default", {}),
+            ("publish4", {"RAY_TPU_RL_PUBLISH_EVERY": "4",
+                          "RAY_TPU_RL_MAX_LAG": "4"}),
+            ("actors2", {"RAY_TPU_RL_ACTORS": "2"})):
+        e = dict(env, **overrides)
+        t0 = time.time()
+        proc = subprocess.run([sys.executable, bench, "--rl"], env=e,
+                              capture_output=True, text=True)
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rec["arm"] = arm
+            rec["wall_s"] = round(time.time() - t0, 1)
+            print(json.dumps(rec), flush=True)
+        if proc.returncode:
+            print(json.dumps({"arm": arm, "error": proc.stderr[-500:]}),
+                  flush=True)
+    sys.exit(0)
+
+# swap — weight-publication microbench on a live engine
+from ray_tpu.inference import InferenceEngine, SamplingParams  # noqa: E402
+
+params = init_params(cfg, jax.random.PRNGKey(0))
+engine = InferenceEngine(cfg, params, telemetry=False, **engine_kwargs)
+prompt = list(np.random.RandomState(0).randint(0, cfg.vocab_size, 16))
+engine.generate([prompt], max_new_tokens=4)       # compile everything
+compiles0 = dict(engine.compile_counts)
+host = jax.tree.map(np.asarray, params)
+lat = []
+for i in range(swaps):
+    # swap mid-traffic: submit, tick once, publish, finish the request
+    engine.submit(prompt, max_new_tokens=6,
+                  sampling=SamplingParams(temperature=1.0, seed=i))
+    engine.step()
+    t0 = time.perf_counter()
+    engine.set_params(host, version=i + 1)
+    lat.append(time.perf_counter() - t0)
+    while engine.has_work():
+        engine.step()
+print(json.dumps({
+    "arm": "swap",
+    "backend": jax.default_backend(),
+    "swaps": swaps,
+    "swap_s_mean": sum(lat) / len(lat),
+    "swap_s_max": max(lat),
+    "compiles_before": compiles0,
+    "compiles_after": dict(engine.compile_counts),
+    "recompile_free": compiles0 == dict(engine.compile_counts),
+    "param_version": engine.param_version,
+}), flush=True)

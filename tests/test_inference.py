@@ -610,6 +610,70 @@ def test_eos_retires_early(tiny_f32):
         probe.scheduler.allocator.free_count
 
 
+# --------------------------------------------------------------- logprobs
+def test_logprobs_match_teacher_forced(tiny_f32):
+    """r14 satellite: the sampler's chosen-token logprobs — threaded
+    through step events and ``generate(return_logprobs=True)`` — match
+    a ``log_softmax`` teacher-forced ``forward`` recompute step by
+    step, for greedy AND temperature sampling (the logprob is always
+    the model distribution's, independent of sampling shaping)."""
+    import jax
+
+    from ray_tpu.inference import SamplingParams
+    cfg, params = tiny_f32
+    for sp in (None, SamplingParams(temperature=0.9, top_k=50,
+                                    seed=7)):
+        engine = _make_engine(cfg, params)
+        prompt = _prompt(11, cfg.vocab_size, seed=61)
+        (toks,), (lps,) = engine.generate([prompt], max_new_tokens=6,
+                                          sampling=sp,
+                                          return_logprobs=True)
+        ref_rows = _teacher_forced_rows(cfg, params, prompt, toks)
+        ref_lp = jax.nn.log_softmax(ref_rows, axis=-1)
+        want = [float(ref_lp[i, t]) for i, t in enumerate(toks)]
+        np.testing.assert_allclose(lps, want, rtol=2e-4, atol=2e-4)
+        # logprobs ride the events too (the serve stream's source)
+        engine2 = _make_engine(cfg, params)
+        engine2.submit(prompt, max_new_tokens=6, sampling=sp)
+        ev_lps = []
+        while engine2.has_work():
+            for ev in engine2.step():
+                assert ev == (ev[0], ev[1], ev[2])   # 3-tuple compat
+                ev_lps.append(ev.logprob)
+        np.testing.assert_allclose(ev_lps, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_deployment_streams_logprobs(tiny_f32):
+    """The serve deployment's ``"logprobs": True`` option: stream
+    items become {token, logprob} dicts whose logprobs match the
+    offline engine's (drives the class directly — no serve runtime)."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+    cfg, params = tiny_f32
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 2, "page_size": 16, "buckets": (32,),
+                       "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+    prompt = [3, 1, 4, 1, 5]
+
+    async def run():
+        agen = dep({"tokens": prompt, "max_new_tokens": 4,
+                    "logprobs": True})
+        return [item async for item in agen]
+
+    items = asyncio.run(asyncio.wait_for(run(), timeout=60))
+    assert all(set(i) == {"token", "logprob"} for i in items)
+    want_toks, want_lps = _make_engine(cfg, params).generate(
+        [prompt], max_new_tokens=4, return_logprobs=True)
+    assert [i["token"] for i in items] == want_toks[0]
+    np.testing.assert_allclose([i["logprob"] for i in items],
+                               want_lps[0], rtol=1e-6)
+
+
 # --------------------------------------------------------------- sampling
 def test_sampling_modes():
     import jax.numpy as jnp

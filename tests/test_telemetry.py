@@ -197,6 +197,42 @@ def test_disabled_mode_noop(monkeypatch):
     assert tcfg_mod.telemetry_config().enabled
 
 
+def test_rl_telemetry_summary():
+    """r14: the RL-loop recorder's summary block — rollout tokens/s,
+    learner steps/s (steady: first step's compile excluded), publish
+    latency, the param_version_lag series and the queue drop
+    accounting — plus the disabled no-op."""
+    from ray_tpu.telemetry import RLTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = RLTelemetry(config=TelemetryConfig(enabled=True))
+    for i in range(3):
+        tel.record_rollout(0.1, tokens=50, param_version=i + 1)
+    tel.record_learner_step(1.0, version_lag=0)      # cold: compile
+    tel.record_learner_step(0.01, version_lag=0)
+    tel.record_learner_step(0.01, version_lag=2)
+    for v in (1, 2, 3, 4):
+        tel.record_publish(0.002, version=v)
+    tel.record_backpressure()
+    tel.record_queue_counters(drops_stale=5, drops_overflow=1)
+    out = tel.summary()
+    assert out["enabled"] and out["label"] == "rl"
+    assert out["rollouts"] == 3 and out["rollout_tokens"] == 150
+    assert out["rollout_tokens_per_sec"] == pytest.approx(500.0)
+    assert out["learner_steps"] == 3
+    # steady rate: the 1s compile step is excluded
+    assert out["learner_steps_per_sec"] == pytest.approx(100.0)
+    assert out["publishes"] == 4 and out["param_version"] == 4
+    assert out["publish_s"] == pytest.approx(0.002)
+    assert out["version_lag_mean"] == pytest.approx(2 / 3)
+    assert out["version_lag_max"] == 2
+    assert out["drops"] == {"stale": 5, "overflow": 1}
+    assert out["backpressure_rejections"] == 1
+    off = RLTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_rollout(0.1, tokens=1, param_version=1)
+    assert off.summary() == {"enabled": False}
+
+
 @pytest.mark.slow
 def test_telemetry_overhead_under_one_percent():
     """Acceptance budget: telemetry-on steady-state step time exceeds
