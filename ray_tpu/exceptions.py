@@ -57,8 +57,20 @@ class TaskError(RayError):
         try:
             class _Wrapped(TaskError, cause_cls):  # type: ignore[misc]
                 def __init__(wrapped_self):
-                    TaskError.__init__(wrapped_self, self.cause,
-                                       self.remote_tb, self.task_id)
+                    # set TaskError's state directly instead of calling
+                    # TaskError.__init__: its cooperative
+                    # ``super().__init__(str(cause))`` would continue
+                    # down _Wrapped's MRO INTO cause_cls.__init__ —
+                    # a cause class with a non-(message) constructor
+                    # (DeadlineExceededError, InjectedFault, ...) then
+                    # raised TypeError and the wrap silently degraded
+                    # to a plain TaskError that except-cause_cls
+                    # clauses no longer caught
+                    wrapped_self.cause = self.cause
+                    wrapped_self.remote_tb = self.remote_tb
+                    wrapped_self.task_id = self.task_id
+                    wrapped_self.proctitle = self.proctitle
+                    Exception.__init__(wrapped_self, str(self.cause))
 
                 def __reduce__(wrapped_self):
                     # the dynamic class can't unpickle (cause_cls's

@@ -18,12 +18,14 @@ from ray_tpu.inference.engine import (InferenceEngine,  # noqa: F401
 from ray_tpu.inference.kv_cache import (KVCache,  # noqa: F401
                                         PageAllocator, PrefixIndex)
 from ray_tpu.inference.sampling import SamplingParams  # noqa: F401
-from ray_tpu.inference.scheduler import (QueueFullError,  # noqa: F401
+from ray_tpu.inference.scheduler import (DeadlineExceededError,  # noqa: F401
+                                         QueueFullError,
                                          Request, SlotScheduler)
 
 __all__ = [
     "InferConfig", "infer_config", "default_buckets",
     "InferenceEngine", "StepEvent", "KVCache", "PageAllocator",
     "PrefixIndex",
-    "SamplingParams", "QueueFullError", "Request", "SlotScheduler",
+    "SamplingParams", "QueueFullError", "DeadlineExceededError",
+    "Request", "SlotScheduler",
 ]

@@ -62,6 +62,21 @@ class InferConfig:
       :class:`~ray_tpu.inference.scheduler.QueueFullError` (load
       shedding) that the serve deployment surfaces as the stream's
       error instead of queueing unboundedly.
+    - ``RAY_TPU_INFER_TTFT_DEADLINE`` (default ``0`` = none): default
+      per-request time-to-first-token deadline in seconds.  A request
+      still waiting past it is retired with a typed
+      :class:`~ray_tpu.inference.scheduler.DeadlineExceededError`
+      surfaced on its stream — over-deadline work is shed, not queued.
+    - ``RAY_TPU_INFER_DEADLINE`` (default ``0`` = none): default
+      per-request *total* deadline in seconds (submit to last token);
+      expiry mid-decode retires the sequence, releasing its slot,
+      pages and prefix refcounts.
+    - ``RAY_TPU_INFER_WATCHDOG`` (default ``0`` = off): engine
+      watchdog timeout in seconds — with work pending and no engine
+      tick completing for this long, the serve replica's
+      :class:`~ray_tpu.resilience.watchdog.EngineWatchdog` declares
+      the step loop wedged (stderr + ``wedges`` counter; the drain /
+      restart decision is the operator's).
     """
     slots: int = 8
     page_size: int = 128
@@ -71,6 +86,9 @@ class InferConfig:
     kv_dtype: str = "model"
     prefix: bool = True
     max_queue: int = 0
+    ttft_deadline: float = 0.0
+    deadline: float = 0.0
+    watchdog: float = 0.0
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -99,6 +117,21 @@ def infer_config(refresh: bool = False) -> InferConfig:
             print(f"RAY_TPU_INFER_MAX_QUEUE={max_queue} negative; "
                   "using 0 (unbounded)", file=sys.stderr)
             max_queue = 0
+
+        def nonneg_float(name, off_meaning):
+            val = float(env(name, "0"))
+            if val < 0:
+                print(f"{name}={val} negative; using 0 "
+                      f"({off_meaning})", file=sys.stderr)
+                return 0.0
+            return val
+
+        ttft_deadline = nonneg_float("RAY_TPU_INFER_TTFT_DEADLINE",
+                                     "no TTFT deadline")
+        deadline = nonneg_float("RAY_TPU_INFER_DEADLINE",
+                                "no total deadline")
+        watchdog = nonneg_float("RAY_TPU_INFER_WATCHDOG",
+                                "watchdog off")
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
@@ -108,6 +141,9 @@ def infer_config(refresh: bool = False) -> InferConfig:
             kv_dtype=kv_dtype,
             prefix=env("RAY_TPU_INFER_PREFIX", "1") != "0",
             max_queue=max_queue,
+            ttft_deadline=ttft_deadline,
+            deadline=deadline,
+            watchdog=watchdog,
         )
     return _CONFIG
 

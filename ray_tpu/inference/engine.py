@@ -58,7 +58,8 @@ from ray_tpu.inference import kv_cache as kvc
 from ray_tpu.inference.config import default_buckets, infer_config
 from ray_tpu.inference.sampling import (SamplingParams,
                                         sample_tokens_logprobs)
-from ray_tpu.inference.scheduler import Request, SlotScheduler
+from ray_tpu.inference.scheduler import (DeadlineExceededError,
+                                         Request, SlotScheduler)
 from ray_tpu.models import gpt as gpt_mod
 from ray_tpu.ops.attention import _NEG_INF
 
@@ -68,18 +69,27 @@ class StepEvent(tuple):
     ``(rid, token, done)`` 3-tuple, with the sampled token's model
     logprob riding along as an attribute (``ev.logprob``) so logprob
     consumers (the serve stream's ``logprobs`` option, the RL rollout
-    actors) don't force a tuple-shape change on every caller."""
+    actors) don't force a tuple-shape change on every caller.
 
-    def __new__(cls, rid: int, token: int, done: bool, logprob: float):
+    ``ev.error`` (default None) is the failure channel: a request
+    retired by deadline expiry emits one final event with
+    ``done=True``, ``token=-1`` and the typed exception here — the
+    serve pump raises it into the request's stream, ``generate()``
+    re-raises it, and tuple consumers that ignore the attribute still
+    see a clean terminal event."""
+
+    def __new__(cls, rid: int, token: int, done: bool, logprob: float,
+                error: Optional[BaseException] = None):
         self = super().__new__(cls, (rid, token, done))
         self.logprob = logprob
+        self.error = error
         return self
 
     def __getnewargs__(self):
         # tuple's default reduce would replay __new__ with the bare
         # 3-tuple; events cross process boundaries here (object store,
-        # remote rollout actors), so pickle must carry all four args
-        return (self[0], self[1], self[2], self.logprob)
+        # remote rollout actors), so pickle must carry all five args
+        return (self[0], self[1], self[2], self.logprob, self.error)
 
 
 def _cached_context_attention(q, kctx, vctx, ks, vs, cached_len,
@@ -153,6 +163,8 @@ class InferenceEngine:
                  kv_dtype: Optional[str] = None,
                  prefix: Optional[bool] = None,
                  max_queue: Optional[int] = None,
+                 ttft_deadline: Optional[float] = None,
+                 deadline: Optional[float] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
                  executable_cache: Optional[Dict[Any, Any]] = None):
@@ -169,6 +181,13 @@ class InferenceEngine:
         self.prefix = icfg.prefix if prefix is None else bool(prefix)
         self.max_queue = (icfg.max_queue if max_queue is None
                           else max_queue)
+        # default per-request deadlines (0/None = none); per-submit
+        # overrides win.  Stored as None-or-positive so the expiry
+        # sweep can skip requests without budgets cheaply.
+        self.ttft_deadline = (icfg.ttft_deadline if ttft_deadline
+                              is None else float(ttft_deadline)) or None
+        self.deadline = (icfg.deadline if deadline is None
+                         else float(deadline)) or None
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"unknown kv_dtype {self.kv_dtype!r} "
                              "(check RAY_TPU_KV_DTYPE)")
@@ -216,6 +235,12 @@ class InferenceEngine:
         self._next_rid = 0
         self._cancelled: set = set()
         self._lock = threading.Lock()   # submit() vs step() admissions
+        # liveness bookkeeping for the resilience watchdog: ``ticks``
+        # counts completed step() calls, ``last_tick_ts`` their wall
+        # time — a wedged step loop is has_work + neither moving
+        self.ticks = 0
+        self.last_tick_ts = time.monotonic()
+        self.deadline_exceeded = 0
         # versioned params (the RL weight-publication contract): the
         # construction snapshot is version 0 and may alias caller-held
         # arrays, so the first set_params() does not delete it
@@ -239,7 +264,9 @@ class InferenceEngine:
     # --------------------------------------------------------- requests
     def submit(self, prompt, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None,
-               eos_token: Optional[int] = None) -> int:
+               eos_token: Optional[int] = None,
+               ttft_deadline_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -258,7 +285,13 @@ class InferenceEngine:
             req = Request(rid=rid, prompt=prompt,
                           max_new_tokens=max_new_tokens,
                           sampling=sampling or SamplingParams(),
-                          eos_token=eos_token)
+                          eos_token=eos_token,
+                          ttft_deadline_s=(self.ttft_deadline
+                                           if ttft_deadline_s is None
+                                           else ttft_deadline_s
+                                           or None),
+                          deadline_s=(self.deadline if deadline_s
+                                      is None else deadline_s or None))
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
             depth = len(self.scheduler.waiting)
@@ -281,6 +314,21 @@ class InferenceEngine:
             if rid in self._requests:
                 self._cancelled.add(rid)
 
+    def drain_requests(self) -> int:
+        """Retire every known request NOW, host-side (no device step):
+        the teardown path for a replica whose pump died or a supervisor
+        replacing a dead rollout engine — nothing may be left holding
+        slots/pages/refcounts.  Safe only when no concurrent
+        :meth:`step` is running (the callers' situation by
+        construction: the stepping thread is gone).  Returns how many
+        requests were retired."""
+        with self._lock:
+            rids = list(self._requests)
+        for rid in rids:
+            self.cancel(rid)
+        self._process_cancels()
+        return len(rids)
+
     def _process_cancels(self) -> None:
         with self._lock:
             cancelled, self._cancelled = self._cancelled, set()
@@ -296,6 +344,54 @@ class InferenceEngine:
                 sched.waiting.remove(req)
                 req.done = True
                 self._requests.pop(req.rid, None)
+
+    def _expire_deadlines(self, events: List["StepEvent"]) -> None:
+        """Retire every request past its deadline, at the same safe
+        point cancels process (tick start — nothing is mid-flight over
+        a slot).  A waiting request can blow either budget (TTFT is
+        total-bounded too: ``ttft <= total``); an active request only
+        the total one, since admission delivered its first token in
+        its admission tick.  Retirement releases everything — slot,
+        pages, prefix refcounts — and emits a terminal error event the
+        stream surfaces as :class:`DeadlineExceededError`."""
+        now = time.monotonic()
+
+        def expiry(req: Request, waiting: bool):
+            waited = now - req.submitted_ts
+            if waiting and req.ttft_deadline_s is not None \
+                    and waited > req.ttft_deadline_s:
+                return DeadlineExceededError(req.rid, "ttft",
+                                             req.ttft_deadline_s,
+                                             waited)
+            if req.deadline_s is not None and waited > req.deadline_s:
+                return DeadlineExceededError(req.rid, "total",
+                                             req.deadline_s, waited)
+            return None
+
+        expired: List[Request] = []
+        with self._lock:
+            sched = self.scheduler
+            for req, err in [(r, e) for r in sched.waiting
+                             if (e := expiry(r, True)) is not None]:
+                sched.waiting.remove(req)
+                req.error = err
+                req.done = True
+                self._requests.pop(req.rid, None)
+                expired.append(req)
+            for slot, req in list(sched.active.items()):
+                err = expiry(req, False)
+                if err is not None:
+                    sched.retire(slot)
+                    req.error = err
+                    self._requests.pop(req.rid, None)
+                    expired.append(req)
+        for req in expired:
+            self.deadline_exceeded += 1
+            if self.telemetry.enabled:
+                self.telemetry.record_deadline_exceeded(
+                    kind=req.error.kind)
+            events.append(StepEvent(req.rid, -1, True, 0.0,
+                                    error=req.error))
 
     def set_params(self, params, *, version: Optional[int] = None) -> int:
         """Hot-swap the engine's parameters to a new snapshot.
@@ -360,6 +456,8 @@ class InferenceEngine:
             "max_queue": self.max_queue,
             "param_version": self.param_version,
             "prefix": self.scheduler.prefix_stats(),
+            "deadline_exceeded": self.deadline_exceeded,
+            "ticks": self.ticks,
         }
 
     # ------------------------------------------------------ engine tick
@@ -369,6 +467,7 @@ class InferenceEngine:
         along)."""
         events: List[StepEvent] = []
         self._process_cancels()
+        self._expire_deadlines(events)
         while True:
             with self._lock:
                 req = self.scheduler.try_admit()
@@ -377,28 +476,49 @@ class InferenceEngine:
             self._prefill(req, events)
         if self.scheduler.active:
             self._decode(events)
+        self.ticks += 1
+        self.last_tick_ts = time.monotonic()
         return events
 
     def generate(self, prompts, max_new_tokens: int = 16,
                  sampling: Optional[SamplingParams] = None,
                  eos_token: Optional[int] = None,
-                 return_logprobs: bool = False
+                 return_logprobs: bool = False,
+                 ttft_deadline_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None
                  ) -> Union[List[List[int]],
                             Tuple[List[List[int]], List[List[float]]]]:
         """Run-to-completion over a batch of prompts (ordered results).
 
         With ``return_logprobs`` the result is ``(token lists, logprob
         lists)`` — each generated token's model logprob, aligned with
-        the token lists (the RL rollout form)."""
-        rids = [self.submit(p, max_new_tokens, sampling, eos_token)
+        the token lists (the RL rollout form).  A deadline expiry
+        raises its :class:`DeadlineExceededError` (streaming callers
+        get it per-request via the event's ``error`` instead)."""
+        rids = [self.submit(p, max_new_tokens, sampling, eos_token,
+                            ttft_deadline_s=ttft_deadline_s,
+                            deadline_s=deadline_s)
                 for p in prompts]
         out: Dict[int, List[int]] = {r: [] for r in rids}
         lps: Dict[int, List[float]] = {r: [] for r in rids}
-        while self.has_work():
+        err: Optional[BaseException] = None
+        while err is None and self.has_work():
             for ev in self.step():
                 rid, tok, _done = ev
-                out[rid].append(tok)
-                lps[rid].append(ev.logprob)
+                if ev.error is not None:
+                    if err is None and rid in out:
+                        err = ev.error
+                    continue
+                if rid in out:          # not a stale leftover rid
+                    out[rid].append(tok)
+                    lps[rid].append(ev.logprob)
+        if err is not None:
+            # don't abandon the surviving siblings mid-decode: their
+            # slots/pages would stay held and poison the next call
+            for r in rids:
+                self.cancel(r)
+            self._process_cancels()
+            raise err
         if return_logprobs:
             return ([out[r] for r in rids], [lps[r] for r in rids])
         return [out[r] for r in rids]
@@ -463,7 +583,13 @@ class InferenceEngine:
 
     # ----------------------------------------------------------- decode
     def _decode(self, events) -> None:
-        from ray_tpu.util import tracing
+        from ray_tpu.util import chaos, tracing
+
+        # fault site BEFORE any cache/scheduler mutation and before the
+        # donated executable dispatches: an injected decode failure
+        # leaves the engine state consistent (slots/pages still held,
+        # cache arrays live), so supervisors can cancel/drain cleanly
+        chaos.maybe_fail("infer.decode")
         sched = self.scheduler
         tokens = np.zeros((self.slots,), np.int32)
         reqs: List[Optional[Request]] = [None] * self.slots

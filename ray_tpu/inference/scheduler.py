@@ -59,6 +59,32 @@ class QueueFullError(RuntimeError):
     replica) instead of queueing unboundedly."""
 
 
+class DeadlineExceededError(RuntimeError):
+    """Typed per-request deadline expiry (``RAY_TPU_INFER_TTFT_DEADLINE``
+    / ``RAY_TPU_INFER_DEADLINE`` or per-request overrides): the request
+    was retired — slot, pages and prefix refcounts released — because
+    it blew its time-to-first-token or total budget.  Surfaced as the
+    stream's error; wedged or over-deadline work is shed, not queued
+    (the arXiv:2011.03641 concurrency-limits argument in seconds)."""
+
+    def __init__(self, rid: int, kind: str, budget_s: float,
+                 waited_s: float):
+        super().__init__(
+            f"request {rid}: {kind} deadline of {budget_s:.3f}s "
+            f"exceeded ({waited_s:.3f}s elapsed)")
+        self.rid = rid
+        self.kind = kind            # "ttft" | "total"
+        self.budget_s = budget_s
+        self.waited_s = waited_s
+
+    def __reduce__(self):
+        # default exception pickling replays __init__ with self.args
+        # (the message) — this error crosses the object store on serve
+        # streams, so it must rebuild from its real constructor args
+        return (DeadlineExceededError,
+                (self.rid, self.kind, self.budget_s, self.waited_s))
+
+
 @dataclasses.dataclass
 class Request:
     rid: int
@@ -78,6 +104,15 @@ class Request:
     submitted_ts: float = dataclasses.field(default_factory=time.monotonic)
     admitted_ts: Optional[float] = None
     done: bool = False
+    # deadlines (seconds from submit; None = none): ``ttft_deadline_s``
+    # bounds time-to-first-token — it can only expire while the request
+    # is still waiting, because admission delivers the first token in
+    # the same tick — and ``deadline_s`` bounds the whole request.  An
+    # expired request is retired with everything released and carries
+    # the typed error here for the stream to surface.
+    ttft_deadline_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    error: Optional[BaseException] = None
     # prefix-cache state: chained hashes of the prompt's full pages
     # (None until the first admission attempt computes them — they are
     # immutable per request, so retries reuse them), how many were

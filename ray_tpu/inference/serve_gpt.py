@@ -38,12 +38,19 @@ Usage (see the README serving quickstart)::
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu.serve as serve
 from ray_tpu.inference.sampling import SamplingParams
 
 _PRESETS = ("tiny", "gpt2", "gpt2_medium", "gpt2_large")
+
+
+class ReplicaDrainingError(RuntimeError):
+    """Typed admission rejection while the replica drains: new
+    requests must go to another replica (the router's retry signal);
+    in-flight streams keep decoding to completion."""
 
 
 def _build_engine(model: str, model_config: Optional[Dict[str, Any]],
@@ -73,11 +80,17 @@ class GPTDeployment:
 
     Request payload (one dict): ``{"tokens": [...], "max_new_tokens":
     int, "temperature": float, "top_k": int, "top_p": float, "seed":
-    int, "eos_token": int | None, "logprobs": bool}`` — yields
-    generated token ids; with ``"logprobs": True`` each item is
+    int, "eos_token": int | None, "logprobs": bool,
+    "ttft_deadline_s": float | None, "deadline_s": float | None}`` —
+    yields generated token ids; with ``"logprobs": True`` each item is
     ``{"token": int, "logprob": float}`` instead (the sampled token's
     model logprob — ``log_softmax`` of the raw logits, parity-tested
     against a teacher-forced recompute in ``tests/test_inference.py``).
+    The deadline keys override the ``RAY_TPU_INFER_TTFT_DEADLINE`` /
+    ``RAY_TPU_INFER_DEADLINE`` defaults per request; an expired
+    request is retired (slot/pages/prefix refcounts released) and its
+    stream raises the typed
+    :class:`~ray_tpu.inference.scheduler.DeadlineExceededError`.
 
     **Load shedding**: with ``RAY_TPU_INFER_MAX_QUEUE`` set, an
     over-cap submit raises
@@ -91,13 +104,27 @@ class GPTDeployment:
     def __init__(self, model: str = "tiny",
                  model_config: Optional[Dict[str, Any]] = None,
                  engine_config: Optional[Dict[str, Any]] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 watchdog_s: Optional[float] = None):
         self.cfg, self.engine = _build_engine(model, model_config,
                                               engine_config, seed)
         self._queues: Dict[int, asyncio.Queue] = {}
         self._pump_task: Optional[asyncio.Task] = None
+        self._draining = False
+        from ray_tpu.inference.config import infer_config
+        watchdog_s = (infer_config().watchdog if watchdog_s is None
+                      else watchdog_s)
+        self._watchdog = None
+        if watchdog_s:
+            from ray_tpu.resilience.watchdog import EngineWatchdog
+            self._watchdog = EngineWatchdog(
+                self.engine, timeout_s=watchdog_s).start()
 
     async def __call__(self, request: Dict[str, Any]):
+        if self._draining:
+            raise ReplicaDrainingError(
+                "replica is draining: admission stopped, in-flight "
+                "requests finishing — retry on another replica")
         sampling = SamplingParams(
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
@@ -108,7 +135,9 @@ class GPTDeployment:
             request["tokens"],
             max_new_tokens=int(request.get("max_new_tokens", 16)),
             sampling=sampling,
-            eos_token=request.get("eos_token"))
+            eos_token=request.get("eos_token"),
+            ttft_deadline_s=request.get("ttft_deadline_s"),
+            deadline_s=request.get("deadline_s"))
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = queue
         self._ensure_pump()
@@ -148,14 +177,82 @@ class GPTDeployment:
                 for ev in events:
                     rid, token, done = ev
                     queue = self._queues.get(rid)
-                    if queue is not None:
+                    if queue is None:
+                        continue
+                    if ev.error is not None:
+                        # deadline expiry: the engine already released
+                        # the slot/pages; surface the typed error as
+                        # the stream's failure
+                        queue.put_nowait(ev.error)
+                    else:
                         queue.put_nowait((token, done, ev.logprob))
         except BaseException as e:  # noqa: BLE001 — deliver, then die
             for queue in self._queues.values():
                 queue.put_nowait(e)
             raise
 
+    # ------------------------------------------------------------ drain
+    async def drain(self, poll_s: float = 0.05,
+                    timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful shutdown: stop admission (``__call__`` raises a
+        typed :class:`ReplicaDrainingError` from now on), let every
+        in-flight request decode to completion, then report.  The
+        autoscaler's scale-down / a preemption notice calls this so a
+        replica exits with zero dropped streams; the engine's own
+        clean-idle invariants (no held slots/pages) are what "finished"
+        means.
+
+        ``timeout_s`` bounds the wait on a pump that is alive but not
+        finishing (a wedged step — the watchdog's scenario): past it,
+        drain gives up WITHOUT touching engine state (the stuck step
+        may still hold it) and reports ``drained: False`` so the
+        preemption handler can escalate instead of hanging forever."""
+        self._draining = True
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while True:
+            pump_alive = (self._pump_task is not None
+                          and not self._pump_task.done())
+            if pump_alive:
+                if deadline is not None and \
+                        time.monotonic() > deadline:
+                    # the watchdog stays ARMED: the replica is still
+                    # running with a possibly wedged engine — this is
+                    # exactly the scenario it reports on
+                    stats = self.engine.stats()
+                    return {"drained": False,
+                            "reason": "pump still running past the "
+                                      "drain timeout (wedged step?)",
+                            "free_slots": stats["free_slots"],
+                            "active": stats["active"],
+                            "waiting": stats["waiting"]}
+                await asyncio.sleep(poll_s)
+                continue
+            if self.engine.has_work():
+                # the pump is dead (step failure) or never ran, so
+                # nothing will tick the engine again: retire every
+                # leftover request host-side — the replica must exit
+                # with slots/pages/refcounts released, not hang
+                # waiting for a tick that cannot come (consumers
+                # already got the pump's error fan-out)
+                self.engine.drain_requests()
+            break
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        stats = self.engine.stats()
+        return {"drained": True,
+                "requests_done":
+                    self.engine.telemetry.summary().get(
+                        "requests_done", 0)
+                    if self.engine.telemetry.enabled else None,
+                "free_slots": stats["free_slots"],
+                "active": stats["active"],
+                "waiting": stats["waiting"]}
+
     def telemetry_summary(self) -> Dict[str, Any]:
         summary = self.engine.telemetry.summary()
         summary["stats"] = self.engine.stats()
+        summary["draining"] = self._draining
+        if self._watchdog is not None:
+            summary["watchdog_wedges"] = self._watchdog.wedges
         return summary

@@ -1,0 +1,43 @@
+"""``ray_tpu.resilience`` — supervision and recovery for the ML stack.
+
+TPU fleets are preemptible and serving fleets shed replicas; this
+package is the layer that turns those deaths from run-killers into
+bounded hiccups, spanning all four workloads built in r06–r14:
+
+- **train** — async bit-exact checkpoint/resume
+  (:class:`~ray_tpu.resilience.checkpoint.TrainCheckpointer`,
+  :func:`~ray_tpu.resilience.checkpoint.run_train_ckpt_loop`):
+  snapshots off the critical path, orbax/npz + checkpoint-manager
+  retention, corrupt snapshots fall back loudly.
+- **RL** — the supervised actor/learner loop
+  (:func:`~ray_tpu.resilience.supervisor.run_supervised_rl_loop`):
+  dead rollout actors restart from the latest published weights with
+  zero recompiles, the learner checkpoints and restores in place, a
+  killed loop resumes with bounded lost work.
+- **inference/serve** — per-request TTFT/total deadlines (typed
+  :class:`~ray_tpu.inference.scheduler.DeadlineExceededError`,
+  everything released on expiry), the
+  :class:`~ray_tpu.resilience.watchdog.EngineWatchdog` wedge
+  detector, and graceful deployment drain.
+- **proof** — all of the above is exercised by the deterministic
+  fault-injection plan in :mod:`ray_tpu.util.chaos`
+  (``RAY_TPU_FAULTS``), not just unit-tested.
+
+Config via ``RAY_TPU_CKPT_*`` (:func:`resilience_config`); the
+deadline/watchdog knobs live with the engine's
+(``RAY_TPU_INFER_*``).
+"""
+
+from ray_tpu.resilience.checkpoint import (TrainCheckpointer,  # noqa: F401
+                                           run_train_ckpt_loop)
+from ray_tpu.resilience.config import (ResilienceConfig,  # noqa: F401
+                                       resilience_config)
+from ray_tpu.resilience.supervisor import run_supervised_rl_loop  # noqa: F401
+from ray_tpu.resilience.watchdog import EngineWatchdog  # noqa: F401
+
+__all__ = [
+    "ResilienceConfig", "resilience_config",
+    "TrainCheckpointer", "run_train_ckpt_loop",
+    "run_supervised_rl_loop",
+    "EngineWatchdog",
+]

@@ -1,0 +1,61 @@
+"""Resilience env knobs — the single home for checkpoint/recovery config.
+
+Follows the ``infer_config()`` / ``rl_config()`` precedent: one frozen
+dataclass resolved from the environment once, ``refresh=True`` for
+tests and A/B drivers that flip flags after import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Checkpoint/recovery knobs, resolved once from the environment.
+
+    - ``RAY_TPU_CKPT_EVERY`` (default ``0`` = off): training steps
+      between async TrainState snapshots.  The snapshot (device->host
+      copy) runs on the training thread; the disk write runs on the
+      checkpointer's background thread, off the critical path.
+    - ``RAY_TPU_CKPT_DIR`` (default unset): checkpoint directory.  A
+      :class:`~ray_tpu.resilience.checkpoint.TrainCheckpointer` built
+      without an explicit directory uses this; with neither set,
+      checkpointing is off.
+    - ``RAY_TPU_CKPT_KEEP`` (default ``3``): retained snapshots —
+      retention rides ``train/checkpoint_manager.py`` (newest-first;
+      the corrupt-restore fallback walks these in order).
+    """
+    ckpt_every: int = 0
+    ckpt_dir: Optional[str] = None
+    ckpt_keep: int = 3
+
+
+_CONFIG: Optional[ResilienceConfig] = None
+
+
+def resilience_config(refresh: bool = False) -> ResilienceConfig:
+    """The process-wide :class:`ResilienceConfig` (env read once)."""
+    global _CONFIG
+    if _CONFIG is None or refresh:
+        env = os.environ.get
+        every = int(env("RAY_TPU_CKPT_EVERY", "0"))
+        if every < 0:
+            print(f"RAY_TPU_CKPT_EVERY={every} negative; using 0 "
+                  "(checkpointing off)", file=sys.stderr)
+            every = 0
+        keep = int(env("RAY_TPU_CKPT_KEEP", "3"))
+        if keep < 1:
+            print(f"RAY_TPU_CKPT_KEEP={keep} must be >= 1 (resume "
+                  "needs at least the latest snapshot); using 1",
+                  file=sys.stderr)
+            keep = 1
+        _CONFIG = ResilienceConfig(
+            ckpt_every=every,
+            ckpt_dir=env("RAY_TPU_CKPT_DIR") or None,
+            ckpt_keep=keep,
+        )
+    return _CONFIG

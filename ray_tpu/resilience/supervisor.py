@@ -1,0 +1,369 @@
+"""Supervised actor/learner RL loop: survive deaths mid-training.
+
+``run_rl_loop`` (r14) is the fair-weather driver: any actor or learner
+failure loses the whole run.  This module is the Podracer answer
+(arXiv:2104.06272 — preemption is normal, checkpoint/restart is the
+recovery path) applied to the RL subsystem:
+
+- **actor supervision** — every rollout is health-checked by outcome;
+  a dead actor (engine fault, injected ``rl.rollout`` kill) is
+  replaced by a fresh :class:`~ray_tpu.rl.rollout.RolloutActor`
+  re-seeded from the **latest** :class:`~ray_tpu.rl.replay.WeightStore`
+  version.  Replacements share the fleet's executable cache, so a
+  restart compiles **nothing** (asserted by counters in the chaos
+  acceptance test) — restart cost is engine construction + one
+  device_put, not XLA.
+- **learner checkpointing** — every ``ckpt_every`` learner steps the
+  full learner :class:`~ray_tpu.models.training.TrainState` (params +
+  opt state + step), the published version and the rollout-seed cursor
+  snapshot through the async
+  :class:`~ray_tpu.resilience.checkpoint.TrainCheckpointer`.  A
+  learner death (injected ``rl.learner``) restores the newest valid
+  snapshot in place and **republishes** under a fresh version, so
+  actors resync and stale in-queue batches age out through the
+  existing ``max_lag`` bound.
+- **loop resume** — a killed *process* reruns with ``resume=True``:
+  learner state, step counter and seed cursor restore from the
+  checkpoint; lost work is bounded by (checkpoint interval + one
+  :class:`~ray_tpu.rl.replay.ReplayQueue` of trajectories), never the
+  run.
+- **publish supervision** — a failed weight publication (injected
+  ``rl.publish``) is counted and skipped; actors continue on the
+  previous consistent version.
+
+Wait-policy rejections here are non-blocking (held batch + retry) —
+the driver is single-threaded, so a timed put would stall waiting for
+its own consumer.  The timed put (``RAY_TPU_RL_PUT_TIMEOUT`` ->
+:class:`~ray_tpu.rl.replay.ReplayPutTimeout`, counted as
+backpressure) is the contract for actors whose learner pops from
+another thread/process — it bounds how long a producer can block on a
+dead learner.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rl.config import RLConfig, rl_config
+from ray_tpu.rl.learner import InProcessLearner
+from ray_tpu.rl.replay import (ReplayPutTimeout, ReplayQueue,
+                               WeightStore)
+from ray_tpu.rl.reward import target_token_reward
+from ray_tpu.rl.rollout import RolloutActor
+from ray_tpu.resilience.checkpoint import TrainCheckpointer
+from ray_tpu.util import chaos
+
+
+def _drain_engine(engine) -> None:
+    """Best-effort retire of everything a (possibly dying) engine
+    holds.  Never raises — the engine may be the thing that just
+    failed."""
+    try:
+        engine.drain_requests()
+    except Exception:  # noqa: BLE001 — cleanup of a broken engine
+        pass
+
+
+def _put_with_backpressure(queue: ReplayQueue, batch, *, tel) -> bool:
+    """One queue put under the supervised policy: a ``wait``-policy
+    rejection counts as backpressure and returns False so the caller
+    holds the batch.
+
+    Deliberately NON-blocking (no ``RAY_TPU_RL_PUT_TIMEOUT`` here):
+    this driver runs producer and consumer on one thread, so a timed
+    put would wait for a pop that cannot happen until it returns — a
+    guaranteed full-timeout stall per backpressured actor.  The timed
+    put (:class:`~ray_tpu.rl.replay.ReplayPutTimeout`) is for actors
+    whose learner pops from another thread/process."""
+    try:
+        if queue.put(batch):
+            return True
+    except ReplayPutTimeout:       # pragma: no cover — defensive
+        pass
+    tel.record_backpressure()
+    return False
+
+
+def run_supervised_rl_loop(cfg, *, steps: int,
+                           rlcfg: Optional[RLConfig] = None,
+                           reward_fn: Optional[Callable] = None,
+                           prompt: Optional[Sequence[int]] = None,
+                           prompt_len: int = 4,
+                           eos_token: Optional[int] = None,
+                           seed: int = 0,
+                           lr: float = 1e-3,
+                           mesh=None,
+                           optimizer=None,
+                           ckpt: Optional[TrainCheckpointer] = None,
+                           ckpt_every: Optional[int] = None,
+                           resume: bool = False,
+                           max_actor_restarts: int = 8,
+                           max_learner_restarts: int = 3,
+                           engine_kwargs: Optional[Dict[str, Any]] = None,
+                           learner_fns: Optional[Dict[str, Any]] = None,
+                           telemetry: Optional[bool] = None
+                           ) -> Dict[str, Any]:
+    """``run_rl_loop`` semantics under supervision (in-process learner).
+
+    Same fixed-seed determinism contract as the r14 loop *until the
+    first fault*: an undisturbed supervised run reproduces
+    ``run_rl_loop``'s trajectories exactly (same seeds, same order).
+    After a fault the trajectories diverge by construction — recovery
+    is judged on the reward criterion (final-third mean within
+    tolerance of an uninterrupted run), not bitwise.
+
+    ``ckpt``/``ckpt_every`` arm learner checkpointing (``ckpt_every``
+    defaults to the checkpointer's own ``RAY_TPU_CKPT_EVERY`` cadence);
+    ``resume=True`` restores the newest valid snapshot before the
+    first rollout.  ``max_learner_restarts=0`` disables in-place
+    learner recovery: the death propagates, and the caller reruns with
+    ``resume=True`` (the killed-loop path).
+
+    Returns the ``run_rl_loop`` result dict plus ``actor_restarts``,
+    ``learner_restarts``, ``publish_failures``, ``resumed_from`` and
+    the ``checkpoint`` telemetry block.
+    """
+    rlcfg = rlcfg or rl_config()
+    rng = np.random.RandomState(seed)
+    if prompt is None:
+        prompt = [int(t) for t in
+                  rng.randint(0, cfg.vocab_size, prompt_len)]
+    prompts = [list(prompt)] * rlcfg.batch
+    seq_len = len(prompt) + rlcfg.horizon
+    if reward_fn is None:
+        target = int(rng.randint(0, cfg.vocab_size))
+        reward_fn = target_token_reward(
+            target, length_penalty=1.0 / max(rlcfg.horizon, 1),
+            eos_token=eos_token)
+
+    from ray_tpu.telemetry.config import TelemetryConfig
+    from ray_tpu.telemetry.rl import RLTelemetry
+    tel = RLTelemetry(config=None if telemetry is None else
+                      TelemetryConfig(enabled=bool(telemetry)))
+
+    learner = InProcessLearner(cfg, mesh=mesh, baseline=rlcfg.baseline,
+                               lr=lr, optimizer=optimizer, seed=seed,
+                               fns=learner_fns)
+    store = WeightStore(use_object_store=False)
+    # put_timeout pinned to 0 — single-threaded driver, see
+    # _put_with_backpressure
+    queue = ReplayQueue(rlcfg.queue, max_lag=rlcfg.max_lag,
+                        overflow=rlcfg.overflow, put_timeout=0)
+    ckpt_every = (ckpt.every if ckpt is not None and ckpt_every is None
+                  else (ckpt_every or 0))
+
+    learner_steps = 0
+    rollout_seed = seed * 1_000_003
+    publish_failures = 0
+    resumed_from = None
+
+    def checkpoint_now():
+        if ckpt is None:
+            return
+        ckpt.save(learner.state_host(), step=learner_steps,
+                  extras={"version": store.version,
+                          "learner_steps": learner_steps,
+                          "rollout_seed": rollout_seed})
+
+    def restore_learner() -> bool:
+        """Newest valid snapshot -> learner + counters; False when the
+        directory holds nothing usable (fresh start)."""
+        nonlocal learner_steps, rollout_seed, resumed_from
+        if ckpt is None:
+            return False
+        example = {"state": learner.state_host(),
+                   "extras": {"version": np.asarray(0),
+                              "learner_steps": np.asarray(0),
+                              "rollout_seed": np.asarray(0)}}
+        restored = ckpt.restore_latest(example=example)
+        if restored is None:
+            return False
+        learner.load_state(restored["state"])
+        learner_steps = int(restored["extras"]["learner_steps"])
+        rollout_seed = int(restored["extras"]["rollout_seed"])
+        resumed_from = restored["path"]
+        return True
+
+    def publish(must: bool = False) -> bool:
+        """One supervised publication; a failure (injected or real) is
+        fatal only when ``must`` (the seed publish — actors cannot
+        start without version 1)."""
+        nonlocal publish_failures
+        t0 = time.monotonic()
+        try:
+            version = store.publish(learner.params_host())
+        except Exception as e:  # noqa: BLE001 — supervised: skip one
+            if must:
+                raise
+            publish_failures += 1
+            print(f"weight publish failed ({e!r}); actors stay on "
+                  f"version {store.version}", file=sys.stderr)
+            return False
+        tel.record_publish(time.monotonic() - t0, version=version)
+        return True
+
+    if resume:
+        restore_learner()
+    publish(must=True)           # seeds actors (fresh or restored)
+    checkpoint_now()             # in-place learner recovery needs >= 1
+    # history/reward_curve index THIS call's counted steps; a resumed
+    # run starts its records at `base_steps`, so mid-loop rollbacks
+    # must truncate relative to it, not to the absolute step counter
+    base_steps = learner_steps
+    shared_exec: Dict[Any, Any] = {}
+    ekw = dict(engine_kwargs or {})
+    ekw.setdefault("executable_cache", shared_exec)
+    ekw.setdefault("telemetry", False)
+
+    def spawn_actor(aid: int) -> RolloutActor:
+        version, params = store.latest()
+        actor = RolloutActor(cfg, params, actor_id=aid,
+                             temperature=rlcfg.temperature,
+                             eos_token=eos_token, engine_kwargs=ekw)
+        actor.engine.param_version = version
+        return actor
+
+    actors = [spawn_actor(i) for i in range(rlcfg.actors)]
+    actor_restarts = 0
+    learner_restarts = 0
+    # per-actor compile counters at spawn time tell the acceptance
+    # test which engines were born after the cache warmed
+    restart_compiles: List[Dict[str, int]] = []
+
+    history: List[Dict[str, float]] = []
+    reward_curve: List[float] = []
+    pending: Dict[int, Any] = {}
+    try:
+        while learner_steps < steps:
+            # ---- held batches first (the r14 no-starvation order)
+            for aid in list(pending):
+                if _put_with_backpressure(queue, pending[aid],
+                                          tel=tel):
+                    del pending[aid]
+            # ---- actor side, supervised: a rollout that raises kills
+            # only its actor; the replacement syncs to the latest
+            # publication and takes over the same slot in the fleet
+            for i, actor in enumerate(actors):
+                if actor.actor_id in pending:
+                    continue
+                if actor.param_version != store.version:
+                    version, params = store.latest()
+                    actor.sync(version, params)
+                rollout_seed += rlcfg.batch
+                try:
+                    batch = actor.rollout(prompts,
+                                          horizon=rlcfg.horizon,
+                                          seq_len=seq_len,
+                                          reward_fn=reward_fn,
+                                          seed=rollout_seed)
+                except Exception as e:  # noqa: BLE001 — supervise
+                    if actor_restarts >= max_actor_restarts:
+                        raise
+                    actor_restarts += 1
+                    tel.record_actor_restart()
+                    print(f"rollout actor {actor.actor_id} died "
+                          f"({e!r}); restarting from version "
+                          f"{store.version}", file=sys.stderr)
+                    _drain_engine(actor.engine)
+                    # leak check NOW (the same clean-idle invariant
+                    # the shutdown path asserts), then drop the
+                    # engine: keeping dead engines around would pin
+                    # their device params + KV arrays (a whole replica
+                    # of HBM each) for the rest of the run
+                    if not actor.idle():
+                        raise RuntimeError(
+                            f"dead rollout engine {actor.actor_id} did "
+                            "not drain clean (slots/pages still held) "
+                            "— the recovery path broke the allocator "
+                            "invariants") from e
+                    actors[i] = spawn_actor(actor.actor_id)
+                    restart_compiles.append(
+                        dict(actors[i].engine.compile_counts))
+                    continue        # the fleet moves on this round
+                tel.record_rollout(batch.wall_s,
+                                   tokens=batch.gen_tokens,
+                                   param_version=batch.param_version)
+                if not _put_with_backpressure(queue, batch, tel=tel):
+                    pending[actor.actor_id] = batch
+            # ---- learner side, supervised: drain what is fresh
+            while learner_steps < steps:
+                batch = queue.pop(store.version)
+                if batch is None:
+                    break
+                lag = store.version - batch.param_version
+                t0 = time.monotonic()
+                try:
+                    chaos.maybe_fail("rl.learner")
+                    metrics = learner.update(batch.as_learner_batch())
+                except Exception as e:  # noqa: BLE001 — supervise
+                    if ckpt is None or \
+                            learner_restarts >= max_learner_restarts:
+                        raise
+                    learner_restarts += 1
+                    tel.record_learner_restart()
+                    print(f"learner died ({e!r}); restoring from its "
+                          "checkpoint and republishing",
+                          file=sys.stderr)
+                    if not restore_learner():
+                        raise
+                    # roll the records back with the learner so
+                    # history[i] / reward_curve[i] stays "the i-th
+                    # counted step of THIS call" — without this the
+                    # re-run steps would be double-counted and the
+                    # curve's indices would stop meaning anything
+                    # (clamped: a corrupt-newest fallback can restore
+                    # a snapshot older than this call's starting point,
+                    # which invalidates every record of this call)
+                    keep = max(learner_steps - base_steps, 0)
+                    del history[keep:]
+                    del reward_curve[keep:]
+                    publish(must=True)   # fresh version: actors resync
+                    break                # back to the rollout side
+                tel.record_learner_step(time.monotonic() - t0,
+                                        version_lag=lag)
+                learner_steps += 1
+                metrics["rollout_reward_mean"] = float(
+                    np.mean(batch.rewards))
+                metrics["param_version_lag"] = float(lag)
+                history.append(metrics)
+                reward_curve.append(metrics["rollout_reward_mean"])
+                if learner_steps % rlcfg.publish_every == 0:
+                    publish()
+                if ckpt_every and learner_steps % ckpt_every == 0:
+                    checkpoint_now()
+    finally:
+        leftover = queue.drain() + list(pending.values())
+        if ckpt is not None:
+            ckpt.flush()
+    tel.record_queue_counters(drops_stale=queue.drops_stale,
+                              drops_overflow=queue.drops_overflow)
+    leaked = [a.actor_id for a in actors if not a.idle()]
+    if leaked:
+        raise RuntimeError(f"rollout engines {leaked} did not drain "
+                           "clean at shutdown (slots/pages still held)")
+    return {
+        "steps": learner_steps,
+        "history": history,
+        "reward_curve": reward_curve,
+        "leftover_batches": len(leftover),
+        "drops_stale": queue.drops_stale,
+        "drops_overflow": queue.drops_overflow,
+        "backpressure_rejections": queue.backpressure_rejections,
+        "param_version": store.version,
+        "publishes": store.publish_count,
+        "publish_failures": publish_failures,
+        "actor_restarts": actor_restarts,
+        "learner_restarts": learner_restarts,
+        "restart_compiles": restart_compiles,
+        "resumed_from": resumed_from,
+        "telemetry": tel.summary(),
+        "checkpoint": (ckpt.telemetry.summary() if ckpt is not None
+                       else {"enabled": False}),
+        "engine_stats": [a.engine.stats() for a in actors],
+        "actors": [a.engine for a in actors],
+        "learner": learner,
+    }

@@ -26,7 +26,8 @@ from ray_tpu.rl.learner import (GPTPolicyLearner,  # noqa: F401
                                 InProcessLearner, LearnerGroupAdapter,
                                 RLLearnerConfig)
 from ray_tpu.rl.loop import run_rl_loop  # noqa: F401
-from ray_tpu.rl.replay import ReplayQueue, WeightStore  # noqa: F401
+from ray_tpu.rl.replay import (ReplayPutTimeout,  # noqa: F401
+                               ReplayQueue, WeightStore)
 from ray_tpu.rl.reward import (batch_rewards,  # noqa: F401
                                target_token_reward)
 from ray_tpu.rl.rollout import (RolloutActor,  # noqa: F401
@@ -35,7 +36,7 @@ from ray_tpu.rl.rollout import (RolloutActor,  # noqa: F401
 __all__ = [
     "RLConfig", "rl_config",
     "RolloutActor", "TrajectoryBatch", "trajectories_to_batch",
-    "ReplayQueue", "WeightStore",
+    "ReplayQueue", "ReplayPutTimeout", "WeightStore",
     "InProcessLearner", "GPTPolicyLearner", "LearnerGroupAdapter",
     "RLLearnerConfig",
     "target_token_reward", "batch_rewards",

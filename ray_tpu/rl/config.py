@@ -50,6 +50,12 @@ class RLConfig:
       temperature.  ``1.0`` keeps the behavior distribution equal to
       the model softmax the learner differentiates (on-policy); other
       values are exploration knobs that reintroduce off-policy bias.
+    - ``RAY_TPU_RL_PUT_TIMEOUT`` (default ``0`` = non-blocking):
+      seconds a ``wait``-policy queue put may block for a pop to free
+      space before raising the typed
+      :class:`~ray_tpu.rl.replay.ReplayPutTimeout` — the bound that
+      keeps a rollout actor from blocking forever on a dead learner
+      (timeouts count as ``backpressure_rejections``).
     """
     actors: int = 1
     batch: int = 8
@@ -60,6 +66,7 @@ class RLConfig:
     publish_every: int = 1
     baseline: str = "rloo"
     temperature: float = 1.0
+    put_timeout: float = 0.0
 
 
 _CONFIG: Optional[RLConfig] = None
@@ -99,6 +106,11 @@ def rl_config(refresh: bool = False) -> RLConfig:
                   "(greedy rollouts zero the policy gradient); "
                   "using 1.0", file=sys.stderr)
             temperature = 1.0
+        put_timeout = float(env("RAY_TPU_RL_PUT_TIMEOUT", "0"))
+        if put_timeout < 0:
+            print(f"RAY_TPU_RL_PUT_TIMEOUT={put_timeout} negative; "
+                  "using 0 (non-blocking puts)", file=sys.stderr)
+            put_timeout = 0.0
         max_lag = int(env("RAY_TPU_RL_MAX_LAG", "1"))
         if max_lag < 0:
             print(f"RAY_TPU_RL_MAX_LAG={max_lag} negative; using 0 "
@@ -115,5 +127,6 @@ def rl_config(refresh: bool = False) -> RLConfig:
             publish_every=pos_int("RAY_TPU_RL_PUBLISH_EVERY", 1),
             baseline=baseline,
             temperature=temperature,
+            put_timeout=put_timeout,
         )
     return _CONFIG

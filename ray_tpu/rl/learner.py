@@ -49,7 +49,7 @@ class InProcessLearner:
 
     def __init__(self, cfg, *, mesh=None, baseline: str = "rloo",
                  lr: float = 1e-3, grad_clip: float = 1.0,
-                 optimizer=None, seed: int = 0):
+                 optimizer=None, seed: int = 0, fns=None):
         import jax
 
         from ray_tpu.models import training
@@ -58,7 +58,11 @@ class InProcessLearner:
             mesh = make_mesh(dp=1, devices=jax.devices()[:1])
         self.cfg = cfg
         self.mesh = mesh
-        self.fns = training.build_gpt_rl_train(
+        # ``fns``: a pre-built ``build_gpt_rl_train`` dict — learners
+        # of one geometry then share compiled steps (supervised-loop
+        # restarts, A/B drivers, tests); baseline/optimizer/mesh args
+        # are baked into it, so they are ignored when it is passed
+        self.fns = fns or training.build_gpt_rl_train(
             cfg, mesh, baseline=baseline,
             optimizer=optimizer or _rl_optimizer(lr, grad_clip))
         self.state = self.fns["init_fn"](jax.random.PRNGKey(seed))
@@ -76,6 +80,25 @@ class InProcessLearner:
         copies in (the device TrainState stays resident here)."""
         import jax
         return jax.tree.map(np.asarray, self.state.params)
+
+    def state_host(self):
+        """The *checkpoint* form: the full host TrainState (params +
+        opt state + step) — what the supervised loop persists so a
+        restored learner takes the identical next optimizer step."""
+        import jax
+        return jax.tree.map(np.asarray, self.state)
+
+    def load_state(self, host_state) -> None:
+        """Restore from a :meth:`state_host`-shaped snapshot: leaves
+        go back to the devices under this learner's shardings, so the
+        restored state is donation- and parity-identical to one that
+        never left (the checkpoint/restore acceptance contract)."""
+        import jax
+        self.state = jax.device_put(
+            jax.tree.unflatten(jax.tree.structure(self.state),
+                               jax.tree.leaves(host_state)),
+            self.fns["state_shardings"])
+        self.steps = int(np.asarray(self.state.step))
 
 
 class GPTPolicyLearner:

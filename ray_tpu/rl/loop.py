@@ -105,8 +105,13 @@ def run_rl_loop(cfg, *, steps: int,
                                    baseline=rlcfg.baseline, lr=lr,
                                    optimizer=optimizer, seed=seed)
     store = WeightStore(use_object_store=num_learners >= 1)
+    # put_timeout pinned to 0: this driver runs producer and consumer
+    # on one thread, so a timed put (RAY_TPU_RL_PUT_TIMEOUT) would
+    # wait for a pop that cannot happen until it returns — the
+    # hold-and-retry `pending` mechanism below is the backpressure
+    # path here
     queue = ReplayQueue(rlcfg.queue, max_lag=rlcfg.max_lag,
-                        overflow=rlcfg.overflow)
+                        overflow=rlcfg.overflow, put_timeout=0)
 
     def publish():
         t0 = time.monotonic()

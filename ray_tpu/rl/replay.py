@@ -24,9 +24,31 @@ Two host-side structures close the train<->infer loop:
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Any, Deque, List, Optional, Tuple
 
 from ray_tpu.rl.rollout import TrajectoryBatch
+from ray_tpu.util import chaos
+
+
+class ReplayPutTimeout(RuntimeError):
+    """Typed timeout for a blocking ``wait``-policy put
+    (``RAY_TPU_RL_PUT_TIMEOUT``): the queue stayed full for the whole
+    budget — the learner is dead or wedged, and the rollout actor must
+    get control back (report to its supervisor, resync, retry) instead
+    of blocking forever on a consumer that will never pop."""
+
+    def __init__(self, timeout_s: float):
+        super().__init__(
+            f"ReplayQueue put timed out after {timeout_s:.3f}s: the "
+            "queue stayed full (dead/wedged learner?) — rejecting the "
+            "batch back to the producer (RAY_TPU_RL_PUT_TIMEOUT)")
+        self.timeout_s = timeout_s
+
+    def __reduce__(self):
+        # rebuild from the constructor arg, not the message (remote
+        # rollout actors ship this across the object store)
+        return (ReplayPutTimeout, (self.timeout_s,))
 
 
 class WeightStore:
@@ -60,7 +82,14 @@ class WeightStore:
         returns only once the snapshot *exists* in the object store:
         a publication isn't published until actors can fetch it, and
         the publish-latency metric must price the serialization/store
-        put, not a ~µs async ref handoff."""
+        put, not a ~µs async ref handoff.
+
+        Fault site ``rl.publish`` fires *before* any state mutates, so
+        a failed publication leaves the store serving the previous
+        version — actors keep rolling out on stale-but-consistent
+        weights, which is the recovery contract the supervised loop
+        tests."""
+        chaos.maybe_fail("rl.publish")
         from ray_tpu.object_ref import ObjectRef
         if self._use_ray:
             import ray_tpu
@@ -96,7 +125,8 @@ class ReplayQueue:
     """Bounded trajectory queue with a hard staleness bound."""
 
     def __init__(self, capacity: int, *, max_lag: int = 1,
-                 overflow: str = "drop"):
+                 overflow: str = "drop",
+                 put_timeout: Optional[float] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if overflow not in ("drop", "wait"):
@@ -107,28 +137,63 @@ class ReplayQueue:
         self.capacity = capacity
         self.max_lag = max_lag
         self.overflow = overflow
+        # default blocking budget for ``wait``-policy puts whose call
+        # passes no explicit timeout: ``RAY_TPU_RL_PUT_TIMEOUT``.
+        # Single-threaded drivers (producer and consumer on one
+        # thread) MUST pin this to 0 — a timed put there waits for a
+        # pop that cannot happen until it returns.
+        if put_timeout is None:
+            from ray_tpu.rl.config import rl_config
+            put_timeout = rl_config().put_timeout
+        self.put_timeout = float(put_timeout)
         self._q: Deque[TrajectoryBatch] = collections.deque()
+        # one lock + condition makes the queue safe for supervised
+        # loops that run actors on threads; pops notify blocked
+        # ``wait``-policy puts
+        self._cond = threading.Condition()
         self.drops_stale = 0
         self.drops_overflow = 0
         self.puts = 0
         self.pops = 0
+        self.backpressure_rejections = 0
 
     def __len__(self) -> int:
-        return len(self._q)
+        with self._cond:
+            return len(self._q)
 
-    def put(self, batch: TrajectoryBatch) -> bool:
+    def put(self, batch: TrajectoryBatch,
+            timeout: Optional[float] = None) -> bool:
         """Enqueue; returns False when a full queue rejects the put
         under the ``wait`` policy (the producer backs off — nothing
         was dropped).  Under ``drop`` the oldest batch is evicted: the
-        freshest trajectories always fit."""
-        if len(self._q) >= self.capacity:
-            if self.overflow == "wait":
-                return False
-            self._q.popleft()
-            self.drops_overflow += 1
-        self._q.append(batch)
-        self.puts += 1
-        return True
+        freshest trajectories always fit.
+
+        ``timeout`` (seconds, ``wait`` policy only; defaults to the
+        queue's ``put_timeout`` = ``RAY_TPU_RL_PUT_TIMEOUT``) turns
+        the rejection into a bounded block: wait up to ``timeout``
+        for a pop to free space, then raise :class:`ReplayPutTimeout`
+        — a producer must never block forever on a dead learner.
+        Both the immediate rejection and the timeout count as
+        ``backpressure_rejections``."""
+        if timeout is None:
+            timeout = self.put_timeout
+        with self._cond:
+            if len(self._q) >= self.capacity:
+                if self.overflow == "wait":
+                    if timeout <= 0:
+                        self.backpressure_rejections += 1
+                        return False
+                    if not self._cond.wait_for(
+                            lambda: len(self._q) < self.capacity,
+                            timeout=timeout):
+                        self.backpressure_rejections += 1
+                        raise ReplayPutTimeout(timeout)
+                else:
+                    self._q.popleft()
+                    self.drops_overflow += 1
+            self._q.append(batch)
+            self.puts += 1
+            return True
 
     def pop(self, current_version: int) -> Optional[TrajectoryBatch]:
         """Next batch fresh enough to train on, or None.
@@ -137,18 +202,22 @@ class ReplayQueue:
         current_version - max_lag`` — the hard bound: the learner
         never sees a trajectory generated more than ``max_lag``
         publications ago, under either overflow policy."""
-        while self._q:
-            batch = self._q.popleft()
-            if batch.param_version < current_version - self.max_lag:
-                self.drops_stale += 1
-                continue
-            self.pops += 1
-            return batch
-        return None
+        with self._cond:
+            while self._q:
+                batch = self._q.popleft()
+                self._cond.notify_all()
+                if batch.param_version < current_version - self.max_lag:
+                    self.drops_stale += 1
+                    continue
+                self.pops += 1
+                return batch
+            return None
 
     def drain(self) -> List[TrajectoryBatch]:
         """Empty the queue (shutdown); returns the leftover batches so
         the caller can account for them — nothing silently vanishes."""
-        out = list(self._q)
-        self._q.clear()
-        return out
+        with self._cond:
+            out = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+            return out

@@ -94,8 +94,13 @@ class RolloutActor:
         self.actor_id = actor_id
         self.temperature = float(temperature)
         self.eos_token = eos_token
-        self.engine = InferenceEngine(cfg, params,
-                                      **(engine_kwargs or {}))
+        kw = dict(engine_kwargs or {})
+        # rollouts have no deadline semantics: an actor engine must not
+        # inherit the serving fleet's RAY_TPU_INFER_*DEADLINE defaults
+        # (an expired request would truncate a trajectory mid-flight)
+        kw.setdefault("ttft_deadline", 0)
+        kw.setdefault("deadline", 0)
+        self.engine = InferenceEngine(cfg, params, **kw)
         self._rollouts = 0
 
     @property
@@ -117,7 +122,14 @@ class RolloutActor:
         through the engine's per-sequence PRNG, so a rollout is a pure
         function of (params, prompts, seed) — co-batching, slot
         assignment and actor count never change the trajectories
-        (the engine's solo-vs-batched invariant)."""
+        (the engine's solo-vs-batched invariant).
+
+        Fault site ``rl.rollout`` fires on entry — before any request
+        is submitted — so an injected actor death leaves the engine
+        drained (nothing held) and the supervisor can replace the
+        actor without leaking slots or pages."""
+        from ray_tpu.util import chaos
+        chaos.maybe_fail("rl.rollout")
         t0 = time.monotonic()
         rids = [self.engine.submit(
             p, max_new_tokens=horizon,
@@ -129,6 +141,14 @@ class RolloutActor:
         lps: Dict[int, List[float]] = {r: [] for r in rids}
         while self.engine.has_work():
             for ev in self.engine.step():
+                if ev.error is not None:
+                    # a request died mid-rollout (deadline set despite
+                    # the defaults, engine fault): the trajectory is
+                    # incomplete — appending the terminal (-1, 0.0)
+                    # event would train the learner on a fake action,
+                    # so the actor fails loudly and the supervisor
+                    # replaces it
+                    raise ev.error
                 rid, tok, _done = ev
                 toks[rid].append(tok)
                 lps[rid].append(ev.logprob)

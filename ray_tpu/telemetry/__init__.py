@@ -23,6 +23,7 @@ steps.  See :func:`telemetry_config`.
 """
 
 from ray_tpu.telemetry import chrome_trace  # noqa: F401
+from ray_tpu.telemetry.ckpt import CkptTelemetry  # noqa: F401
 from ray_tpu.telemetry.config import (TelemetryConfig,  # noqa: F401
                                       telemetry_config)
 from ray_tpu.telemetry.flops import (chip_peak_tflops,  # noqa: F401
@@ -38,6 +39,7 @@ __all__ = [
     "StepTelemetry", "instrument", "recorders",
     "InferTelemetry",
     "RLTelemetry",
+    "CkptTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",
     "gpt_train_flops_per_token", "mfu",

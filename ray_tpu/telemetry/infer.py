@@ -60,6 +60,7 @@ class InferTelemetry:
         self.decode_tokens = 0
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
+        self.deadline_exceeded: Dict[str, int] = {}
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
@@ -129,6 +130,18 @@ class InferTelemetry:
         if self.enabled:
             self.requests_done += 1
 
+    def record_deadline_exceeded(self, *, kind: str) -> None:
+        """One request retired past its deadline (``kind`` = ``ttft``
+        — never admitted in time — or ``total`` — expired mid-flight).
+        Shed work is the load-limit signal, so it gets a Prometheus
+        counter (``infer_deadline_exceeded_total``) operators can rate
+        and alarm on."""
+        if not self.enabled:
+            return
+        self.deadline_exceeded[kind] = \
+            self.deadline_exceeded.get(kind, 0) + 1
+        self._emit_deadline(kind)
+
     def record_cache_info(self, *, kv_dtype: str, cache_bytes: int,
                           kv_bytes_per_slot: int) -> None:
         """Static KV-cache geometry the engine reports once at
@@ -156,6 +169,7 @@ class InferTelemetry:
         }
         out["prompt_tokens"] = self.prompt_tokens
         out["prefill_tokens_skipped"] = self.prefix_hit_tokens
+        out["deadline_exceeded"] = dict(self.deadline_exceeded)
         if self.prompt_tokens:
             out["prefix_hit_rate"] = (self.prefix_hit_tokens
                                       / self.prompt_tokens)
@@ -192,7 +206,7 @@ class InferTelemetry:
         if not is_initialized():
             return None
         if self._metrics is None:
-            from ray_tpu.util.metrics import Gauge, Histogram
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
             tags = ("label",)
             self._metrics = {
                 "ttft": Histogram(
@@ -213,6 +227,10 @@ class InferTelemetry:
                     "infer_queue_depth",
                     "requests waiting for a decode slot",
                     tag_keys=tags),
+                "deadline": Counter(
+                    "infer_deadline_exceeded_total",
+                    "requests retired past their TTFT/total deadline",
+                    tag_keys=("label", "kind")),
             }
         return self._metrics
 
@@ -237,6 +255,17 @@ class InferTelemetry:
                 if wait_s is not None:
                     metrics["queue_wait"].observe(wait_s, tags=tags)
                 metrics["queue_depth"].set(depth, tags=tags)
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_deadline(self, kind: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["deadline"].inc(
+                    1.0, tags={"label": self.label, "kind": kind})
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

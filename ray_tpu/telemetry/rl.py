@@ -49,6 +49,8 @@ class RLTelemetry:
         self.version_lags: List[int] = []
         self.drops: Dict[str, int] = {}
         self.backpressure = 0
+        self.actor_restarts = 0
+        self.learner_restarts = 0
         self._metrics = None
         self._metrics_dead = False
         self._metrics_last = 0.0
@@ -93,6 +95,20 @@ class RLTelemetry:
         if self.enabled:
             self.backpressure += 1
 
+    def record_actor_restart(self) -> None:
+        """A rollout actor died (engine fault, injected kill) and the
+        supervisor replaced it — the fleet-health signal
+        (``rl_actor_restarts_total``) for preemptible actor pools."""
+        if not self.enabled:
+            return
+        self.actor_restarts += 1
+        self._emit_restart()
+
+    def record_learner_restart(self) -> None:
+        """The learner was restored from its checkpoint mid-loop."""
+        if self.enabled:
+            self.learner_restarts += 1
+
     def record_queue_counters(self, *, drops_stale: int,
                               drops_overflow: int) -> None:
         """Final queue accounting (the loop stamps these at
@@ -115,6 +131,8 @@ class RLTelemetry:
             "param_version": self.param_version,
             "drops": dict(self.drops),
             "backpressure_rejections": self.backpressure,
+            "actor_restarts": self.actor_restarts,
+            "learner_restarts": self.learner_restarts,
         }
         if self.rollouts:
             wall = sum(r["wall_s"] for r in self.rollouts)
@@ -149,9 +167,13 @@ class RLTelemetry:
         if not is_initialized():
             return None
         if self._metrics is None:
-            from ray_tpu.util.metrics import Gauge, Histogram
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
             tags = ("label",)
             self._metrics = {
+                "restarts": Counter(
+                    "rl_actor_restarts_total",
+                    "rollout actors restarted by the supervisor",
+                    tag_keys=tags),
                 "rollout_tok": Gauge("rl_rollout_tokens_per_sec",
                                      "actor rollout token throughput",
                                      tag_keys=tags),
@@ -191,6 +213,17 @@ class RLTelemetry:
             if wall > 0:
                 metrics["learner_rate"].set(len(steady) / wall,
                                             tags=tags)
+        except Exception:  # noqa: BLE001 — never tax the loop
+            self._metrics_dead = True
+
+    def _emit_restart(self):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["restarts"].inc(1.0,
+                                        tags={"label": self.label})
         except Exception:  # noqa: BLE001 — never tax the loop
             self._metrics_dead = True
 

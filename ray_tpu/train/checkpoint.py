@@ -166,7 +166,12 @@ def save_pytree(tree, path: str, *, name: str = "state") -> None:
     leaves, treedef = jax.tree.flatten(tree)
     arrays, exotic = {}, {}
     for i, leaf in enumerate(leaves):
-        arr = np.ascontiguousarray(np.asarray(leaf))
+        # NOT ascontiguousarray: it silently promotes 0-d leaves (the
+        # step counter, optimizer counts) to shape (1,), so a restored
+        # TrainState would no longer match the live one
+        arr = np.asarray(leaf)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
         if arr.dtype.kind == "V":      # ml_dtypes: npz can't serialize
             exotic[str(i)] = (str(arr.dtype), arr.shape)
             arr = arr.reshape(-1).view(np.uint8)

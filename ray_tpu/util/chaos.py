@@ -1,4 +1,5 @@
-"""Chaos testing — kill random workers/actors/nodes under load.
+"""Chaos testing — kill random workers/actors/nodes under load, and
+deterministic fault injection for the ML stack.
 
 Parity: the reference's chaos-testing utilities
 (``python/ray/_private/test_utils.py`` get_and_run_resource_killer /
@@ -6,16 +7,157 @@ WorkerKillerActor shapes, used by the chaos release tests): a
 background thread that periodically kills a random victim so fault-
 tolerance paths (task retries, actor restarts, lineage reconstruction,
 node-death recovery) are exercised for real, not just unit-tested.
+
+**Deterministic faults (r15).**  :class:`ResourceKiller` is random by
+design, which is right for soak tests and wrong for acceptance tests:
+a recovery *invariant* ("the RL loop survives an actor death with zero
+steady-state recompiles") needs the death to land at an exact,
+reproducible point.  :class:`FaultPlan` is that: named injection
+**sites** in the ML stack call :func:`maybe_fail`/:func:`should_fire`,
+and a spec — ``RAY_TPU_FAULTS`` or :func:`install_faults` — arms the
+Nth hit of a site to raise :class:`InjectedFault` (or, for action
+sites like checkpoint truncation, to return True so the site corrupts
+itself).  Current sites:
+
+- ``rl.rollout`` — a rollout actor dies entering its Nth rollout
+  (the supervised loop must restart it from the latest weights);
+- ``rl.learner`` — the learner dies entering its Nth update (the
+  supervised loop must restore it from its checkpoint);
+- ``rl.publish`` — the Nth weight publication fails (the loop keeps
+  training; actors stay on the previous version);
+- ``infer.decode`` — the Nth engine decode tick raises *before* the
+  compiled step dispatches (donated buffers untouched — the engine
+  stays drainable);
+- ``ckpt.write`` — the Nth background checkpoint write fails;
+- ``ckpt.truncate`` — the Nth checkpoint write is truncated on disk
+  *after* writing (the resume path must fall back to the previous
+  retained snapshot, loudly).
+
+Spec grammar: comma-separated ``site@N`` entries (``N`` = 1-based hit
+index, fires once; bare ``site`` means ``site@1``), e.g.
+``RAY_TPU_FAULTS="rl.rollout@3,rl.learner@5"``.
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+
+
+# ---------------------------------------------------------------- faults
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault-injection site (never by real code
+    paths) — supervisors treat it like any other death, tests can
+    assert it specifically."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at site {site!r} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+    def __reduce__(self):
+        # rebuild from constructor args, not the message — injected
+        # faults cross process boundaries (killed remote actors)
+        return (InjectedFault, (self.site, self.hit))
+
+
+class FaultPlan:
+    """Parsed fault spec: deterministic per-site hit counters.
+
+    ``fires(site)`` counts one hit of ``site`` and reports whether an
+    armed fault triggers on exactly this hit.  Counters are process-
+    global per plan, so a fixed spec + deterministic call order (the
+    loops here are single-threaded drivers) reproduces the same
+    failure point every run.  ``fired`` logs every triggered
+    ``(site, hit)`` so tests can assert the fault actually landed.
+    """
+
+    def __init__(self, spec: str = ""):
+        self._armed: Dict[str, List[int]] = {}
+        self._hits: Dict[str, int] = {}
+        self.fired: List[Tuple[str, int]] = []
+        self.spec = spec.strip()
+        for entry in self.spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, at = entry.partition("@")
+            site = site.strip()
+            try:
+                hit = int(at) if at else 1
+            except ValueError:
+                raise ValueError(
+                    f"bad RAY_TPU_FAULTS entry {entry!r}: expected "
+                    "'site' or 'site@N' (N = 1-based hit index)")
+            if hit < 1:
+                raise ValueError(
+                    f"bad RAY_TPU_FAULTS entry {entry!r}: hit index "
+                    "must be >= 1")
+            self._armed.setdefault(site, []).append(hit)
+
+    def fires(self, site: str) -> bool:
+        """Count one hit of ``site``; True iff an armed fault triggers
+        on exactly this hit (each armed entry fires at most once)."""
+        hit = self._hits.get(site, 0) + 1
+        self._hits[site] = hit
+        if hit in self._armed.get(site, ()):
+            self.fired.append((site, hit))
+            return True
+        return False
+
+    def hits(self, site: str) -> int:
+        return self._hits.get(site, 0)
+
+
+_PLAN: Optional[FaultPlan] = None
+_PLAN_FROM_ENV = False
+
+
+def install_faults(spec: str) -> FaultPlan:
+    """Arm a fault plan programmatically (tests / drivers); returns it
+    so the caller can assert on ``plan.fired``."""
+    global _PLAN, _PLAN_FROM_ENV
+    _PLAN = FaultPlan(spec)
+    _PLAN_FROM_ENV = True       # explicit install wins over the env
+    return _PLAN
+
+
+def clear_faults() -> None:
+    global _PLAN, _PLAN_FROM_ENV
+    _PLAN = None
+    _PLAN_FROM_ENV = False
+
+
+def fault_plan() -> Optional[FaultPlan]:
+    """The active plan: an installed one, else lazily from the
+    ``RAY_TPU_FAULTS`` env spec (read once), else None."""
+    global _PLAN, _PLAN_FROM_ENV
+    if _PLAN is None and not _PLAN_FROM_ENV:
+        spec = os.environ.get("RAY_TPU_FAULTS", "")
+        _PLAN_FROM_ENV = True
+        if spec.strip():
+            _PLAN = FaultPlan(spec)
+    return _PLAN
+
+
+def should_fire(site: str) -> bool:
+    """Count a hit of an *action* site (the site corrupts something
+    itself when True — e.g. truncating a just-written checkpoint)."""
+    plan = fault_plan()
+    return plan.fires(site) if plan is not None else False
+
+
+def maybe_fail(site: str) -> None:
+    """Count a hit of a *raise* site; raises :class:`InjectedFault`
+    when an armed fault triggers.  Free when no plan is armed."""
+    plan = fault_plan()
+    if plan is not None and plan.fires(site):
+        raise InjectedFault(site, plan.hits(site))
 
 
 class ResourceKiller:

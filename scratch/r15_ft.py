@@ -1,0 +1,168 @@
+"""Round-15 on-chip driver: preemption-tolerance A/Bs.
+
+Usage: python scratch/r15_ft.py <variant>
+
+Variants:
+  ckpt     — checkpoint-stall A/B at the GPT-2 124M recipe:
+             steady step time with RAY_TPU_CKPT_EVERY off / 50 / 10,
+             plus the isolated device->host snapshot latency (the only
+             cost the step loop pays; the write rides the background
+             thread).  The acceptance claim is <1% steady-state
+             overhead at a realistic cadence — this arm prices it on
+             real HBM->host bandwidth instead of the host-sim proxy.
+  recover  — kill-mid-loop RL recovery at the bench shape: an injected
+             rl.rollout kill + rl.learner kill (RAY_TPU_FAULTS) inside
+             run_supervised_rl_loop; reports restart latency, the
+             replacement engine's compile counters (must be all-zero —
+             the shared-executable-cache claim on real Mosaic
+             binaries), learner restore latency from the orbax
+             checkpoint, and the reward curve across the fault.
+
+Carried arms (no chip session yet; every r06-r14 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+rl / swap plus all r6-r13 arms — delegated verbatim to
+scratch/r14_rl.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "ckpt"
+
+_R14_ARMS = ("rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R14_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r14_rl.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r15_ft.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("ckpt", "recover"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if VARIANT == "ckpt":
+    from ray_tpu.models import training
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.resilience import TrainCheckpointer
+
+    if on_tpu:
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        B, S, steps = 8, 1024, 30
+    else:
+        cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=128, dtype=jnp.float32)
+        B, S, steps = 4, 64, 20
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    fns = training.build_gpt_train(cfg, mesh, telemetry=False)
+    state = fns["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), B, S,
+                                        cfg.vocab_size)
+    # isolated snapshot latency: the only on-critical-path cost
+    state, _ = fns["step_fn"](state, batch)      # compile out of the way
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    host = jax.tree.map(np.asarray, state)
+    snap_s = time.perf_counter() - t0
+    del host
+
+    rows = []
+    for every in (0, 50, 10):
+        d = tempfile.mkdtemp(prefix=f"r15_ckpt_{every}_")
+        ck = (TrainCheckpointer(d, every=every, keep=2)
+              if every else None)
+        walls = []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            state, m = fns["step_fn"](state, batch)
+            jax.block_until_ready(m["loss"])
+            if ck is not None:
+                ck.maybe_save(state, step=i + 1)
+            if i > 1:
+                walls.append(time.perf_counter() - t0)
+        if ck is not None:
+            ck.flush()
+            ck.close()
+        walls.sort()
+        rows.append({"every": every,
+                     "step_s_median": walls[len(walls) // 2],
+                     "step_s_max": walls[-1]})
+    base = rows[0]["step_s_median"]
+    print(json.dumps({
+        "arm": "ckpt",
+        "backend": jax.default_backend(),
+        "snapshot_s": snap_s,
+        "rows": rows,
+        "overhead_at_50": rows[1]["step_s_median"] / base - 1,
+        "overhead_at_10": rows[2]["step_s_median"] / base - 1,
+    }), flush=True)
+    sys.exit(0)
+
+# recover — kill-mid-loop RL recovery
+from ray_tpu.resilience import (TrainCheckpointer,  # noqa: E402
+                                run_supervised_rl_loop)
+from ray_tpu.rl.config import RLConfig  # noqa: E402
+from ray_tpu.util import chaos  # noqa: E402
+
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16)
+    rlcfg = RLConfig(actors=2, batch=8, horizon=32, queue=4, max_lag=2)
+    engine_kwargs = {}
+    steps, lr = 16, 1e-4
+else:
+    cfg = GPTConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=64, dtype=jnp.float32)
+    rlcfg = RLConfig(actors=2, batch=6, horizon=8, queue=4, max_lag=2)
+    engine_kwargs = {"slots": 6, "page_size": 16, "buckets": (16,)}
+    steps, lr = 10, 1e-2
+
+d = tempfile.mkdtemp(prefix="r15_recover_")
+plan = chaos.install_faults("rl.rollout@5,rl.learner@7")
+t0 = time.time()
+with TrainCheckpointer(d, every=0, keep=3) as ck:
+    res = run_supervised_rl_loop(cfg, steps=steps, rlcfg=rlcfg,
+                                 seed=3, lr=lr, ckpt=ck, ckpt_every=2,
+                                 engine_kwargs=engine_kwargs,
+                                 telemetry=True)
+chaos.clear_faults()
+curve = res["reward_curve"]
+third = max(len(curve) // 3, 1)
+print(json.dumps({
+    "arm": "recover",
+    "backend": jax.default_backend(),
+    "wall_s": round(time.time() - t0, 1),
+    "fired": [list(f) for f in plan.fired],
+    "actor_restarts": res["actor_restarts"],
+    "learner_restarts": res["learner_restarts"],
+    "restart_compiles": res["restart_compiles"],
+    "reward_first_third": float(np.mean(curve[:third])),
+    "reward_final_third": float(np.mean(curve[-third:])),
+    "drops_stale": res["drops_stale"],
+    "leftover_batches": res["leftover_batches"],
+    "checkpoint": res["checkpoint"],
+    "telemetry": {k: res["telemetry"].get(k) for k in
+                  ("rollout_tokens_per_sec", "learner_steps_per_sec",
+                   "actor_restarts", "learner_restarts",
+                   "backpressure_rejections")},
+}), flush=True)

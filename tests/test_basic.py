@@ -82,6 +82,25 @@ def test_task_error_propagates(ray_start_regular):
         ray.get(bad.remote())
 
 
+def test_task_error_multiarg_cause_still_is_a(ray_start_regular):
+    """r15 regression: the is-a TaskError wrap must survive cause
+    classes whose __init__ takes more than a message — the old wrap
+    called TaskError.__init__, whose cooperative super() continued
+    down the MRO *into* the cause class and degraded the wrap to a
+    plain TaskError that except-cause clauses silently missed (bitten
+    for real by DeadlineExceededError on serve streams)."""
+    ray = ray_start_regular
+
+    from ray_tpu.inference.scheduler import DeadlineExceededError
+
+    @ray.remote(max_retries=0)
+    def bad():
+        raise DeadlineExceededError(7, "ttft", 0.5, 0.9)
+
+    with pytest.raises(DeadlineExceededError, match="ttft deadline"):
+        ray.get(bad.remote())
+
+
 def test_nested_tasks(ray_start_regular):
     ray = ray_start_regular
 

@@ -935,3 +935,282 @@ def test_gpt_deployment_streams_tokens(ray_start_regular):
         [prompt], max_new_tokens=5)[0]
     assert got == want
     serve.delete("gpt")
+
+
+# ------------------------------------------------- deadlines & resilience
+def test_ttft_deadline_expires_waiting_request(tiny_f32):
+    """A request still waiting past its TTFT deadline is shed: typed
+    terminal error event, nothing ever held (r15 — over-deadline work
+    is shed, not queued)."""
+    import time
+
+    from ray_tpu.inference import DeadlineExceededError
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, slots=1, telemetry=True)
+    p = _prompt(8, cfg.vocab_size)
+    r1 = engine.submit(p, max_new_tokens=4)
+    r2 = engine.submit(p, max_new_tokens=4, ttft_deadline_s=1e-4)
+    time.sleep(0.005)                   # r2 is queued behind r1's slot
+    errs, toks = {}, {r1: 0, r2: 0}
+    while engine.has_work():
+        for ev in engine.step():
+            if ev.error is not None:
+                errs[ev[0]] = ev
+            else:
+                toks[ev[0]] += 1
+    assert toks[r1] == 4 and toks[r2] == 0
+    ev = errs[r2]
+    assert ev == (r2, -1, True)          # 3-tuple-compatible terminal
+    assert isinstance(ev.error, DeadlineExceededError)
+    assert ev.error.kind == "ttft" and ev.error.rid == r2
+    # the error rides serve streams across the object store: pickling
+    # must rebuild it from its constructor args (not the message)
+    import pickle
+    back = pickle.loads(pickle.dumps(ev.error))
+    assert (back.rid, back.kind) == (r2, "ttft")
+    assert str(back) == str(ev.error)
+    assert engine.deadline_exceeded == 1
+    assert engine.stats()["deadline_exceeded"] == 1
+    assert engine.telemetry.summary()["deadline_exceeded"] == \
+        {"ttft": 1}
+    assert not engine._requests          # expired requests are pruned
+
+
+def test_total_deadline_retires_mid_decode_and_releases_all(tiny_f32):
+    """Total-deadline expiry mid-decode retires the sequence with its
+    slot, pages and prefix refcounts released — the allocator
+    partition is exact afterwards."""
+    import time
+
+    from ray_tpu.inference import DeadlineExceededError
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, prefix=True)
+    alloc = engine.scheduler.allocator
+    free0 = alloc.free_count
+    rid = engine.submit(_prompt(8, cfg.vocab_size), max_new_tokens=20,
+                        deadline_s=0.05)
+    got, err = 0, None
+    engine.step()                        # prefill tick: first token
+    got += 1
+    time.sleep(0.06)                     # blow the budget mid-decode
+    while engine.has_work():
+        for ev in engine.step():
+            if ev.error is not None:
+                err = ev.error
+            else:
+                got += 1
+    assert isinstance(err, DeadlineExceededError)
+    assert err.kind == "total" and 1 <= got < 20
+    assert len(engine.scheduler.free_slots) == engine.slots
+    assert alloc.free_count == free0
+    # generate() surfaces the typed error instead of hanging (1ns
+    # budget: the first tick's sweep always sees it expired)
+    with pytest.raises(DeadlineExceededError):
+        engine.generate([_prompt(8, cfg.vocab_size, seed=1)],
+                        max_new_tokens=4, deadline_s=1e-9)
+
+
+def test_cancel_before_prefill_releases_prefix_refcounts(tiny_f32):
+    """r15 satellite regression: cancelling a request that was
+    admitted with prefix-cache hits but NOT yet prefilled must release
+    the refcounts admission acquired — the free/idle/held partition
+    stays exact and no page keeps a stray reference."""
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params, slots=4, page_size=8,
+                          buckets=(32,), prefix=True)
+    alloc = engine.scheduler.allocator
+    pp = _prompt(17, cfg.vocab_size, seed=3)   # 2 full pages + tail
+    engine.generate([pp], max_new_tokens=2)    # registers the 2 pages
+    base_idle, base_free = alloc.idle_count, alloc.free_count
+    assert base_idle == 2
+    rid = engine.submit(pp, max_new_tokens=2)
+    # drive admission by hand: the request now holds 2 prefix-hit
+    # refcounts + fresh pages, but its prefill has not run
+    req = engine.scheduler.try_admit()
+    assert req is not None and req.rid == rid and req.n_hit_pages == 2
+    assert alloc.refcount(req.pages[0]) == 1   # revived idle hit
+    engine.cancel(rid)
+    engine.step()                              # cancel processed first
+    assert not engine.has_work()
+    assert alloc.idle_count == base_idle
+    assert alloc.free_count == base_free
+    assert len(engine.scheduler.free_slots) == 4
+    for page in range(1, alloc.num_pages):
+        assert alloc.refcount(page) == 0
+    # the shared pages survived the cancel: a fresh request still hits
+    rid2 = engine.submit(pp, max_new_tokens=2)
+    engine.step()
+    assert engine.scheduler.prefix_requests_hit >= 2
+    while engine.has_work():
+        engine.step()
+
+
+def test_decode_fault_leaves_engine_drainable(tiny_f32):
+    """An injected ``infer.decode`` fault fires before the donated
+    executable dispatches: the engine state stays consistent, cancels
+    drain it clean (the supervisor's actor-replacement contract)."""
+    from ray_tpu.util import chaos
+    cfg, params = tiny_f32
+    engine = _make_engine(cfg, params)
+    alloc = engine.scheduler.allocator
+    free0 = alloc.free_count
+    chaos.install_faults("infer.decode@1")
+    try:
+        rid = engine.submit(_prompt(8, cfg.vocab_size),
+                            max_new_tokens=4)
+        with pytest.raises(chaos.InjectedFault):
+            while engine.has_work():
+                engine.step()
+        engine.cancel(rid)
+        engine.step()                   # fault fired once; tick works
+        assert not engine.has_work()
+        assert alloc.free_count + alloc.idle_count == free0
+        assert len(engine.scheduler.free_slots) == engine.slots
+    finally:
+        chaos.clear_faults()
+
+
+def test_gpt_deployment_deadline_is_stream_error(tiny_f32):
+    """The serve deployment surfaces a deadline expiry as the typed
+    stream error (the client's shed-load signal), and the payload's
+    deadline keys reach the engine."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference import DeadlineExceededError
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 1, "page_size": 16, "buckets": (32,),
+                       "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+    # slot 1 is busy; the deadlined request queues behind it and blows
+    # its TTFT budget on the first pump tick
+    dep.engine.submit(_prompt(6, 512), max_new_tokens=8)
+
+    async def run():
+        agen = dep({"tokens": _prompt(6, 512, seed=2),
+                    "max_new_tokens": 4, "ttft_deadline_s": 1e-4})
+        await asyncio.sleep(0.01)
+        return [tok async for tok in agen]
+
+    with pytest.raises(DeadlineExceededError):
+        asyncio.run(asyncio.wait_for(run(), timeout=60))
+    assert not dep._queues
+    assert dep.engine.deadline_exceeded == 1
+
+
+def test_gpt_deployment_graceful_drain(tiny_f32):
+    """``drain()``: admission stops with a typed error, in-flight
+    streams finish, the engine ends idle (r15 — a scale-down or
+    preemption notice costs zero dropped streams)."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference.serve_gpt import (GPTDeployment,
+                                             ReplicaDrainingError)
+
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 2, "page_size": 16, "buckets": (32,),
+                       "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+
+    async def run():
+        agen = dep({"tokens": _prompt(6, 512), "max_new_tokens": 6})
+        first = await agen.__anext__()          # stream is in flight
+        drain_task = asyncio.create_task(dep.drain())
+        await asyncio.sleep(0.01)
+        # draining: new admissions are rejected with the typed error
+        with pytest.raises(ReplicaDrainingError):
+            async for _ in dep({"tokens": [1, 2], "max_new_tokens": 2}):
+                pass
+        # ... but the in-flight stream runs to completion
+        rest = [tok async for tok in agen]
+        report = await drain_task
+        return first, rest, report
+
+    first, rest, report = asyncio.run(
+        asyncio.wait_for(run(), timeout=60))
+    assert len([first] + rest) == 6
+    assert report["drained"] is True
+    assert report["active"] == 0 and report["waiting"] == 0
+    assert report["free_slots"] == 2
+    assert not dep.engine.has_work()
+    assert dep.telemetry_summary()["draining"] is True
+
+
+@pytest.mark.slow   # the healthy-path drain test stays tier-1; this
+                    # variant re-pays a deployment engine build
+def test_gpt_deployment_drain_survives_dead_pump(tiny_f32):
+    """r15 review hardening: ``drain()`` must not hang when the pump
+    died with work still in the engine (nothing will ever tick it
+    again) — it retires the leftovers host-side and reports idle."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+    from ray_tpu.util import chaos
+
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 2, "page_size": 16, "buckets": (32,),
+                       "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+    chaos.install_faults("infer.decode@1")
+    try:
+        async def run():
+            agen = dep({"tokens": _prompt(6, 512),
+                        "max_new_tokens": 6})
+            with pytest.raises(chaos.InjectedFault):
+                async for _ in agen:
+                    pass                 # pump dies on the decode tick
+            return await asyncio.wait_for(dep.drain(), timeout=30)
+
+        report = asyncio.run(asyncio.wait_for(run(), timeout=60))
+    finally:
+        chaos.clear_faults()
+    assert report["drained"] is True
+    assert report["active"] == 0 and report["waiting"] == 0
+    assert not dep.engine.has_work()
+    assert dep.engine.scheduler.allocator.free_count == \
+        dep.engine.scheduler.allocator.num_pages - 1
+
+
+def test_gpt_deployment_drain_timeout_on_wedged_pump(tiny_f32):
+    """r15 review hardening: ``drain(timeout_s=...)`` must not hang on
+    a pump that is alive but never finishing (a wedged step) — it
+    reports ``drained: False`` without touching engine state, so the
+    preemption handler can escalate."""
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.inference.serve_gpt import GPTDeployment
+
+    dep = GPTDeployment.func_or_class(
+        model="tiny", model_config={"dtype": jnp.float32},
+        engine_config={"slots": 2, "page_size": 16, "buckets": (32,),
+                       "telemetry": False,
+                       "executable_cache": _EXEC_CACHE})
+
+    async def run():
+        dep.engine.submit(_prompt(6, 512), max_new_tokens=4)
+        # a "pump" that never finishes stands in for a wedged step
+        dep._pump_task = asyncio.get_running_loop().create_task(
+            asyncio.sleep(60))
+        report = await asyncio.wait_for(
+            dep.drain(poll_s=0.01, timeout_s=0.1), timeout=10)
+        dep._pump_task.cancel()
+        return report
+
+    report = asyncio.run(asyncio.wait_for(run(), timeout=60))
+    assert report["drained"] is False
+    assert "wedged" in report["reason"]
+    assert report["active"] + report["waiting"] == 1  # state untouched
+    dep.engine.drain_requests()            # test cleanup
+    assert not dep.engine.has_work()

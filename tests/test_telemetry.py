@@ -214,9 +214,14 @@ def test_rl_telemetry_summary():
     for v in (1, 2, 3, 4):
         tel.record_publish(0.002, version=v)
     tel.record_backpressure()
+    tel.record_actor_restart()      # r15: supervisor counters
+    tel.record_actor_restart()
+    tel.record_learner_restart()
     tel.record_queue_counters(drops_stale=5, drops_overflow=1)
     out = tel.summary()
     assert out["enabled"] and out["label"] == "rl"
+    assert out["actor_restarts"] == 2
+    assert out["learner_restarts"] == 1
     assert out["rollouts"] == 3 and out["rollout_tokens"] == 150
     assert out["rollout_tokens_per_sec"] == pytest.approx(500.0)
     assert out["learner_steps"] == 3
@@ -230,6 +235,49 @@ def test_rl_telemetry_summary():
     assert out["backpressure_rejections"] == 1
     off = RLTelemetry(config=TelemetryConfig(enabled=False))
     off.record_rollout(0.1, tokens=1, param_version=1)
+    off.record_actor_restart()
+    assert off.summary() == {"enabled": False}
+
+
+def test_ckpt_telemetry_summary():
+    """r15: the checkpoint recorder's summary block — write counts,
+    failure counter (a failed write must never kill the run, so it has
+    to be observable instead), write-latency stats and the
+    last-persisted-step gauge value — plus the disabled no-op."""
+    from ray_tpu.telemetry import CkptTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = CkptTelemetry(config=TelemetryConfig(enabled=True))
+    assert tel.summary()["last_checkpoint_step"] == -1
+    tel.record_write(0.2, step=50)
+    tel.record_write(0.4, step=100)
+    tel.record_failure()
+    out = tel.summary()
+    assert out["enabled"] and out["label"] == "train"
+    assert out["checkpoints"] == 2 and out["failed"] == 1
+    assert out["last_checkpoint_step"] == 100
+    assert out["write_s"] == pytest.approx(0.3)
+    assert out["write_max_s"] == pytest.approx(0.4)
+    off = CkptTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_write(0.2, step=1)
+    off.record_failure()
+    assert off.summary() == {"enabled": False}
+
+
+def test_infer_telemetry_deadline_counter():
+    """r15: ``infer_deadline_exceeded_total`` rides the infer
+    recorder, split by kind in the summary block."""
+    from ray_tpu.telemetry import InferTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = InferTelemetry(config=TelemetryConfig(enabled=True))
+    tel.record_deadline_exceeded(kind="ttft")
+    tel.record_deadline_exceeded(kind="ttft")
+    tel.record_deadline_exceeded(kind="total")
+    assert tel.summary()["deadline_exceeded"] == \
+        {"ttft": 2, "total": 1}
+    off = InferTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_deadline_exceeded(kind="ttft")
     assert off.summary() == {"enabled": False}
 
 
@@ -333,9 +381,22 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert steps, [ev.get("name") for ev in timeline][:20]
     assert all(ev["ph"] == "X" and ev["dur"] > 0 for ev in steps)
 
+    # r15 resilience series ride the same control plane
+    from ray_tpu.telemetry import (CkptTelemetry, InferTelemetry,
+                                   RLTelemetry)
+    from ray_tpu.telemetry.config import TelemetryConfig
+    on = TelemetryConfig(enabled=True)
+    CkptTelemetry(config=on).record_write(0.1, step=2)
+    RLTelemetry(config=on).record_actor_restart()
+    InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
+
     text = requests.get(f"http://127.0.0.1:{port}/metrics",
                         timeout=10).text
     assert "train_step_seconds" in text, text[:2000]
     assert "user_histogram_train_step_seconds_bucket" in text
     assert "train_mfu" in text
     assert "train_collective_bytes" in text
+    assert "train_checkpoint_seconds" in text
+    assert "train_last_checkpoint_step" in text
+    assert "rl_actor_restarts_total" in text
+    assert "infer_deadline_exceeded_total" in text
