@@ -218,6 +218,37 @@ def _bench_mesh_body(axes):
         print(json.dumps(record))
 
 
+def _bench_fleet_arm(cfg, params, replicas_n, slots, page, affinity,
+                     executables, payloads, gap_s):
+    """One measured fleet arm, scoped so the whole fleet (N engines
+    with full KV caches) frees before the next arm allocates its own
+    — the two arms must never be resident together on a real device."""
+    from ray_tpu.fleet import EngineReplica, FleetRouter, fleet_config
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.telemetry.config import TelemetryConfig
+    from ray_tpu.telemetry.fleet import FleetTelemetry
+
+    engines = [InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, telemetry=True,
+                               max_queue=0,
+                               executable_cache=executables)
+               for _ in range(replicas_n)]
+    router = FleetRouter(
+        [EngineReplica(f"r{i}", e) for i, e in enumerate(engines)],
+        cfg=fleet_config(), affinity=affinity, rng_seed=0,
+        telemetry=FleetTelemetry(config=TelemetryConfig(enabled=True)))
+    dt, streams = _run_fleet_open_loop(router, payloads, gap_s)
+    return {
+        "wall_s": dt,
+        "generated_tokens": sum(len(s.generated) for s in streams),
+        "errors": sum(1 for s in streams if s.error is not None),
+        "ttfts": sorted(router.recent_ttfts()),
+        "telemetries": [e.telemetry.summary() for e in engines],
+        "compiles": [e.stats()["compiles"] for e in engines],
+        "fleet": router.telemetry.summary(),
+    }
+
+
 def _infer_trace(cfg, page, requests, rng_seed=1, shared_pages=3,
                  suffix_lens=None):
     """Open-loop request trace with a shared system prompt: every
@@ -266,6 +297,146 @@ def _run_open_loop(engine, prompts, max_new, gap_s):
         else:
             _time.sleep(min(gap_s, 0.002))
     return _time.perf_counter() - t0, total
+
+
+def _replicas_arg() -> int:
+    if "--replicas" not in sys.argv:
+        return 1
+    idx = sys.argv.index("--replicas")
+    if idx + 1 >= len(sys.argv):
+        raise SystemExit("--replicas needs an argument, e.g. "
+                         "--replicas 4")
+    n = int(sys.argv[idx + 1])
+    if n < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {n}")
+    return n
+
+
+def _run_fleet_open_loop(router, payloads, gap_s):
+    """Submit on a fixed arrival schedule through the router while
+    pumping the fleet; returns (wall seconds, streams)."""
+    import time as _time
+    streams = []
+    submitted = 0
+    t0 = _time.perf_counter()
+    while submitted < len(payloads) or any(not s.done for s in streams):
+        now = _time.perf_counter() - t0
+        while (submitted < len(payloads)
+               and submitted * gap_s <= now):
+            streams.append(router.remote(payloads[submitted]))
+            submitted += 1
+        if not router.poll():
+            _time.sleep(min(gap_s, 0.001))
+    return _time.perf_counter() - t0, streams
+
+
+def bench_infer_fleet(replicas_n: int):
+    """Multi-replica inference arm: ``python bench.py --infer
+    --replicas N`` — a mixed open-loop trace (N shared-prefix groups
+    interleaved) over N in-process replicas behind the fleet router,
+    run twice: affinity routing vs pure pow-2.  Two JSON lines, one
+    per arm, each carrying aggregate tokens/s, p50/p99 TTFT, and the
+    fleet-wide prefix hit rate — the A/B the ROADMAP item 1 asks for:
+    with affinity every group's requests land where its prefix pages
+    live; without, each replica pays a cold prefill per group.  All
+    replicas share one executable cache, so the measured arms show
+    zero compiles (warmed separately)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.inference.config import infer_config
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        slots, page, max_new = 4, 16, 8
+        shared_pages, gap_s = 3, 0.005
+        requests = 8 * replicas_n
+        suffix_lens = [9, 17, 5, 23, 12, 30, 7, 14]
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        icfg = infer_config()
+        slots, page, max_new = icfg.slots, icfg.page_size, 64
+        shared_pages, gap_s = 3, 0.01
+        requests = 16 * replicas_n
+        suffix_lens = [32 + 23 * i % 224 for i in range(requests)]
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    # N prefix groups, requests interleaved round-robin: the mixed
+    # fleet-traffic shape (distinct tenants, each with its own shared
+    # system prompt)
+    groups = [
+        _infer_trace(cfg, page, requests // replicas_n, rng_seed=g + 1,
+                     shared_pages=shared_pages,
+                     suffix_lens=suffix_lens)[0]
+        for g in range(replicas_n)]
+    prompts = [groups[i % replicas_n][i // replicas_n]
+               for i in range(requests)]
+    shared_len = shared_pages * page
+
+    executables = {}
+    # warm BOTH prefill flavors: with the prefix cache off every full
+    # prompt bucket compiles (the spread-traffic cold prefills the
+    # no-affinity arm pays), with it on the cached-suffix buckets do —
+    # the measured fleet then shows zero compiles in either arm
+    for warm_prefix in (False, True):
+        warm = InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, telemetry=False,
+                               max_queue=0, prefix=warm_prefix,
+                               executable_cache=executables)
+        _run_open_loop(warm, prompts, max_new, gap_s=0.0)
+        del warm
+
+    payloads = [{"tokens": p, "max_new_tokens": max_new}
+                for p in prompts]
+    for affinity in (True, False):
+        arm = _bench_fleet_arm(cfg, params, replicas_n, slots, page,
+                               affinity, executables, payloads, gap_s)
+        dt, ttfts = arm["wall_s"], arm["ttfts"]
+        tels = arm["telemetries"]
+        prompt_tokens = sum(t.get("prompt_tokens", 0) for t in tels)
+        skipped = sum(t.get("prefill_tokens_skipped", 0) for t in tels)
+        record = {
+            "metric": "gpt_infer_fleet_tokens_per_sec",
+            "value": round(arm["generated_tokens"] / dt, 1)
+            if dt > 0 else 0.0,
+            "unit": "tokens/s",
+            "platform": platform,
+            "model_params": None if quick else 124_000_000,
+            "replicas": replicas_n,
+            "affinity": affinity,
+            "requests": requests,
+            "generated_tokens": arm["generated_tokens"],
+            "errors": arm["errors"],
+            "wall_s": round(dt, 3),
+            "slots": slots,
+            "page_size": page,
+            "open_loop_gap_s": gap_s,
+            "prefix_groups": replicas_n,
+            "shared_prompt_tokens": shared_len,
+            "fleet_prefix_hit_rate": round(
+                skipped / prompt_tokens, 4) if prompt_tokens else 0.0,
+            "ttft_p50_s": round(
+                statistics.median(ttfts), 4) if ttfts else 0.0,
+            "ttft_p99_s": round(
+                ttfts[min(len(ttfts) - 1,
+                          int(0.99 * len(ttfts)))], 4)
+                if ttfts else 0.0,
+            # zero steady-state recompiles across the whole fleet: the
+            # measured replicas ride the warmup's shared executables
+            "compiles": arm["compiles"],
+            "fleet": arm["fleet"],
+        }
+        print(json.dumps(record))
 
 
 def bench_infer():
@@ -470,7 +641,11 @@ def main():
     from ray_tpu.parallel.mesh import make_mesh
 
     if "--infer" in sys.argv:
-        bench_infer()
+        n = _replicas_arg()
+        if n > 1:
+            bench_infer_fleet(n)
+        else:
+            bench_infer()
         return
     if "--rl" in sys.argv:
         bench_rl()

@@ -1,0 +1,45 @@
+"""``ray_tpu.fleet`` — fleet-scale serving: N replicas as one service.
+
+Everything below the replica was built in r10–r15 (the engine, the
+prefix cache, deadlines/drain/watchdog); this package is the layer
+above it:
+
+- :class:`~ray_tpu.fleet.router.FleetRouter` — a
+  ``DeploymentHandle``-shaped router over
+  :class:`~ray_tpu.fleet.replica.EngineReplica` objects:
+  power-of-two-choices on queue depth, **prefix affinity** (prompts
+  route to the replica whose r12 prefix index already holds their
+  pages — the cache works fleet-wide), and **mid-stream failover**
+  (a dead or wedged replica's streams re-admit on a healthy one,
+  re-prefilling prompt + already-emitted tokens; at-most-once token
+  delivery, typed :class:`~ray_tpu.fleet.router.
+  ReplicaUnavailableError` only when retries exhaust).
+- :class:`~ray_tpu.fleet.reconciler.Reconciler` — an
+  autoscaler-v2-style instance state machine (STARTING → RUNNING →
+  DRAINING → STOPPED / WEDGED → RESTARTING): watchdog-signalled
+  restarts with capped backoff, queue-depth / TTFT-SLO scale-up,
+  drain-based zero-dropped-streams scale-down, anti-flap dwell.
+
+Recovery invariants are proven under deterministic ``RAY_TPU_FAULTS``
+plans (sites ``serve.replica`` / ``serve.route`` in
+:mod:`ray_tpu.util.chaos`).  Config via ``RAY_TPU_FLEET_*``
+(:func:`fleet_config`).
+"""
+
+from ray_tpu.fleet.config import FleetConfig, fleet_config  # noqa: F401
+from ray_tpu.fleet.reconciler import (DRAINING, RESTARTING,  # noqa: F401
+                                      RUNNING, STARTING, STOPPED,
+                                      WEDGED, Instance, Reconciler)
+from ray_tpu.fleet.replica import EngineReplica  # noqa: F401
+from ray_tpu.fleet.router import (FleetRouter,  # noqa: F401
+                                  FleetStream,
+                                  ReplicaUnavailableError)
+
+__all__ = [
+    "FleetConfig", "fleet_config",
+    "EngineReplica", "FleetRouter", "FleetStream",
+    "ReplicaUnavailableError",
+    "Reconciler", "Instance",
+    "STARTING", "RUNNING", "DRAINING", "STOPPED", "WEDGED",
+    "RESTARTING",
+]

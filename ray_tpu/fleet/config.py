@@ -1,0 +1,90 @@
+"""Fleet-layer env knobs — the single home for router/reconciler config.
+
+Follows the ``infer_config()`` / ``rl_config()`` precedent: one frozen
+dataclass resolved from the environment once, ``refresh=True`` for
+tests and A/B drivers that flip flags after import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet router/reconciler knobs, resolved once from the environment.
+
+    - ``RAY_TPU_FLEET_RETRIES`` (default ``2``): mid-stream failover
+      budget per request — how many times a stream may be re-admitted
+      on a healthy replica after its replica died or wedged before the
+      router gives up with a typed
+      :class:`~ray_tpu.fleet.router.ReplicaUnavailableError`.
+      Draining/queue-full rejections are immediate re-route signals
+      and do **not** consume this budget (each replica is tried at
+      most once per routing attempt, so re-routing always terminates).
+    - ``RAY_TPU_FLEET_AFFINITY`` (default ``1``): prefix-affinity
+      routing — prompts whose chained page hashes hit a replica's
+      prefix index route to that replica (the r12 cache working
+      fleet-wide); ``0`` falls back to pure power-of-two-choices.
+    - ``RAY_TPU_FLEET_AFFINITY_CAP`` (default ``8``): queue-depth cap
+      above which an affinity hit is overridden — a hot replica must
+      not absorb every shared-prefix request while its neighbours sit
+      idle (the arXiv:2011.03641 saturated-not-overloaded argument).
+    - ``RAY_TPU_FLEET_UP_DEPTH`` (default ``4``): mean waiting-queue
+      depth per running replica that, sustained for the dwell, scales
+      the fleet up.
+    - ``RAY_TPU_FLEET_TTFT_SLO`` (default ``0`` = off): TTFT SLO in
+      seconds — recent first-token latencies above this, sustained
+      for the dwell, also scale up (queue depth can look fine while
+      TTFT burns on slow prefills).
+    - ``RAY_TPU_FLEET_DWELL`` (default ``5``): anti-flap hysteresis in
+      seconds — the minimum time a scale signal must persist before
+      the reconciler acts, and the minimum dwell in a state before a
+      voluntary transition (failure transitions are immediate).
+    - ``RAY_TPU_FLEET_BACKOFF`` (default ``0.5``) /
+      ``RAY_TPU_FLEET_BACKOFF_MAX`` (default ``30``): restart backoff
+      — a wedged/dead replica restarts after
+      ``min(backoff * 2**restarts, backoff_max)`` seconds, so a
+      crash-looping replica cannot hot-loop the factory.
+    """
+    retries: int = 2
+    affinity: bool = True
+    affinity_cap: int = 8
+    up_depth: float = 4.0
+    ttft_slo: float = 0.0
+    dwell: float = 5.0
+    backoff: float = 0.5
+    backoff_max: float = 30.0
+
+
+_CONFIG: Optional[FleetConfig] = None
+
+
+def fleet_config(refresh: bool = False) -> FleetConfig:
+    """The process-wide :class:`FleetConfig` (env read once, cached)."""
+    global _CONFIG
+    if _CONFIG is None or refresh:
+        env = os.environ.get
+
+        def nonneg(name, default, cast=float):
+            val = cast(env(name, default))
+            if val < 0:
+                print(f"{name}={val} negative; using {default}",
+                      file=sys.stderr)
+                return cast(default)
+            return val
+
+        _CONFIG = FleetConfig(
+            retries=nonneg("RAY_TPU_FLEET_RETRIES", "2", int),
+            affinity=env("RAY_TPU_FLEET_AFFINITY", "1") != "0",
+            affinity_cap=nonneg("RAY_TPU_FLEET_AFFINITY_CAP", "8", int),
+            up_depth=nonneg("RAY_TPU_FLEET_UP_DEPTH", "4"),
+            ttft_slo=nonneg("RAY_TPU_FLEET_TTFT_SLO", "0"),
+            dwell=nonneg("RAY_TPU_FLEET_DWELL", "5"),
+            backoff=nonneg("RAY_TPU_FLEET_BACKOFF", "0.5"),
+            backoff_max=nonneg("RAY_TPU_FLEET_BACKOFF_MAX", "30"),
+        )
+    return _CONFIG

@@ -1,0 +1,181 @@
+"""One fleet replica: an inference engine plus health/drain state.
+
+The fleet layer is host-driven by design (the Podracer pattern one
+level up): the router owns the tick loop and calls :meth:`step` on
+every replica with work, so a deterministic ``RAY_TPU_FAULTS`` plan
+reproduces the same death/wedge point every run — the property the
+chaos acceptance tests are built on.  A replica wraps one
+:class:`~ray_tpu.inference.engine.InferenceEngine` (replicas of one
+fleet share the executable cache, so scale-up and restart compile
+nothing) and carries the three health signals the router and
+reconciler consume:
+
+- **alive**: flips False when a step raises (the ``serve.replica``
+  chaos site fires at the top of :meth:`step`, before any engine
+  mutation — an injected death leaves the engine state consistent for
+  the host-side reap);
+- **wedged**: the r15 :class:`~ray_tpu.resilience.watchdog.
+  EngineWatchdog` signal, probed manually by the router's poll loop
+  (no background thread — deterministic under test clocks);
+- **draining**: admission stopped (``submit`` raises the typed
+  :class:`~ray_tpu.inference.serve_gpt.ReplicaDrainingError`, the
+  router's immediate re-route signal) while in-flight sequences decode
+  to completion — the zero-dropped-streams scale-down path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.inference.engine import InferenceEngine, StepEvent
+
+
+class EngineReplica:
+    """One engine behind the fleet router.
+
+    ``watchdog_s`` arms a manual-probe wedge detector (the router
+    calls :meth:`check` each poll; no thread, so tests drive it with
+    explicit clocks).  ``replica_id`` must be unique within a fleet —
+    the router keys stream bindings by ``(replica_id, rid)`` so a
+    failed-over request's stale events can never leak into its
+    stream.
+    """
+
+    def __init__(self, replica_id: str, engine: InferenceEngine, *,
+                 watchdog_s: float = 0.0):
+        self.id = replica_id
+        self.engine = engine
+        self.alive = True
+        self.draining = False
+        self.watchdog = None
+        if watchdog_s:
+            from ray_tpu.resilience.watchdog import EngineWatchdog
+            # NOT .start()ed: the router's poll loop probes check()
+            self.watchdog = EngineWatchdog(engine, timeout_s=watchdog_s)
+        # test/chaos hook: a "wedged" replica has work but its step
+        # stops ticking (the engine stamp freezes -> the watchdog
+        # fires); real wedges are a hung device step, which host-sim
+        # cannot produce in a single-threaded drive loop
+        self._stalled = False
+        self.reaped = False
+        # prefix-digest memo, keyed by engine tick: registrations only
+        # happen inside step() (which bumps ticks), so within one
+        # router poll the digest is immutable — the routing hot path
+        # must not rebuild an O(pages) frozenset per candidate per
+        # request.  (A set_params prefix flush without a tick can
+        # serve one stale digest: a routing-quality blip, never a
+        # correctness one — admission re-walks the real index.)
+        self._digest: Optional[frozenset] = None
+        self._digest_ticks = -1
+
+    # --------------------------------------------------------- admission
+    def submit(self, prompt, *, max_new_tokens: int, sampling=None,
+               eos_token=None, ttft_deadline_s=None,
+               deadline_s=None) -> int:
+        """Admit one request; raises the typed re-route signals
+        (``ReplicaDrainingError`` / ``QueueFullError``) the router
+        retries on, or ``ValueError`` for a request this fleet's
+        geometry can never serve (the router fails the stream)."""
+        if not self.alive:
+            raise RuntimeError(f"replica {self.id} is dead — the "
+                               "router must not route to it")
+        if self.draining:
+            from ray_tpu.inference.serve_gpt import ReplicaDrainingError
+            raise ReplicaDrainingError(
+                f"replica {self.id} is draining: admission stopped, "
+                "in-flight requests finishing — route elsewhere")
+        return self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                  sampling=sampling, eos_token=eos_token,
+                                  ttft_deadline_s=ttft_deadline_s,
+                                  deadline_s=deadline_s)
+
+    # -------------------------------------------------------------- tick
+    def step(self) -> List[StepEvent]:
+        """One engine tick.  The ``serve.replica`` fault site fires
+        BEFORE the engine steps (donated buffers untouched, scheduler
+        consistent) and any raise — injected or real — marks the
+        replica dead before propagating, so the router's failover path
+        sees a consistent corpse."""
+        from ray_tpu.util import chaos
+        if self._stalled:
+            return []                  # wedge: work pending, no tick
+        try:
+            chaos.maybe_fail("serve.replica")
+            return self.engine.step()
+        except BaseException:
+            self.alive = False
+            raise
+
+    # ------------------------------------------------------------ health
+    @property
+    def wedged(self) -> bool:
+        return self.watchdog is not None and self.watchdog.wedges > 0
+
+    @property
+    def wedges(self) -> int:
+        return self.watchdog.wedges if self.watchdog is not None else 0
+
+    def check(self, now: Optional[float] = None) -> None:
+        """Probe the watchdog (the router calls this each poll)."""
+        if self.watchdog is not None:
+            self.watchdog.check(now)
+
+    def stall(self) -> None:
+        """Wedge this replica (test/driver hook): work stops ticking,
+        the engine stamps freeze, and the next watchdog probe past the
+        budget declares the wedge."""
+        self._stalled = True
+
+    def has_work(self) -> bool:
+        return self.alive and self.engine.has_work()
+
+    def queue_depth(self) -> int:
+        """Waiting + active — the pow-2 load signal."""
+        sched = self.engine.scheduler
+        return len(sched.waiting) + len(sched.active)
+
+    def waiting_depth(self) -> int:
+        return len(self.engine.scheduler.waiting)
+
+    def prefix_digest(self) -> frozenset:
+        ticks = self.engine.ticks
+        if self._digest is None or self._digest_ticks != ticks:
+            self._digest = self.engine.prefix_digest()
+            self._digest_ticks = ticks
+        return self._digest
+
+    # ------------------------------------------------------------- drain
+    def drain(self) -> None:
+        self.draining = True
+
+    @property
+    def drained(self) -> bool:
+        return self.draining and not self.engine.has_work()
+
+    # -------------------------------------------------------------- reap
+    def reap(self) -> int:
+        """Host-side teardown for a dead/wedged replica being replaced:
+        retire every request so slots/pages/prefix refcounts release
+        (the r15 dead-actor precedent — the corpse must audit clean
+        before it is dropped).  Returns retired-request count."""
+        self.reaped = True
+        return self.engine.drain_requests()
+
+    def leak_free(self) -> bool:
+        """Fleet-wide leak audit: every slot free, every page either
+        free or parked idle in the prefix pool, nothing in flight."""
+        sched = self.engine.scheduler
+        return (not sched.active and not sched.waiting
+                and len(sched.free_slots) == self.engine.slots
+                and sched.allocator.free_count
+                == sched.allocator.num_pages - 1)
+
+    def stats(self) -> Dict[str, Any]:
+        out = self.engine.stats()
+        out["replica"] = self.id
+        out["alive"] = self.alive
+        out["draining"] = self.draining
+        out["wedges"] = self.wedges
+        out["last_wedge_ts"] = (self.watchdog.last_wedge_ts
+                                if self.watchdog is not None else None)
+        return out

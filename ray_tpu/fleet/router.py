@@ -1,0 +1,465 @@
+"""Health-aware replica router: pow-2 choices, prefix affinity,
+mid-stream failover.
+
+The layer that makes N replicas look like one reliable service.  The
+API is ``DeploymentHandle``-shaped — ``router.remote(payload)`` takes
+the :class:`~ray_tpu.inference.serve_gpt.GPTDeployment` request dict
+and returns a stream you iterate — but the router runs host-side over
+:class:`~ray_tpu.fleet.replica.EngineReplica` objects and drives their
+engine ticks itself (:meth:`FleetRouter.poll`), so every routing and
+recovery decision is deterministic under a ``RAY_TPU_FAULTS`` plan.
+
+**Routing** (per request): with affinity on, the prompt's chained page
+hashes (the r12 :class:`~ray_tpu.inference.kv_cache.PrefixIndex`
+keys) are matched against each healthy replica's
+:meth:`~ray_tpu.fleet.replica.EngineReplica.prefix_digest`; the
+longest-hit replica wins if it is under the affinity queue-depth cap —
+the fleet-wide prefix cache.  Otherwise power-of-two-choices on queue
+depth (SURVEY: Serve's ``pow_2_scheduler.py``): sample two, take the
+shallower queue — near-least-loaded at O(1) probe cost.
+
+**Failover**: a replica death (``serve.replica`` chaos site, or any
+step raise) or a watchdog wedge mid-stream re-admits every bound
+request on a healthy replica — re-prefilling from the original prompt
+*plus the tokens already emitted*, with ``max_new`` reduced by the
+same count, so delivery is at-most-once by construction (the stream
+asserts it).  Stale events from a wedged replica that later revives
+cannot reach the stream: bindings are keyed ``(replica_id, rid)`` and
+dropped at failover.  ``ReplicaDrainingError`` / ``QueueFullError`` /
+a ``serve.route`` submit fault are immediate re-route signals (each
+replica tried at most once per attempt); only death/wedge failovers
+consume the ``RAY_TPU_FLEET_RETRIES`` budget, and exhausting it — or
+running out of healthy replicas — surfaces a typed
+:class:`ReplicaUnavailableError` on the stream, never a hang.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.fleet.config import FleetConfig, fleet_config
+from ray_tpu.fleet.replica import EngineReplica
+from ray_tpu.inference.kv_cache import PrefixIndex
+from ray_tpu.inference.scheduler import QueueFullError
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """Typed routing failure: the failover budget is exhausted or no
+    healthy replica remains — the caller sees this on the stream, not
+    a hang (the fleet's zero-hung-streams contract)."""
+
+    def __init__(self, msg: str, *, retries: int = 0):
+        super().__init__(msg)
+        self.retries = retries
+
+
+class FleetStream:
+    """One routed request: iterate tokens as they land (the
+    ``DeploymentResponseGenerator`` shape).  Iteration pumps the
+    router's poll loop; a typed error — deadline expiry, exhausted
+    failover — raises out of ``__next__``."""
+
+    def __init__(self, router: "FleetRouter", payload: Dict[str, Any]):
+        from ray_tpu.inference.serve_gpt import parse_request
+        self._router = router
+        self.prompt = [int(t) for t in payload["tokens"]]
+        parsed = parse_request(payload)    # the deployment's parser:
+        self.max_new_tokens = parsed["max_new_tokens"]  # no drift
+        self.sampling = parsed["sampling"]
+        self.want_logprobs = parsed["want_logprobs"]
+        self.eos_token = parsed["eos_token"]
+        self.ttft_deadline_s = parsed["ttft_deadline_s"]
+        self.deadline_s = parsed["deadline_s"]
+        self.submitted_ts = time.monotonic()
+        self.first_token_ts: Optional[float] = None
+        # every token the fleet has emitted for this request, in order
+        # (the failover re-prefill source), with its model logprob
+        # beside it; _cursor is how far the consumer has read
+        self.generated: List[int] = []
+        self.logprobs: List[float] = []
+        self._cursor = 0
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.retries = 0                  # death/wedge failovers only
+        self.replica_id: Optional[str] = None
+        self.rid: Optional[int] = None
+
+    # ------------------------------------------------- router callbacks
+    def _push(self, token: int, logprob: float) -> None:
+        if len(self.generated) >= self.max_new_tokens:
+            # at-most-once delivery is structural (failover re-admits
+            # with max_new reduced by the emitted count) — a violation
+            # is a router bug, surfaced loudly
+            raise AssertionError(
+                f"stream got token {len(self.generated) + 1} of "
+                f"{self.max_new_tokens}: duplicate delivery after "
+                "failover")
+        if self.first_token_ts is None:
+            self.first_token_ts = time.monotonic()
+            self._router._record_ttft(
+                self.first_token_ts - self.submitted_ts)
+        self.generated.append(int(token))
+        self.logprobs.append(float(logprob))
+
+    def _finish(self) -> None:
+        self.done = True
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        self.done = True
+
+    # ---------------------------------------------------------- consume
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        while self._cursor >= len(self.generated):
+            if self.error is not None:
+                raise self.error
+            if self.done:
+                raise StopIteration
+            if not self._router.poll():
+                # no replica ticked (e.g. a wedge waiting out its
+                # watchdog budget): yield the cpu instead of spinning
+                time.sleep(0.001)
+        tok = self.generated[self._cursor]
+        lp = self.logprobs[self._cursor]
+        self._cursor += 1
+        # same item shape as the deployment's stream: bare token ids,
+        # or {"token", "logprob"} dicts under {"logprobs": True}
+        return {"token": tok, "logprob": lp} if self.want_logprobs \
+            else tok
+
+    def result(self) -> List[int]:
+        """Drain to completion and return every token (raises the
+        stream's typed error like iteration does)."""
+        for _ in self:
+            pass
+        return list(self.generated)
+
+    def close(self) -> None:
+        """Abandon the stream: cancel the in-flight request so its
+        slot/pages/prefix refs free within a tick."""
+        self._router._cancel_stream(self)
+
+
+class FleetRouter:
+    """Route requests over a set of replicas and drive their ticks.
+
+    ``replicas`` seed the fleet (the reconciler adds/removes later);
+    all replicas must share page size and bucket geometry (the prefix
+    hashes and re-admission lengths assume it — checked here).
+    ``rng_seed`` pins the pow-2 sampling so routing distributions are
+    reproducible in tests and benchmarks.
+    """
+
+    _TTFT_WINDOW = 256
+
+    def __init__(self, replicas: List[EngineReplica], *,
+                 cfg: Optional[FleetConfig] = None,
+                 affinity: Optional[bool] = None,
+                 rng_seed: int = 0, telemetry=None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.cfg = cfg or fleet_config()
+        self.affinity = (self.cfg.affinity if affinity is None
+                         else bool(affinity))
+        self._replicas: "collections.OrderedDict[str, EngineReplica]" \
+            = collections.OrderedDict()
+        self._rng = random.Random(rng_seed)
+        # (replica_id, rid) -> stream; dropped at failover so a stale
+        # event from a revived wedge can never reach a re-homed stream
+        self._by_rid: Dict[Tuple[str, int], FleetStream] = {}
+        self._ttfts: "collections.deque[float]" = collections.deque(
+            maxlen=self._TTFT_WINDOW)
+        if telemetry is None:
+            from ray_tpu.telemetry.fleet import FleetTelemetry
+            telemetry = FleetTelemetry()
+        self.telemetry = telemetry
+        self.page_size = replicas[0].engine.page_size
+        self.buckets = replicas[0].engine.buckets
+        for r in replicas:
+            self.add_replica(r)
+
+    # ----------------------------------------------------------- fleet
+    def add_replica(self, replica: EngineReplica) -> None:
+        if replica.id in self._replicas:
+            raise ValueError(f"duplicate replica id {replica.id!r}")
+        if replica.engine.page_size != self.page_size \
+                or replica.engine.buckets != self.buckets:
+            # one fleet geometry: the prefix hashes assume the page
+            # size and failover re-admission assumes every replica
+            # accepts the same prompt lengths
+            raise ValueError(
+                f"replica {replica.id!r} geometry (page_size "
+                f"{replica.engine.page_size}, buckets "
+                f"{replica.engine.buckets}) != fleet (page_size "
+                f"{self.page_size}, buckets {self.buckets})")
+        self._replicas[replica.id] = replica
+
+    def remove_replica(self, replica_id: str) -> EngineReplica:
+        """Drop a replica from routing.  Refuses while streams are
+        still bound to it — scale-down must drain first (zero dropped
+        streams); dead/wedged replicas are unbound by failover."""
+        bound = [k for k in self._by_rid if k[0] == replica_id]
+        if bound:
+            raise ValueError(
+                f"replica {replica_id!r} still has {len(bound)} "
+                "in-flight stream(s) — drain (or fail over) first")
+        # drop the gauge state too, or a long-running fleet's
+        # queue-depth series grows one stale replica per restart
+        self.telemetry.forget_replica(replica_id)
+        return self._replicas.pop(replica_id)
+
+    def replicas(self) -> List[EngineReplica]:
+        return list(self._replicas.values())
+
+    def bound_streams(self, replica_id: str) -> int:
+        """How many in-flight streams are bound to a replica (the
+        reconciler's retire gate: removal requires zero)."""
+        return sum(1 for k in self._by_rid if k[0] == replica_id)
+
+    def healthy(self) -> List[EngineReplica]:
+        return [r for r in self._replicas.values()
+                if r.alive and not r.draining and not r.wedged]
+
+    # --------------------------------------------------------- routing
+    def remote(self, payload: Dict[str, Any]) -> FleetStream:
+        """Route one request (the ``GPTDeployment`` payload dict) and
+        return its stream.  Routing failures surface as the stream's
+        typed error at first iteration — the streaming-path contract
+        (``QueueFullError`` precedent), never an exception here."""
+        stream = FleetStream(self, payload)
+        try:
+            self._route(stream)
+        except (ReplicaUnavailableError, ValueError) as e:
+            stream._fail(e)
+        return stream
+
+    def _chain_hashes(self, prompt: List[int]) -> List[bytes]:
+        """Hit-eligible chained page hashes of a prompt — the
+        scheduler's own walk (shared helper, so the hashing scheme
+        and the final-page eligibility rule can never drift between
+        routing and admission)."""
+        eligible = PrefixIndex.hit_eligible(len(prompt),
+                                            self.page_size)
+        return PrefixIndex.chain_hashes(prompt,
+                                        self.page_size)[:eligible]
+
+    def _affinity_pick(self, prompt, cands) -> Optional[EngineReplica]:
+        hashes = self._chain_hashes(prompt)
+        if not hashes:
+            return None
+        best, best_hits = None, 0
+        for r in cands:
+            digest = r.prefix_digest()
+            hits = 0
+            for h in hashes:
+                if h not in digest:
+                    break
+                hits += 1
+            if hits > best_hits:
+                best, best_hits = r, hits
+        if best is not None \
+                and best.queue_depth() < self.cfg.affinity_cap:
+            return best
+        return None             # no hit, or the hit replica is hot
+
+    def _pow2_pick(self, cands) -> EngineReplica:
+        if len(cands) == 1:
+            return cands[0]
+        a, b = self._rng.sample(cands, 2)
+        return a if a.queue_depth() <= b.queue_depth() else b
+
+    def _route(self, stream: FleetStream) -> None:
+        """Pick a replica and submit; draining/queue-full/route-fault
+        rejections re-route immediately (each replica tried at most
+        once).  Raises :class:`ReplicaUnavailableError` when no
+        healthy replica accepts."""
+        from ray_tpu.inference.serve_gpt import ReplicaDrainingError
+        from ray_tpu.util import chaos
+        # failover re-prefill: prompt plus every already-emitted token
+        prompt = stream.prompt + stream.generated
+        remaining = stream.max_new_tokens - len(stream.generated)
+        if len(prompt) > self.buckets[-1]:
+            # the grown prompt outruns the fleet's largest prefill
+            # bucket: the original request was admissible but its
+            # re-admission is not — a geometry limit (size buckets to
+            # cover prompt + max_new when failover must always work),
+            # surfaced typed instead of as a raw engine ValueError
+            raise ReplicaUnavailableError(
+                f"failover re-prefill needs {len(prompt)} prompt "
+                f"tokens but the fleet's largest prefill bucket is "
+                f"{self.buckets[-1]} — size RAY_TPU_INFER_BUCKETS to "
+                "cover prompt + max_new_tokens for failover-proof "
+                "requests", retries=stream.retries)
+        excluded: set = set()
+        while True:
+            cands = [r for r in self.healthy()
+                     if r.id not in excluded]
+            if not cands:
+                raise ReplicaUnavailableError(
+                    f"no healthy replica accepted the request "
+                    f"({len(self._replicas)} total, "
+                    f"{len(excluded)} rejected this attempt, "
+                    f"{stream.retries} failover(s) used)",
+                    retries=stream.retries)
+            replica = None
+            if self.affinity:
+                replica = self._affinity_pick(prompt, cands)
+                if not excluded and stream.retries == 0:
+                    # one decision per REQUEST: re-routes and failover
+                    # re-admissions must not multiply-count a request
+                    # in the hit-rate gauge (failovers skew toward
+                    # hits — the re-prefill is resident fleet-wide —
+                    # which would inflate the metric exactly when the
+                    # fleet is unhealthy)
+                    self.telemetry.record_affinity(
+                        hit=replica is not None)
+            if replica is None:
+                replica = self._pow2_pick(cands)
+            try:
+                chaos.maybe_fail("serve.route")
+                rid = replica.submit(
+                    prompt, max_new_tokens=remaining,
+                    sampling=stream.sampling,
+                    eos_token=stream.eos_token,
+                    ttft_deadline_s=stream.ttft_deadline_s,
+                    deadline_s=stream.deadline_s)
+            except chaos.InjectedFault:
+                # a routed submit failed in flight: indistinguishable
+                # from a dead target at the router — re-route
+                self.telemetry.record_retry("dead")
+                excluded.add(replica.id)
+                continue
+            except ReplicaDrainingError:
+                self.telemetry.record_retry("draining")
+                excluded.add(replica.id)
+                continue
+            except QueueFullError:
+                self.telemetry.record_retry("queue_full")
+                excluded.add(replica.id)
+                continue
+            stream.replica_id, stream.rid = replica.id, rid
+            self._by_rid[(replica.id, rid)] = stream
+            return
+
+    # ------------------------------------------------------- tick loop
+    def poll(self) -> bool:
+        """One fleet tick: probe watchdogs, step every live replica
+        with work, dispatch events, fail streams over from dead or
+        wedged replicas.  Returns whether any replica made progress
+        (consumers back off briefly when none did)."""
+        progressed = False
+        for replica in list(self._replicas.values()):
+            if not replica.alive:
+                self._on_replica_down(replica, reap=True)
+                continue
+            replica.check()
+            if replica.wedged:
+                self._on_replica_down(replica, reap=False)
+                continue
+            if not replica.has_work():
+                continue
+            try:
+                events = replica.step()
+            except BaseException:  # noqa: BLE001 — death IS the event
+                self._on_replica_down(replica, reap=True)
+                continue
+            progressed = progressed or bool(events)
+            for ev in events:
+                self._dispatch(replica, ev)
+        self._record_depths()
+        return progressed
+
+    def _dispatch(self, replica: EngineReplica, ev) -> None:
+        rid, token, done = ev
+        key = (replica.id, rid)
+        stream = self._by_rid.get(key)
+        if stream is None:
+            return                       # cancelled/stale binding
+        if ev.error is not None:
+            # deadline expiry: policy shed the request (everything
+            # already released engine-side) — typed error, no failover
+            del self._by_rid[key]
+            stream._fail(ev.error)
+            return
+        stream._push(token, ev.logprob)
+        if done:
+            del self._by_rid[key]
+            stream._finish()
+
+    def _on_replica_down(self, replica: EngineReplica,
+                         *, reap: bool) -> None:
+        """Fail every stream bound to a dead/wedged replica over to a
+        healthy one.  Dead replicas are reaped host-side (slots/pages/
+        prefix refcounts released — the corpse audits clean); a wedged
+        replica keeps its engine state for the reconciler's restart,
+        but its bound rids are cancelled so a revival cannot keep
+        decoding for streams that have moved on."""
+        bound = [(k, s) for k, s in list(self._by_rid.items())
+                 if k[0] == replica.id]
+        for key, stream in bound:
+            del self._by_rid[key]
+            if replica.alive:
+                replica.engine.cancel(key[1])
+            self._failover(stream)
+        if reap and not replica.alive and not replica.reaped:
+            replica.reap()
+
+    def _failover(self, stream: FleetStream) -> None:
+        self.telemetry.record_retry("dead")
+        stream.retries += 1
+        if stream.retries > self.cfg.retries:
+            stream._fail(ReplicaUnavailableError(
+                f"failover budget exhausted after {stream.retries - 1} "
+                f"retr{'y' if stream.retries == 2 else 'ies'} "
+                "(RAY_TPU_FLEET_RETRIES)", retries=stream.retries - 1))
+            return
+        try:
+            self._route(stream)
+        except (ReplicaUnavailableError, ValueError) as e:
+            stream._fail(e)
+
+    def _cancel_stream(self, stream: FleetStream) -> None:
+        if stream.replica_id is None or stream.done:
+            return
+        key = (stream.replica_id, stream.rid)
+        self._by_rid.pop(key, None)
+        replica = self._replicas.get(stream.replica_id)
+        if replica is not None and replica.alive:
+            replica.engine.cancel(stream.rid)
+        stream._finish()
+
+    # ------------------------------------------------------ observability
+    def _record_ttft(self, ttft_s: float) -> None:
+        self._ttfts.append(ttft_s)
+
+    def recent_ttfts(self) -> List[float]:
+        """Recent first-token latencies (the reconciler's SLO signal
+        and the bench's percentile source)."""
+        return list(self._ttfts)
+
+    def _record_depths(self) -> None:
+        for r in self._replicas.values():
+            if r.alive:
+                self.telemetry.record_queue_depth(r.id, r.queue_depth())
+
+    def leak_free(self) -> bool:
+        """Fleet-wide invariant: no slot/page/refcount held anywhere
+        (dead replicas were reaped at failover, so they audit too)."""
+        return all(r.leak_free() for r in self._replicas.values())
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": {r.id: {"alive": r.alive,
+                                "draining": r.draining,
+                                "wedged": r.wedged,
+                                "queue_depth": r.queue_depth()}
+                         for r in self._replicas.values()},
+            "in_flight": len(self._by_rid),
+            "affinity": self.affinity,
+        }

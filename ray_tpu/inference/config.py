@@ -77,6 +77,14 @@ class InferConfig:
       :class:`~ray_tpu.resilience.watchdog.EngineWatchdog` declares
       the step loop wedged (stderr + ``wedges`` counter; the drain /
       restart decision is the operator's).
+    - ``RAY_TPU_INFER_STREAM_IDLE`` (default ``0`` = off): idle-
+      consumer timeout in seconds for serve streams.  A consumer that
+      silently drops its response generator is undetectable through
+      the object-ref streaming protocol (no liveness signal); with
+      this set, the deployment cancels any request whose stream has
+      tokens waiting but has not been pumped for the budget —
+      releasing its slot/pages/prefix refcounts instead of decoding
+      to ``max_new_tokens`` for a reader that is gone.
     """
     slots: int = 8
     page_size: int = 128
@@ -89,6 +97,7 @@ class InferConfig:
     ttft_deadline: float = 0.0
     deadline: float = 0.0
     watchdog: float = 0.0
+    stream_idle: float = 0.0
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -132,6 +141,8 @@ def infer_config(refresh: bool = False) -> InferConfig:
                                 "no total deadline")
         watchdog = nonneg_float("RAY_TPU_INFER_WATCHDOG",
                                 "watchdog off")
+        stream_idle = nonneg_float("RAY_TPU_INFER_STREAM_IDLE",
+                                   "idle-stream reaper off")
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
@@ -144,6 +155,7 @@ def infer_config(refresh: bool = False) -> InferConfig:
             ttft_deadline=ttft_deadline,
             deadline=deadline,
             watchdog=watchdog,
+            stream_idle=stream_idle,
         )
     return _CONFIG
 

@@ -441,6 +441,14 @@ class InferenceEngine:
         with self._lock:
             return self.scheduler.has_work
 
+    def prefix_digest(self) -> frozenset:
+        """Registered prefix chain hashes (the ``stats()["prefix"]``
+        accounting's underlying index, snapshotted) — the fleet
+        router matches a prompt's chained page hashes against this to
+        route it to the replica whose cache already holds the prefix."""
+        with self._lock:
+            return self.scheduler.prefix_digest()
+
     def stats(self) -> Dict[str, Any]:
         return {
             "compiles": dict(self.compile_counts),

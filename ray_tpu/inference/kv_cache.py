@@ -93,6 +93,29 @@ class PrefixIndex:
         h.update(np.asarray(tokens, np.int64).tobytes())
         return h.digest()
 
+    @classmethod
+    def chain_hashes(cls, tokens: Sequence[int],
+                     page_size: int) -> List[bytes]:
+        """Chained hashes of every *full* page of ``tokens`` — the one
+        walk both the scheduler (registration/hit lookup) and the
+        fleet router (affinity matching) must agree on byte-for-byte,
+        so it lives here."""
+        h = cls.ROOT
+        out = []
+        for i in range(len(tokens) // page_size):
+            h = cls.chain(h, tokens[i * page_size:(i + 1) * page_size])
+            out.append(h)
+        return out
+
+    @staticmethod
+    def hit_eligible(n_tokens: int, page_size: int) -> int:
+        """How many leading full pages of an ``n_tokens`` prompt may
+        be taken as hits: the page holding the final prompt token is
+        excluded even when full — its last token's logits seed the
+        first sampled token, so at least one suffix token must always
+        prefill."""
+        return (n_tokens - 1) // page_size
+
     def lookup(self, chain_hash: bytes) -> Optional[int]:
         return self._by_hash.get(chain_hash)
 
@@ -122,6 +145,15 @@ class PrefixIndex:
         self._by_hash.clear()
         self._by_page.clear()
         return n
+
+    def digest(self) -> frozenset:
+        """Snapshot of every registered chain hash — the fleet
+        router's prefix-affinity signal: a prompt whose chained page
+        hashes appear here would hit this engine's cache.  A frozen
+        copy (the router holds it across its own bookkeeping; the
+        live dicts keep mutating under admissions), cheap at the
+        page-pool sizes a replica runs (hundreds of entries)."""
+        return frozenset(self._by_hash)
 
     def __len__(self) -> int:
         return len(self._by_hash)

@@ -189,15 +189,11 @@ class SlotScheduler:
             req.chain_hashes = []
             return []
         if req.chain_hashes is None:
-            ps = self.page_size
-            h = PrefixIndex.ROOT
-            req.chain_hashes = []
-            for i in range(len(req.prompt) // ps):
-                h = PrefixIndex.chain(h,
-                                      req.prompt[i * ps:(i + 1) * ps])
-                req.chain_hashes.append(h)
+            req.chain_hashes = PrefixIndex.chain_hashes(
+                req.prompt, self.page_size)
         hits: List[int] = []
-        eligible = (len(req.prompt) - 1) // self.page_size
+        eligible = PrefixIndex.hit_eligible(len(req.prompt),
+                                            self.page_size)
         for h_i in req.chain_hashes[:eligible]:
             page = self.prefix_index.lookup(h_i)
             if page is None:
@@ -302,3 +298,10 @@ class SlotScheduler:
             "idle_pages": self.allocator.idle_count,
             "evictions": self.allocator.evictions,
         }
+
+    def prefix_digest(self) -> frozenset:
+        """Registered-chain-hash snapshot for fleet prefix-affinity
+        routing (empty when the prefix cache is off)."""
+        if self.prefix_index is None:
+            return frozenset()
+        return self.prefix_index.digest()

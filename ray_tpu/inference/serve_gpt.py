@@ -18,9 +18,14 @@ disconnected HTTP response — cancels the sequence in the engine so its
 decode slot frees within a tick.  A *handle* consumer that silently
 drops its ``DeploymentResponseGenerator`` does **not** close the
 replica-side generator (the object-ref streaming protocol carries no
-consumer-liveness signal today), so such requests decode to
-``max_new_tokens`` before the slot frees — bound ``max_new_tokens``
-accordingly; ref-generator cancellation is an open runtime item.
+consumer-liveness signal today); the **idle-stream reaper**
+(``RAY_TPU_INFER_STREAM_IDLE``, default off) covers that hole: a
+request whose stream has tokens waiting but has not been pumped for
+the budget is cancelled — slot/pages/prefix refcounts released, a
+typed :class:`StreamIdleError` left on the queue for any late reader
+— instead of decoding to ``max_new_tokens`` for a reader that is
+gone.  A consumer merely *waiting* on a slow engine (empty queue) is
+never reaped.
 
 Usage (see the README serving quickstart)::
 
@@ -51,6 +56,32 @@ class ReplicaDrainingError(RuntimeError):
     """Typed admission rejection while the replica drains: new
     requests must go to another replica (the router's retry signal);
     in-flight streams keep decoding to completion."""
+
+
+class StreamIdleError(RuntimeError):
+    """Typed cancellation of an abandoned stream: tokens sat unread
+    past ``RAY_TPU_INFER_STREAM_IDLE``, so the request was retired
+    (everything released).  A late consumer sees this instead of a
+    silent hang on a queue nothing feeds anymore."""
+
+
+def parse_request(request: Dict[str, Any]) -> Dict[str, Any]:
+    """The one parser for the serving payload dict — the deployment
+    and the fleet router both route requests through it, so a field
+    added to the payload can never silently exist in one path and not
+    the other."""
+    return {
+        "max_new_tokens": int(request.get("max_new_tokens", 16)),
+        "sampling": SamplingParams(
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            seed=int(request.get("seed", 0))),
+        "want_logprobs": bool(request.get("logprobs", False)),
+        "eos_token": request.get("eos_token"),
+        "ttft_deadline_s": request.get("ttft_deadline_s"),
+        "deadline_s": request.get("deadline_s"),
+    }
 
 
 def _build_engine(model: str, model_config: Optional[Dict[str, Any]],
@@ -105,15 +136,23 @@ class GPTDeployment:
                  model_config: Optional[Dict[str, Any]] = None,
                  engine_config: Optional[Dict[str, Any]] = None,
                  seed: int = 0,
-                 watchdog_s: Optional[float] = None):
+                 watchdog_s: Optional[float] = None,
+                 stream_idle_s: Optional[float] = None):
         self.cfg, self.engine = _build_engine(model, model_config,
                                               engine_config, seed)
         self._queues: Dict[int, asyncio.Queue] = {}
         self._pump_task: Optional[asyncio.Task] = None
         self._draining = False
         from ray_tpu.inference.config import infer_config
-        watchdog_s = (infer_config().watchdog if watchdog_s is None
+        icfg = infer_config()
+        watchdog_s = (icfg.watchdog if watchdog_s is None
                       else watchdog_s)
+        # idle-stream reaper: rid -> when the consumer last took an
+        # item (or the request was submitted); swept by the pump
+        self.stream_idle_s = (icfg.stream_idle if stream_idle_s is None
+                              else stream_idle_s) or None
+        self._last_pumped: Dict[int, float] = {}
+        self.streams_reaped = 0
         self._watchdog = None
         if watchdog_s:
             from ray_tpu.resilience.watchdog import EngineWatchdog
@@ -125,25 +164,26 @@ class GPTDeployment:
             raise ReplicaDrainingError(
                 "replica is draining: admission stopped, in-flight "
                 "requests finishing — retry on another replica")
-        sampling = SamplingParams(
-            temperature=float(request.get("temperature", 0.0)),
-            top_k=int(request.get("top_k", 0)),
-            top_p=float(request.get("top_p", 1.0)),
-            seed=int(request.get("seed", 0)))
-        want_logprobs = bool(request.get("logprobs", False))
+        parsed = parse_request(request)
+        want_logprobs = parsed["want_logprobs"]
         rid = self.engine.submit(
             request["tokens"],
-            max_new_tokens=int(request.get("max_new_tokens", 16)),
-            sampling=sampling,
-            eos_token=request.get("eos_token"),
-            ttft_deadline_s=request.get("ttft_deadline_s"),
-            deadline_s=request.get("deadline_s"))
+            max_new_tokens=parsed["max_new_tokens"],
+            sampling=parsed["sampling"],
+            eos_token=parsed["eos_token"],
+            ttft_deadline_s=parsed["ttft_deadline_s"],
+            deadline_s=parsed["deadline_s"])
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = queue
+        self._last_pumped[rid] = time.monotonic()
         self._ensure_pump()
         try:
             while True:
                 item = await queue.get()
+                # the consumer is live: it just took an item (a
+                # consumer *waiting* on an empty queue is tracked by
+                # the queue being empty, not by this stamp)
+                self._last_pumped[rid] = time.monotonic()
                 if isinstance(item, BaseException):
                     raise item       # pump died: surface, don't hang
                 token, done, logprob = item
@@ -153,6 +193,7 @@ class GPTDeployment:
                     return
         finally:
             self._queues.pop(rid, None)
+            self._last_pumped.pop(rid, None)
             # abandoned mid-stream (client disconnect): retire the
             # sequence instead of decoding to max_new_tokens in a slot
             # nobody is reading (no-op for normal completion)
@@ -171,25 +212,78 @@ class GPTDeployment:
         failed one."""
         loop = asyncio.get_running_loop()
         try:
-            while self.engine.has_work():
-                events = await loop.run_in_executor(None,
-                                                    self.engine.step)
-                for ev in events:
-                    rid, token, done = ev
-                    queue = self._queues.get(rid)
-                    if queue is None:
-                        continue
-                    if ev.error is not None:
-                        # deadline expiry: the engine already released
-                        # the slot/pages; surface the typed error as
-                        # the stream's failure
-                        queue.put_nowait(ev.error)
-                    else:
-                        queue.put_nowait((token, done, ev.logprob))
+            while True:
+                await self._pump_engine(loop)
+                if not (self.stream_idle_s and self._queues):
+                    return
+                # engine idle but unread streams remain (abandoned
+                # consumers): keep the reaper alive until they drain
+                # or age out — otherwise their queues would persist on
+                # a quiescent replica until new traffic revives the
+                # pump.  New work re-enters the step loop above.
+                self._reap_idle_streams()
+                await asyncio.sleep(min(self.stream_idle_s / 4, 0.02))
         except BaseException as e:  # noqa: BLE001 — deliver, then die
             for queue in self._queues.values():
                 queue.put_nowait(e)
             raise
+
+    async def _pump_engine(self, loop) -> None:
+        while self.engine.has_work():
+            events = await loop.run_in_executor(None,
+                                                self.engine.step)
+            for ev in events:
+                rid, token, done = ev
+                queue = self._queues.get(rid)
+                if queue is None:
+                    continue
+                if queue.qsize() == 0:
+                    # empty -> nonempty: the idle clock measures how
+                    # long tokens sit UNREAD, so it starts when the
+                    # first unread token lands — not at the last
+                    # consumer read (a consumer blocked in get()
+                    # through a slow step would otherwise look idle
+                    # the moment the token arrives)
+                    self._last_pumped[rid] = time.monotonic()
+                if ev.error is not None:
+                    # deadline expiry: the engine already released
+                    # the slot/pages; surface the typed error as the
+                    # stream's failure
+                    queue.put_nowait(ev.error)
+                else:
+                    queue.put_nowait((token, done, ev.logprob))
+            self._reap_idle_streams()
+
+    def _reap_idle_streams(self) -> None:
+        """Cancel requests whose stream has tokens waiting but whose
+        consumer has not taken one for ``stream_idle_s`` — the r10
+        silently-dropped-generator hole.  An empty queue (consumer
+        blocked on a slow engine) never reaps; only unread tokens
+        aging out do."""
+        if self.stream_idle_s is None:
+            return
+        now = time.monotonic()
+        for rid, queue in list(self._queues.items()):
+            if queue.qsize() == 0:
+                continue
+            if now - self._last_pumped.get(rid, now) \
+                    <= self.stream_idle_s:
+                continue
+            if rid in self.engine._requests:
+                self.engine.cancel(rid)
+                # a late reader must raise, not hang on a queue the
+                # pump no longer feeds
+                queue.put_nowait(StreamIdleError(
+                    f"request {rid}: stream not pumped for "
+                    f"{self.stream_idle_s:.3f}s with tokens waiting "
+                    "(RAY_TPU_INFER_STREAM_IDLE) — request "
+                    "cancelled, slot/pages released"))
+                self.streams_reaped += 1
+            # else: the engine already finished it — nothing held and
+            # nothing to count; just stop tracking the unread queue
+            # (a late reader still drains its buffered tokens to done)
+            self._queues.pop(rid, None)
+            self._last_pumped.pop(rid, None)
 
     # ------------------------------------------------------------ drain
     async def drain(self, poll_s: float = 0.05,
@@ -253,6 +347,7 @@ class GPTDeployment:
         summary = self.engine.telemetry.summary()
         summary["stats"] = self.engine.stats()
         summary["draining"] = self._draining
+        summary["streams_reaped"] = self.streams_reaped
         if self._watchdog is not None:
             summary["watchdog_wedges"] = self._watchdog.wedges
         return summary

@@ -46,6 +46,11 @@ class EngineWatchdog:
             min(self.timeout_s / 4, 0.5)
         self.on_wedge = on_wedge
         self.wedges = 0
+        # wedge-signal export: when the last episode fired (surfaced
+        # through the fleet replica's stats beside the counter, so an
+        # operator can tell a fresh wedge from an old one) — None
+        # until the first episode
+        self.last_wedge_ts: Optional[float] = None
         self._fired_at_tick: Optional[int] = None
         # idle->busy tracking: after an idle stretch the engine's
         # last_tick_ts is stale by construction (nothing steps an
@@ -84,6 +89,7 @@ class EngineWatchdog:
             return False                    # this episode already fired
         self._fired_at_tick = ticks
         self.wedges += 1
+        self.last_wedge_ts = now
         if self.on_wedge is not None:
             try:
                 self.on_wedge(eng)

@@ -26,6 +26,7 @@ from ray_tpu.telemetry import chrome_trace  # noqa: F401
 from ray_tpu.telemetry.ckpt import CkptTelemetry  # noqa: F401
 from ray_tpu.telemetry.config import (TelemetryConfig,  # noqa: F401
                                       telemetry_config)
+from ray_tpu.telemetry.fleet import FleetTelemetry  # noqa: F401
 from ray_tpu.telemetry.flops import (chip_peak_tflops,  # noqa: F401
                                      gpt_fwd_flops_per_token,
                                      gpt_train_flops_per_token, mfu)
@@ -40,6 +41,7 @@ __all__ = [
     "InferTelemetry",
     "RLTelemetry",
     "CkptTelemetry",
+    "FleetTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",
     "gpt_train_flops_per_token", "mfu",

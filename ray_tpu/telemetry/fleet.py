@@ -1,0 +1,191 @@
+"""Fleet telemetry: router retries, replica restarts, affinity hit rate.
+
+The fourth recorder family, beside train/infer/RL: the fleet router
+and reconciler record every retry (split by cause — a dead replica, a
+draining one, a full queue), every replica restart, per-replica queue
+depth, and the prefix-affinity routing hit rate.  Sinks mirror r09:
+Prometheus through the control plane when a session is up
+(``serve_router_retries_total`` / ``serve_replica_restarts_total``
+counters, ``serve_replica_queue_depth`` /
+``serve_fleet_affinity_hit_rate`` gauges), and :meth:`summary` as the
+``fleet`` block of ``bench.py --infer --replicas N`` JSON.
+
+``RAY_TPU_TELEMETRY=0`` disables recording entirely.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from ray_tpu.telemetry.config import telemetry_config
+
+
+class FleetTelemetry:
+    """Per-fleet recorder for routing/reconciliation events."""
+
+    _EMIT_INTERVAL_S = 0.5
+
+    def __init__(self, *, label: str = "fleet", config=None):
+        tcfg = config or telemetry_config()
+        self.enabled: bool = tcfg.enabled
+        self.label = label
+        # cause -> count; causes: "dead" (replica death/wedge failover
+        # or a failed routed submit), "draining", "queue_full"
+        self.retries: Dict[str, int] = {}
+        self.replica_restarts = 0
+        self.affinity_routed = 0
+        self.affinity_decisions = 0
+        self.queue_depths: Dict[str, int] = {}
+        self._metrics = None
+        self._metrics_dead = False
+        self._depth_last: Dict[str, float] = {}
+        self._rate_last = 0.0
+
+    # ---------------------------------------------------------- records
+    def record_retry(self, cause: str) -> None:
+        """One routed request re-routed or failed over (``cause`` in
+        ``dead`` / ``draining`` / ``queue_full``) — the fleet's
+        churn signal: a rising rate means replicas are dying,
+        draining under scale-down, or shedding load."""
+        if not self.enabled:
+            return
+        self.retries[cause] = self.retries.get(cause, 0) + 1
+        self._emit_retry(cause)
+
+    def record_restart(self) -> None:
+        """The reconciler replaced a wedged/dead replica."""
+        if not self.enabled:
+            return
+        self.replica_restarts += 1
+        self._emit_restart()
+
+    def record_affinity(self, *, hit: bool) -> None:
+        """One routing decision with affinity enabled: ``hit`` when a
+        prefix-digest match picked the replica (the fleet-wide cache
+        working), False when routing fell through to pow-2."""
+        if not self.enabled:
+            return
+        self.affinity_decisions += 1
+        if hit:
+            self.affinity_routed += 1
+        self._emit_affinity()
+
+    def record_queue_depth(self, replica_id: str, depth: int) -> None:
+        """Per-replica queue-depth gauge (throttled per replica —
+        the router records every poll)."""
+        if not self.enabled:
+            return
+        self.queue_depths[replica_id] = int(depth)
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        if now - self._depth_last.get(replica_id, 0.0) \
+                < self._EMIT_INTERVAL_S:
+            return
+        self._depth_last[replica_id] = now
+        self._emit_depth(replica_id, depth)
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Drop a stopped replica's gauge state."""
+        self.queue_depths.pop(replica_id, None)
+        self._depth_last.pop(replica_id, None)
+
+    # ---------------------------------------------------------- summary
+    @property
+    def affinity_hit_rate(self) -> float:
+        if not self.affinity_decisions:
+            return 0.0
+        return self.affinity_routed / self.affinity_decisions
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``fleet`` block for multi-replica bench JSON."""
+        if not self.enabled:
+            return {"enabled": False}
+        return {
+            "enabled": True, "label": self.label,
+            "router_retries": dict(self.retries),
+            "router_retries_total": sum(self.retries.values()),
+            "replica_restarts": self.replica_restarts,
+            "affinity_decisions": self.affinity_decisions,
+            "affinity_routed": self.affinity_routed,
+            "affinity_hit_rate": self.affinity_hit_rate,
+            "replica_queue_depth": dict(self.queue_depths),
+        }
+
+    # ------------------------------------------------------- prometheus
+    def _metric_objects(self):
+        from ray_tpu._private.worker import is_initialized
+        if not is_initialized():
+            return None
+        if self._metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+            self._metrics = {
+                "retries": Counter(
+                    "serve_router_retries_total",
+                    "routed requests re-routed or failed over, by "
+                    "cause (dead / draining / queue_full)",
+                    tag_keys=("label", "cause")),
+                "restarts": Counter(
+                    "serve_replica_restarts_total",
+                    "replicas replaced by the fleet reconciler",
+                    tag_keys=("label",)),
+                "depth": Gauge(
+                    "serve_replica_queue_depth",
+                    "waiting + active requests on one replica",
+                    tag_keys=("label", "replica")),
+                "affinity": Gauge(
+                    "serve_fleet_affinity_hit_rate",
+                    "share of routing decisions won by a prefix-"
+                    "affinity digest match",
+                    tag_keys=("label",)),
+            }
+        return self._metrics
+
+    def _emit_retry(self, cause: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["retries"].inc(
+                    1.0, tags={"label": self.label, "cause": cause})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_restart(self):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["restarts"].inc(1.0,
+                                        tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_affinity(self):
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        if (self.affinity_decisions > 1
+                and now - self._rate_last < self._EMIT_INTERVAL_S):
+            return
+        self._rate_last = now
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["affinity"].set(self.affinity_hit_rate,
+                                        tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_depth(self, replica_id: str, depth: int):
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["depth"].set(
+                    float(depth),
+                    tags={"label": self.label, "replica": replica_id})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True

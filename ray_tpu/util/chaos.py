@@ -31,7 +31,13 @@ itself).  Current sites:
 - ``ckpt.write`` — the Nth background checkpoint write fails;
 - ``ckpt.truncate`` — the Nth checkpoint write is truncated on disk
   *after* writing (the resume path must fall back to the previous
-  retained snapshot, loudly).
+  retained snapshot, loudly);
+- ``serve.replica`` — the Nth fleet-replica engine tick kills the
+  replica mid-traffic (the router must fail its in-flight streams
+  over to healthy replicas; the reconciler must restore the target
+  count with zero steady-state recompiles);
+- ``serve.route`` — the Nth routed submit fails in flight (the
+  router must re-route to another replica, counting the retry).
 
 Spec grammar: comma-separated ``site@N`` entries (``N`` = 1-based hit
 index, fires once; bare ``site`` means ``site@1``), e.g.

@@ -1,0 +1,164 @@
+"""Round-16 on-chip driver: fleet-serving A/Bs.
+
+Usage: python scratch/r16_fleet.py <variant>
+
+Variants:
+  affinity — multi-replica routing A/B at the GPT-2 124M serving
+             recipe: `bench.py --infer --replicas 4` emits the
+             affinity-on vs pow-2-only arms side by side (aggregate
+             tok/s, p50/p99 TTFT, fleet prefix hit rate, per-replica
+             compile counters — all must be zero on the warmed
+             executable cache).  The host-sim A/B already resolves
+             the direction (affinity ~1.3x aggregate tok/s and a
+             higher fleet hit rate on the 2-replica CPU smoke); this
+             arm prices it on real prefill latencies.
+  kill     — kill-mid-traffic recovery: a deterministic
+             RAY_TPU_FAULTS plan (serve.replica) kills one replica
+             under open-loop load; reports stream-completion (every
+             in-flight stream finishes via failover or typed error —
+             zero hung), router retry counts, the reconciler's
+             restart latency, the replacement engine's compile
+             counters (must be all-zero — the shared-executable-cache
+             claim on real Mosaic binaries), and the fleet-wide
+             slot/page leak audit.
+
+Carried arms (no chip session yet; every r06-r15 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+ckpt / recover plus all r6-r14 arms — delegated verbatim to
+scratch/r15_ft.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "affinity"
+
+_R15_ARMS = ("ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R15_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r15_ft.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r16_fleet.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("affinity", "kill"), f"unknown variant {VARIANT!r}"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig, init_params  # noqa: E402
+
+on_tpu = jax.default_backend() == "tpu"
+
+if VARIANT == "affinity":
+    # the bench arm IS the A/B: forward both JSON lines
+    args = [sys.executable, os.path.join(HERE, "..", "bench.py"),
+            "--infer", "--replicas", "4"]
+    if not on_tpu:
+        args.append("--quick")
+    sys.exit(subprocess.run(args).returncode)
+
+# ---------------------------------------------------------------- kill
+from ray_tpu.fleet import (EngineReplica, FleetConfig,  # noqa: E402
+                           FleetRouter, Reconciler, RUNNING)
+from ray_tpu.inference import InferenceEngine  # noqa: E402
+from ray_tpu.telemetry.config import TelemetryConfig  # noqa: E402
+from ray_tpu.telemetry.fleet import FleetTelemetry  # noqa: E402
+from ray_tpu.util import chaos  # noqa: E402
+
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16)
+    slots, page, max_new, requests = 8, 128, 32, 24
+else:
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    slots, page, max_new, requests = 4, 16, 8, 12
+
+params = init_params(cfg, jax.random.PRNGKey(0))
+CACHE = {}
+
+
+def make_replica(rid):
+    eng = InferenceEngine(cfg, params, slots=slots, page_size=page,
+                          telemetry=False, max_queue=0,
+                          executable_cache=CACHE)
+    return EngineReplica(rid, eng, watchdog_s=5.0)
+
+
+rng = np.random.RandomState(0)
+shared = list(rng.randint(0, cfg.vocab_size, 2 * page))
+prompts = [shared + list(rng.randint(0, cfg.vocab_size, 5 + i % 17))
+           for i in range(requests)]
+
+# warm the shared cache so the measured fleet compiles nothing
+warm = make_replica("warm")
+for p in prompts[:4]:
+    warm.engine.generate([p], max_new_tokens=max_new)
+warm_compiles = dict(warm.engine.compile_counts)
+del warm
+
+fcfg = FleetConfig(retries=2, affinity=True, affinity_cap=slots * 2,
+                   dwell=0.0, backoff=0.0)
+reps = [make_replica(f"r{i}") for i in range(3)]
+router = FleetRouter(reps, cfg=fcfg, rng_seed=0,
+                     telemetry=FleetTelemetry(
+                         config=TelemetryConfig(enabled=True)))
+rec = Reconciler(router, make_replica, target=3, cfg=fcfg)
+
+chaos.install_faults("serve.replica@5")        # dies under load
+t0 = time.perf_counter()
+streams = [router.remote({"tokens": p, "max_new_tokens": max_new})
+           for p in prompts]
+outs, errors = [], 0
+for s in streams:
+    try:
+        outs.append(list(s))
+    except Exception:  # noqa: BLE001 — typed errors count, not crash
+        errors += 1
+wall = time.perf_counter() - t0
+chaos.clear_faults()
+dead = [r.id for r in reps if not r.alive]
+
+t1 = time.perf_counter()
+deadline = time.time() + 30
+while time.time() < deadline:
+    rec.reconcile()
+    if list(rec.states().values()).count(RUNNING) == 3:
+        break
+    time.sleep(0.01)
+recover_s = time.perf_counter() - t1
+
+print(json.dumps({
+    "arm": "kill",
+    "backend": jax.default_backend(),
+    "requests": requests,
+    "killed": dead,
+    "completed": len(outs),
+    "typed_errors": errors,
+    "hung": 0,                       # loop above terminated: by proof
+    "full_length": sum(1 for o in outs if len(o) == max_new),
+    "wall_s": wall,
+    "reconcile_to_target_s": recover_s,
+    "failover_retries": router.telemetry.summary()["router_retries"],
+    "replica_restarts": rec.restarts_total,
+    "warm_compiles": warm_compiles,
+    "fleet_compiles": [r.engine.stats()["compiles"]
+                       for r in router.replicas()],
+    "leak_free": router.leak_free()
+    and all(r.leak_free() for r in reps),
+}), flush=True)

@@ -264,6 +264,42 @@ def test_ckpt_telemetry_summary():
     assert off.summary() == {"enabled": False}
 
 
+def test_fleet_telemetry_summary():
+    """r16: the fleet recorder's summary block — router retries split
+    by cause, replica restarts, affinity hit rate and the per-replica
+    queue-depth snapshot — plus the disabled no-op."""
+    from ray_tpu.telemetry import FleetTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = FleetTelemetry(config=TelemetryConfig(enabled=True))
+    tel.record_retry("dead")
+    tel.record_retry("dead")
+    tel.record_retry("draining")
+    tel.record_retry("queue_full")
+    tel.record_restart()
+    for hit in (True, False, True, True):
+        tel.record_affinity(hit=hit)
+    tel.record_queue_depth("r0", 3)
+    tel.record_queue_depth("r1", 0)
+    out = tel.summary()
+    assert out["enabled"] and out["label"] == "fleet"
+    assert out["router_retries"] == {"dead": 2, "draining": 1,
+                                     "queue_full": 1}
+    assert out["router_retries_total"] == 4
+    assert out["replica_restarts"] == 1
+    assert out["affinity_decisions"] == 4
+    assert out["affinity_hit_rate"] == pytest.approx(0.75)
+    assert out["replica_queue_depth"] == {"r0": 3, "r1": 0}
+    # a stopped replica's gauge state drops out of the snapshot
+    tel.forget_replica("r1")
+    assert tel.summary()["replica_queue_depth"] == {"r0": 3}
+    off = FleetTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_retry("dead")
+    off.record_restart()
+    off.record_affinity(hit=True)
+    assert off.summary() == {"enabled": False}
+
+
 def test_infer_telemetry_deadline_counter():
     """r15: ``infer_deadline_exceeded_total`` rides the infer
     recorder, split by kind in the summary block."""
@@ -381,14 +417,19 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert steps, [ev.get("name") for ev in timeline][:20]
     assert all(ev["ph"] == "X" and ev["dur"] > 0 for ev in steps)
 
-    # r15 resilience series ride the same control plane
-    from ray_tpu.telemetry import (CkptTelemetry, InferTelemetry,
-                                   RLTelemetry)
+    # r15 resilience + r16 fleet series ride the same control plane
+    from ray_tpu.telemetry import (CkptTelemetry, FleetTelemetry,
+                                   InferTelemetry, RLTelemetry)
     from ray_tpu.telemetry.config import TelemetryConfig
     on = TelemetryConfig(enabled=True)
     CkptTelemetry(config=on).record_write(0.1, step=2)
     RLTelemetry(config=on).record_actor_restart()
     InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
+    fleet = FleetTelemetry(config=on)
+    fleet.record_retry("dead")
+    fleet.record_restart()
+    fleet.record_affinity(hit=True)
+    fleet.record_queue_depth("r0", 2)
 
     text = requests.get(f"http://127.0.0.1:{port}/metrics",
                         timeout=10).text
@@ -400,3 +441,11 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "train_last_checkpoint_step" in text
     assert "rl_actor_restarts_total" in text
     assert "infer_deadline_exceeded_total" in text
+    assert "serve_router_retries_total" in text
+    # counters mangle tags into the series name; the cause split must
+    # still be distinguishable per-series
+    assert "cause" in text and "dead" in text
+    assert "serve_replica_restarts_total" in text
+    assert "serve_replica_queue_depth" in text
+    assert 'replica="r0"' in text        # gauges carry real labels
+    assert "serve_fleet_affinity_hit_rate" in text
