@@ -632,6 +632,125 @@ def bench_rl():
     print(json.dumps(record))
 
 
+def bench_data():
+    """Input-pipeline A/B: streamed packed batches vs preloaded arrays.
+
+    ``python bench.py --data``.  Runs the same compiled GPT train step
+    through two feeds — (a) one preloaded host-array batch (the
+    r01-r16 harness: the input pipeline costs nothing by construction)
+    and (b) the r17 streaming data plane (shard readers -> sample
+    packer -> bounded prefetch -> double-buffered ``device_put``) —
+    and prints ONE JSON line.  The acceptance target is
+    ``step_delta_frac ~ 0`` (all host work hides under the step) while
+    ``packed_tokens_per_batch`` beats the unpacked arm at equal
+    ``[B, S]`` (the padding FLOPs the packer reclaims).  Input tok/s
+    (producer side) vs trainer consumption tok/s says which side has
+    headroom.  On CPU the model shrinks to a smoke configuration
+    (numbers exercise the pipeline, not the hardware).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.data import SyntheticDocs, StreamingLoader
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 8
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=False,
+                             unroll_layers=True, ce_chunk=-1)
+        batch, seq, steps = 24, 1024, 20
+    mesh = make_mesh(dp=len(devices), devices=devices)
+    fns = training.build_gpt_train(cfg, mesh, telemetry=False)
+    source = SyntheticDocs(3, num_shards=8,
+                           docs_per_shard=1 << 16,
+                           vocab=cfg.vocab_size,
+                           min_len=max(8, seq // 8),
+                           max_len=max(12, (3 * seq) // 4))
+
+    def timed(step_fn, feed, n, on_warm=None):
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        for _ in range(2):                      # warmup/compile
+            state, metrics = step_fn(state, feed())
+            float(metrics["loss"])
+        if on_warm is not None:                 # steady state begins
+            on_warm()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step_fn(state, feed())
+        float(metrics["loss"])
+        return (time.perf_counter() - t0) / n, float(metrics["loss"])
+
+    # arm A: ONE preloaded packed batch — same pytree, same
+    # segment-masked attention path, same compiled step as the
+    # streaming arm, so the delta isolates the FEED (reads, packing,
+    # queue, transfer), not a different computation
+    with StreamingLoader(source, batch_size=batch, seq_len=seq,
+                         seed=0, pack=True, device_put=False) as warm:
+        pre = jax.device_put(warm.next().batch, fns["batch_sharding"])
+    pre_step_s, _ = timed(fns["step_fn"], lambda: pre, steps)
+
+    # arm B: the streaming plane (packed, segment-masked); the
+    # consumption-rate clock and token counter start AFTER warmup so
+    # trainer_tok_s is steady-state, not diluted by the jit compile
+    packed_consumed, t_run0 = [0], [0.0]
+    with StreamingLoader(source, batch_size=batch, seq_len=seq,
+                         seed=0, pack=True,
+                         sharding=fns["batch_sharding"]) as loader:
+        def feed():
+            sb = loader.next()
+            packed_consumed[0] += sb.packed_tokens
+            return sb.batch
+
+        def on_warm():
+            packed_consumed[0] = 0
+            t_run0[0] = time.perf_counter()
+        stream_step_s, _ = timed(fns["step_fn"], feed, steps, on_warm)
+        run_wall = time.perf_counter() - t_run0[0]
+        data_summary = loader.telemetry.summary()
+
+    # unpacked control at equal [B, S]: tokens per batch without the
+    # packer (each document pads its own row)
+    with StreamingLoader(source, batch_size=batch, seq_len=seq,
+                         seed=0, pack=False,
+                         device_put=False) as unpacked:
+        un_tokens = [unpacked.next().packed_tokens for _ in range(4)]
+
+    trainer_tok_s = packed_consumed[0] / run_wall if run_wall else 0.0
+    result = {
+        "metric": "data_plane_step_delta",
+        "value": round((stream_step_s - pre_step_s) / pre_step_s, 4)
+        if pre_step_s else 0.0,
+        "unit": "frac vs preloaded",
+        "platform": platform,
+        "n_devices": len(devices),
+        "batch": batch, "seq": seq, "steps": steps,
+        "preloaded_step_s": round(pre_step_s, 6),
+        "stream_step_s": round(stream_step_s, 6),
+        "input_tok_s": data_summary.get("input_tok_s", 0.0),
+        "trainer_tok_s": round(trainer_tok_s, 1),
+        "packed_tokens_per_batch": data_summary.get(
+            "packed_tokens_per_batch", 0.0),
+        "unpacked_tokens_per_batch": round(
+            sum(un_tokens) / len(un_tokens), 1),
+        "grid_tokens_per_batch": batch * seq,
+        "stall_s_total": data_summary.get("stall_s_total", 0.0),
+        "prefetch_depth_mean": data_summary.get(
+            "prefetch_depth_mean", 0.0),
+        "telemetry": {"data": data_summary},
+    }
+    print(json.dumps(result))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -640,6 +759,9 @@ def main():
     from ray_tpu.models.gpt import GPTConfig
     from ray_tpu.parallel.mesh import make_mesh
 
+    if "--data" in sys.argv:
+        bench_data()
+        return
     if "--infer" in sys.argv:
         n = _replicas_arg()
         if n > 1:

@@ -1,16 +1,24 @@
-"""``ray_tpu.data`` — distributed datasets (parity: ``ray.data``)."""
+"""``ray_tpu.data`` — distributed datasets (parity: ``ray.data``) plus
+the streaming training data plane (shard-reader actors, sample packing,
+the deterministic preemption-proof stream cursor — ``stream.py``)."""
 
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.config import DataConfig, data_config
 from ray_tpu.data.connectors import (from_huggingface, from_torch,
                                      read_sql, read_webdataset)
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.io_extra import range_tensor, read_tfrecords
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.packer import PackedBatch, SamplePacker
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
                                    read_csv, read_images, read_json,
                                    read_numpy, read_parquet, read_text)
+from ray_tpu.data.source import (DocumentSource, SyntheticDocs,
+                                 TokenFileSource, write_token_shards)
+from ray_tpu.data.stream import (DataPlaneError, StreamBatch,
+                                 StreamCursor, StreamingLoader)
 
 __all__ = [
     "Block", "BlockAccessor", "DataContext", "Dataset", "DataIterator",
@@ -21,4 +29,10 @@ __all__ = [
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images",
     "read_webdataset", "read_sql", "read_tfrecords",
+    # streaming training data plane
+    "DataConfig", "data_config",
+    "DocumentSource", "SyntheticDocs", "TokenFileSource",
+    "write_token_shards",
+    "SamplePacker", "PackedBatch",
+    "StreamCursor", "StreamBatch", "StreamingLoader", "DataPlaneError",
 ]

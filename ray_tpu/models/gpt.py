@@ -381,8 +381,20 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
     return x, aux
 
 
+def _with_segments(attn_fn, segment_ids):
+    """Close ``segment_ids`` over an attention hook, preserving the
+    ``fused_rope`` marker ``layer_apply`` dispatches on.  Every
+    in-tree hook (``local_attention``, ``flash_attention`` and the
+    ``make_flash_attention_fn`` wrappers) accepts the kwarg; the
+    Pallas schedules decline it with the XLA segment formulation."""
+    fused = getattr(attn_fn, "fused_rope", False)
+    fn = functools.partial(attn_fn, segment_ids=segment_ids)
+    fn.fused_rope = fused
+    return fn
+
+
 def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
-                 mesh=None):
+                 mesh=None, positions=None):
     """tokens [B, S] -> hidden [B, S, d], sharded (batch, seq).
 
     The table is (vocab:tp, d:fsdp)-sharded for the tied head matmul; a
@@ -399,7 +411,13 @@ def embed_tokens(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
                           (None, None))
         x = constrain(table[tokens], ("batch", "seq", None))
         if cfg.pos == "learned":
-            x = x + params["pos_embed"].astype(cfg.dtype)[None, :S]
+            pos_table = params["pos_embed"].astype(cfg.dtype)
+            if positions is not None and getattr(positions, "ndim", 1) == 2:
+                # packed batches: positions restart per document, so
+                # the learned table is gathered per row, not sliced
+                x = x + pos_table[positions]
+            else:
+                x = x + pos_table[None, :S]
         return constrain(x, ("batch", "seq", None))
 
 
@@ -449,7 +467,8 @@ def loss_from_hidden(params, x, targets, cfg: GPTConfig, *, mesh=None,
 def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
                    attn_fn: Optional[Callable] = None, mesh=None,
                    fuse_norm: Optional[bool] = None,
-                   final_norm: bool = True):
+                   final_norm: bool = True,
+                   segment_ids=None, positions=None):
     """tokens [B, S] int32 -> (final hidden [B, S, d], moe aux loss).
 
     ``attn_fn(q, k, v) -> out`` defaults to causal local attention; pass a
@@ -459,13 +478,29 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     ``final_norm=False`` skips the closing ``ln_f`` and returns the raw
     residual stream — for ``loss_fn``'s fused-CE path, which computes
     that norm inside the vocab-matmul kernel instead.
+
+    ``segment_ids``/``positions`` [B, S] carry a sample-packed batch
+    (``ray_tpu.data.SamplePacker``): attention masks block-diagonally
+    per segment and RoPE/learned positions restart at every document
+    start, so the packed forward equals the per-document unpacked one.
     """
     B, S = tokens.shape
     if attn_fn is None:
         attn_fn = functools.partial(local_attention, causal=True)
+    if segment_ids is not None:
+        if positions is None:
+            # global arange positions across packed documents would
+            # silently break the packed==per-doc parity (RoPE/learned
+            # positions must restart at every document start)
+            raise ValueError(
+                "segment_ids without positions: a packed batch needs "
+                "its per-document positions (SamplePacker emits both)")
+        attn_fn = _with_segments(attn_fn, segment_ids)
     constrain = functools.partial(shd.constrain, mesh=mesh)
-    x = embed_tokens(params, tokens, cfg, mesh=mesh)
-    positions = jnp.arange(S)
+    x = embed_tokens(params, tokens, cfg, mesh=mesh,
+                     positions=positions)
+    if positions is None:
+        positions = jnp.arange(S)
 
     def layer_body(x, lp):
         return layer_apply(lp, x, cfg, positions=positions,
@@ -497,11 +532,14 @@ def lm_head(params, cfg: GPTConfig):
 
 def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
             attn_fn: Optional[Callable] = None, mesh=None,
-            fuse_norm: Optional[bool] = None):
+            fuse_norm: Optional[bool] = None,
+            segment_ids=None, positions=None):
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
     x, aux = forward_hidden(params, tokens, cfg, attn_fn=attn_fn,
-                            mesh=mesh, fuse_norm=fuse_norm)
+                            mesh=mesh, fuse_norm=fuse_norm,
+                            segment_ids=segment_ids,
+                            positions=positions)
     logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params, cfg))
     logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits.astype(jnp.float32), aux
@@ -582,7 +620,12 @@ def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
     ``RAY_TPU_FUSE_NORM``): the per-layer out-proj epilogue in
     ``layer_apply``, plus — when the flash-CE-with-norm gate passes —
     skipping the XLA ``ln_f`` entirely and folding it into the
-    vocab-matmul kernel's prologue."""
+    vocab-matmul kernel's prologue.
+
+    Sample-packed batches additionally carry ``segment_ids`` and
+    ``positions`` [B, S] (``ray_tpu.data``): attention masks
+    block-diagonally and positions restart per document; the packer's
+    ``targets`` already mask document boundaries with ``-1``."""
     from ray_tpu.ops import flash_ce
     B, S = batch["tokens"].shape
     n_dev = getattr(mesh, "size", 1) if mesh is not None else 1
@@ -592,7 +635,9 @@ def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
         enabled=fuse_norm)
     x, aux = forward_hidden(params, batch["tokens"], cfg, attn_fn=attn_fn,
                             mesh=mesh, fuse_norm=fuse_norm,
-                            final_norm=not ce_norm)
+                            final_norm=not ce_norm,
+                            segment_ids=batch.get("segment_ids"),
+                            positions=batch.get("positions"))
     loss = loss_from_hidden(
         params, x, batch["targets"], cfg, mesh=mesh, ce_mode=ce_mode,
         norm_scale=params["ln_f"] if ce_norm else None)

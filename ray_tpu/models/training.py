@@ -184,6 +184,16 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     batch_sh = _batch_sharding(mesh)
 
     def loss(params, batch):
+        if "segment_ids" in batch and mesh.shape.get("sp", 1) > 1:
+            # the ring/ulysses hooks have no segment_ids kwarg: the
+            # partial would die as an opaque trace-time TypeError, and
+            # silently dropping the mask would let co-packed documents
+            # attend to each other (same guard as overlap/pipeline)
+            raise ValueError(
+                "sample-packed batches (segment_ids) are not "
+                "supported by sequence-parallel attention (sp>1) yet "
+                "— stream unpacked (RAY_TPU_DATA_PACK=0) or use an "
+                "sp=1 mesh")
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
                                mesh=mesh, ce_mode=ce_mode,
                                fuse_norm=fuse_norm)
@@ -193,6 +203,13 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
 
     def value_and_grad(params, batch):
         if overlap_fns is not None:
+            if "segment_ids" in batch:
+                # silently training a packed batch without its mask
+                # would let co-packed documents attend to each other
+                raise ValueError(
+                    "sample-packed batches (segment_ids) are not "
+                    "supported by the overlap schedule yet — build "
+                    "with comm_mode='gspmd' for streamed packed input")
             return overlap_fns["value_and_grad"](
                 params, batch["tokens"], batch["targets"])
         return jax.value_and_grad(loss)(params, batch)
@@ -460,6 +477,13 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         B, S = tokens.shape
         if B % M:
             raise ValueError(f"batch={B} not divisible by microbatches={M}")
+        if "segment_ids" in batch:
+            # silently dropping the mask would let co-packed documents
+            # attend to each other (same guard as the overlap schedule)
+            raise ValueError(
+                "sample-packed batches (segment_ids) are not supported "
+                "by the pipeline-parallel trainer yet — stream unpacked "
+                "(RAY_TPU_DATA_PACK=0) or use build_gpt_train")
         positions = jnp.arange(S)
         x = gpt_mod.embed_tokens(params, tokens, cfg, mesh=mesh)
         d = x.shape[-1]

@@ -1097,6 +1097,48 @@ def _flash_pack2_rope_bwd(scale, causal, block_q, block_k, bwd_block_q,
 _flash_pack2_rope.defvjp(_flash_pack2_rope_fwd, _flash_pack2_rope_bwd)
 
 
+def segment_attention(q, k, v, segment_ids, *, causal: bool = True,
+                      scale: Optional[float] = None):
+    """Packed-batch attention: block-diagonal masking by segment.
+
+    q, k, v: ``[B, S, H, D]``; ``segment_ids``: ``[B, S]`` int32, the
+    sample packer's per-row document index (1-based; ``0`` = padding).
+    Position ``i`` attends to ``j`` iff ``seg[i] == seg[j]``, both are
+    nonzero, and (``causal``) ``j <= i`` — co-packed documents never
+    see each other, which is what makes a packed forward equal the
+    per-document unpacked forward (asserted in
+    ``tests/test_data_plane.py``).
+
+    XLA formulation (f32 scores/stats, masked online-softmax-free):
+    the per-batch ``[B, S, S]`` mask has no Pallas kernel yet — the
+    flash/pack2 schedules decline packed batches through
+    :func:`flash_attention`'s reasoned gate and land here.  Fully
+    masked rows (padding queries) normalize against a floor so they
+    produce zeros, not NaNs; their targets are ``-1`` so no loss or
+    gradient flows through them.
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    seg = segment_ids.astype(jnp.int32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = (seg[:, None, :, None] == seg[:, None, None, :]) \
+        & (seg[:, None, :, None] > 0)
+    if causal:
+        causal_m = jnp.tril(jnp.ones((S, S), bool))
+        mask = mask & causal_m[None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, -1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    l_q = jnp.swapaxes(l, 1, 2)              # [B, S, H, 1]
+    return (o / jnp.maximum(l_q, 1e-30)).astype(q.dtype)
+
+
 def supports(S: int, Sk: int, D: int, *, block_q: int = 1024,
              block_k: int = 1024) -> bool:
     """Shapes the kernel grid can tile (fallback to einsum otherwise)."""
@@ -1149,7 +1191,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     bwd_block_k: Optional[int] = None,
                     positions=None,
                     rope_theta: float = 10000.0,
-                    pack2: Optional[bool] = None):
+                    pack2: Optional[bool] = None,
+                    segment_ids=None):
     """Fused causal attention.  q,k,v: [B, S, H, D] -> [B, S, H, D].
 
     Drop-in for ``ray_tpu.parallel.ring_attention.local_attention``;
@@ -1171,6 +1214,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
     lane-packed schedule for head_dim 64 / even head counts; odd head
     counts, other head dims and untileable shapes use the single-head
     schedule regardless.
+
+    ``segment_ids`` [B, S] (sample-packed batches) is a reasoned
+    decline of every Pallas schedule: the per-batch block-diagonal
+    mask has no kernel yet, so RoPE (when ``positions`` is given) is
+    applied here and the XLA :func:`segment_attention` formulation
+    runs — loud in timelines as ``attn/segment_xla``.
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
@@ -1180,6 +1229,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if positions is not None and S != Sk:
         raise ValueError(f"rope needs q and kv positions to match: "
                          f"S={S} vs Sk={Sk}")
+    if segment_ids is not None:
+        if positions is not None:
+            q = rope_rotate(q, positions, rope_theta)
+            k = rope_rotate(k, positions, rope_theta)
+        with jax.named_scope("attn/segment_xla"):
+            return segment_attention(q, k, v, segment_ids,
+                                     causal=causal, scale=scale)
 
     plan = _pack2_plan(S, Sk, H, D, causal, block_q, block_k,
                        bwd_block_q, bwd_block_k, pack2)
@@ -1473,6 +1529,23 @@ def make_flash_attention_fn(mesh=None, *, causal: bool = True,
 
     tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
     spec = P(data_axes(mesh), None, tp, None)
+    bseq = P(data_axes(mesh), None)     # [B, S] leaves (packed batches)
+
+    # packed (segment_ids) batches shard over batch like q/k/v; rope —
+    # when fused — is applied per-shard from the per-row positions
+    # before the XLA segment formulation (pallas declines anyway)
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec,) * 3 + (bseq, bseq),
+                       out_specs=spec)
+    def sharded_seg_rope(q, k, v, positions, segment_ids):
+        q = rope_rotate(q, positions, rope_theta or 10000.0)
+        k = rope_rotate(k, positions, rope_theta or 10000.0)
+        return segment_attention(q, k, v, segment_ids, causal=causal)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec,) * 3 + (bseq,), out_specs=spec)
+    def sharded_seg(q, k, v, segment_ids):
+        return segment_attention(q, k, v, segment_ids, causal=causal)
 
     if rope_theta is not None:
         @functools.partial(shard_map, mesh=mesh,
@@ -1481,8 +1554,15 @@ def make_flash_attention_fn(mesh=None, *, causal: bool = True,
         def sharded(q, k, v, positions):
             return fn(q, k, v, positions=positions)
 
-        wrapped = lambda q, k, v, positions: sharded(  # noqa: E731
-            q, k, v, positions)
+        def wrapped(q, k, v, positions, segment_ids=None):
+            if segment_ids is not None:
+                if positions.ndim == 1:      # one spec: always [B, S]
+                    positions = jnp.broadcast_to(
+                        positions[None], segment_ids.shape)
+                return sharded_seg_rope(q, k, v, positions,
+                                        segment_ids)
+            return sharded(q, k, v, positions)
+
         wrapped.fused_rope = True
         return wrapped
 
@@ -1491,6 +1571,10 @@ def make_flash_attention_fn(mesh=None, *, causal: bool = True,
     def sharded(q, k, v):
         return fn(q, k, v)
 
-    sharded_fn = lambda q, k, v: sharded(q, k, v)     # noqa: E731
+    def sharded_fn(q, k, v, segment_ids=None):
+        if segment_ids is not None:
+            return sharded_seg(q, k, v, segment_ids)
+        return sharded(q, k, v)
+
     sharded_fn.fused_rope = False
     return sharded_fn

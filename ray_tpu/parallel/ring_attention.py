@@ -184,8 +184,16 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
 
 
 def local_attention(q, k, v, *, causal: bool = True,
-                    scale: Optional[float] = None):
-    """Single-device reference attention (same signature, no ring)."""
+                    scale: Optional[float] = None, segment_ids=None):
+    """Single-device reference attention (same signature, no ring).
+
+    ``segment_ids`` [B, S] (sample-packed batches, 0 = pad) delegates
+    to the block-diagonal-masked formulation — co-packed documents
+    never attend to each other."""
+    if segment_ids is not None:
+        from ray_tpu.ops.attention import segment_attention
+        return segment_attention(q, k, v, segment_ids, causal=causal,
+                                 scale=scale)
     B, S, H, D = q.shape
     if scale is None:
         scale = D ** -0.5

@@ -8,7 +8,11 @@ bounded hiccups, spanning all four workloads built in r06–r14:
   (:class:`~ray_tpu.resilience.checkpoint.TrainCheckpointer`,
   :func:`~ray_tpu.resilience.checkpoint.run_train_ckpt_loop`):
   snapshots off the critical path, orbax/npz + checkpoint-manager
-  retention, corrupt snapshots fall back loudly.
+  retention, corrupt snapshots fall back loudly.  With a streaming
+  source (:func:`~ray_tpu.resilience.checkpoint.
+  run_train_stream_loop`, r17) the data-plane cursor rides the same
+  extras — resume is float-equal even with reader deaths and
+  SIGKILLs mid-stream.
 - **RL** — the supervised actor/learner loop
   (:func:`~ray_tpu.resilience.supervisor.run_supervised_rl_loop`):
   dead rollout actors restart from the latest published weights with
@@ -29,7 +33,8 @@ deadline/watchdog knobs live with the engine's
 """
 
 from ray_tpu.resilience.checkpoint import (TrainCheckpointer,  # noqa: F401
-                                           run_train_ckpt_loop)
+                                           run_train_ckpt_loop,
+                                           run_train_stream_loop)
 from ray_tpu.resilience.config import (ResilienceConfig,  # noqa: F401
                                        resilience_config)
 from ray_tpu.resilience.supervisor import run_supervised_rl_loop  # noqa: F401
@@ -38,6 +43,7 @@ from ray_tpu.resilience.watchdog import EngineWatchdog  # noqa: F401
 __all__ = [
     "ResilienceConfig", "resilience_config",
     "TrainCheckpointer", "run_train_ckpt_loop",
+    "run_train_stream_loop",
     "run_supervised_rl_loop",
     "EngineWatchdog",
 ]

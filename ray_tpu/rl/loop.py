@@ -39,6 +39,7 @@ def run_rl_loop(cfg, *, steps: int,
                 rlcfg: Optional[RLConfig] = None,
                 reward_fn: Optional[Callable] = None,
                 prompt: Optional[Sequence[int]] = None,
+                prompt_source=None,
                 prompt_len: int = 4,
                 eos_token: Optional[int] = None,
                 seed: int = 0,
@@ -56,6 +57,13 @@ def run_rl_loop(cfg, *, steps: int,
     group's object-store snapshot as the publication path.  Engines
     across actor replicas share one executable cache.
 
+    ``prompt_source``: a :class:`~ray_tpu.data.DocumentSource` (or a
+    prebuilt :class:`~ray_tpu.rl.rollout.PromptDataset`) — each
+    learner round draws its ``rlcfg.batch`` prompts from the
+    deterministic r17 document schedule instead of repeating one fixed
+    prompt; the final prompt cursor is returned as
+    ``result["prompt_cursor"]`` for preemption-proof resume.
+
     Returns a result dict: per-step ``history`` (learner metrics +
     rollout reward), the ``reward_curve`` (rollout-side mean reward
     per learner step — the policy-improvement signal), queue/staleness
@@ -63,6 +71,14 @@ def run_rl_loop(cfg, *, steps: int,
     """
     rlcfg = rlcfg or rl_config()
     rng = np.random.RandomState(seed)
+    prompt_ds = None
+    if prompt_source is not None:
+        from ray_tpu.rl.rollout import PromptDataset
+        prompt_ds = (prompt_source
+                     if isinstance(prompt_source, PromptDataset)
+                     else PromptDataset(prompt_source,
+                                        prompt_len=prompt_len))
+        prompt_len = prompt_ds.prompt_len
     if prompt is None:
         prompt = [int(t) for t in
                   rng.randint(0, cfg.vocab_size, prompt_len)]
@@ -167,6 +183,8 @@ def run_rl_loop(cfg, *, steps: int,
                     version, params = store.latest()
                     actor.sync(version, params)
                 rollout_seed += rlcfg.batch
+                if prompt_ds is not None:
+                    prompts = prompt_ds.next_prompts(rlcfg.batch)
                 batch = actor.rollout(prompts, horizon=rlcfg.horizon,
                                       seq_len=seq_len,
                                       reward_fn=reward_fn,
@@ -220,6 +238,8 @@ def run_rl_loop(cfg, *, steps: int,
         "engine_stats": [a.engine.stats() for a in actors],
         "actors": [a.engine for a in actors],
         "learner": learner,
+        "prompt_cursor": (prompt_ds.cursor_array()
+                          if prompt_ds is not None else None),
     }
 
 

@@ -84,6 +84,87 @@ def trajectories_to_batch(prompts: Sequence[Sequence[int]],
     return {"tokens": tokens, "targets": targets}
 
 
+class PromptDataset:
+    """Deterministic prompt stream for rollout actors, built on the
+    streaming data plane's document schedule.
+
+    The r17 counterpart of the trainer's packed stream: prompts come
+    from a :class:`~ray_tpu.data.DocumentSource` in the same
+    round-robin shard order, truncated to a fixed ``prompt_len`` (the
+    learner wants fixed ``[B, S]`` shapes — one compile), documents
+    shorter than ``prompt_len`` are skipped (counted).  The position
+    serializes through :meth:`cursor_array` / ``cursor=`` exactly like
+    the trainer's, so a preempted RL run resumes on the identical
+    prompt sequence — and a dead reader restarts with the fetch
+    re-issued verbatim (exactly-once, same as training).
+    """
+
+    def __init__(self, source, *, prompt_len: int, cursor=None,
+                 readers: Optional[int] = None,
+                 retries: Optional[int] = None):
+        from ray_tpu.data.config import data_config
+        from ray_tpu.data.stream import _DocSchedule, StreamCursor
+        if prompt_len < 1:
+            raise ValueError(f"prompt_len must be >= 1, got "
+                             f"{prompt_len}")
+        dcfg = data_config()
+        self.prompt_len = int(prompt_len)
+        if cursor is None:
+            cursor = StreamCursor(
+                seed=0, num_shards=source.num_shards,
+                batch_size=0, seq_len=self.prompt_len, pack=False,
+                shard_offsets=[0] * source.num_shards)
+        elif not isinstance(cursor, StreamCursor):
+            cursor = StreamCursor.from_array(cursor)
+        if (cursor.num_shards, cursor.seq_len) != \
+                (source.num_shards, self.prompt_len):
+            raise ValueError(
+                "prompt cursor geometry mismatch: cursor has "
+                f"(shards, prompt_len)=({cursor.num_shards}, "
+                f"{cursor.seq_len}), dataset wants "
+                f"({source.num_shards}, {self.prompt_len})")
+        self._cursor = cursor.copy()
+        self._schedule = _DocSchedule(
+            source, self._cursor,
+            readers=dcfg.readers if readers is None else readers,
+            retries=dcfg.retries if retries is None else retries)
+        self.skipped_short = 0
+
+    def next_prompts(self, n: int) -> List[List[int]]:
+        """The next ``n`` fixed-length prompts of the schedule.
+
+        Documents shorter than ``prompt_len`` are skipped (counted);
+        a full epoch of skips without one usable document raises
+        loudly — the schedule wraps epochs forever, so a corpus with
+        no long-enough document would otherwise spin here."""
+        out: List[List[int]] = []
+        skipped_run = 0
+        total = self._schedule.source.total_docs()
+        while len(out) < n:
+            doc_id, toks = self._schedule.next_doc()
+            if len(toks) < self.prompt_len:
+                self.skipped_short += 1
+                skipped_run += 1
+                if skipped_run > total:
+                    raise ValueError(
+                        f"no document in the source reaches "
+                        f"prompt_len={self.prompt_len} (skipped a "
+                        f"full epoch of {total} documents) — lower "
+                        "prompt_len or fix the corpus")
+                continue
+            skipped_run = 0
+            out.append([int(t) for t in toks[:self.prompt_len]])
+        return out
+
+    @property
+    def reader_restarts(self) -> int:
+        return self._schedule.reader_restarts
+
+    def cursor_array(self) -> np.ndarray:
+        """Fixed-capacity serialization (checkpoint extras)."""
+        return self._cursor.to_array()
+
+
 class RolloutActor:
     """One rollout replica: engine + reward + version bookkeeping."""
 

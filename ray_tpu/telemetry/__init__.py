@@ -24,6 +24,7 @@ steps.  See :func:`telemetry_config`.
 
 from ray_tpu.telemetry import chrome_trace  # noqa: F401
 from ray_tpu.telemetry.ckpt import CkptTelemetry  # noqa: F401
+from ray_tpu.telemetry.data import DataTelemetry  # noqa: F401
 from ray_tpu.telemetry.config import (TelemetryConfig,  # noqa: F401
                                       telemetry_config)
 from ray_tpu.telemetry.fleet import FleetTelemetry  # noqa: F401
@@ -41,6 +42,7 @@ __all__ = [
     "InferTelemetry",
     "RLTelemetry",
     "CkptTelemetry",
+    "DataTelemetry",
     "FleetTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",

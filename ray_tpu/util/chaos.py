@@ -37,7 +37,15 @@ itself).  Current sites:
   over to healthy replicas; the reconciler must restore the target
   count with zero steady-state recompiles);
 - ``serve.route`` — the Nth routed submit fails in flight (the
-  router must re-route to another replica, counting the retry).
+  router must re-route to another replica, counting the retry);
+- ``data.read`` — the Nth shard-reader fetch dies (the data plane
+  must restart the reader and re-issue the fetch verbatim —
+  exactly-once sample accounting, no drop, no dup);
+- ``data.pack`` — the Nth batch assembly dies before mutating packer
+  state (the plane retries; the replayed batch is bit-identical);
+- ``data.stall`` — the Nth shard read sleeps ``RAY_TPU_DATA_STALL_S``
+  (slow-shard backpressure: the bounded prefetch queue drains and the
+  trainer's ``data_stall_seconds`` histogram shows the block).
 
 Spec grammar: comma-separated ``site@N`` entries (``N`` = 1-based hit
 index, fires once; bare ``site`` means ``site@1``), e.g.

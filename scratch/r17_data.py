@@ -1,0 +1,148 @@
+"""Round-17 on-chip driver: streaming-data-plane A/Bs.
+
+Usage: python scratch/r17_data.py <variant>
+
+Variants:
+  data    — stream-vs-preloaded A/B at the GPT-2 124M train recipe:
+            `bench.py --data` emits one JSON line with
+            step_delta_frac (target ~0: shard reads, packing and
+            host->device transfer all hide under the step),
+            producer-side input tok/s vs trainer consumption tok/s,
+            and packed vs unpacked tokens/batch at equal [B, S] (the
+            padding FLOPs the sample packer reclaims).  Both arms run
+            the identical compiled packed step (arm A preloads ONE
+            packed batch), so the delta isolates the feed; host-sim
+            resolves the direction (delta ~ 0, packed ~1.9x unpacked
+            on the synthetic corpus) and this arm prices it on real
+            HBM transfer latencies.
+  resume  — kill-mid-stream recovery on chip: runs the checkpointed
+            streaming train loop (run_train_stream_loop) in a child
+            process with a deterministic RAY_TPU_FAULTS plan
+            (data.read kills) plus async checkpoints, SIGKILLs the
+            child mid-run, resumes in this process from the cursor in
+            the checkpoint extras, and reports whether the post-resume
+            loss sequence is float-equal to an uninterrupted
+            fixed-seed run — the r15 bit-exact proof with a streaming
+            source (reader restarts and re-issued fetches included).
+
+Carried arms (no chip session yet; every r06-r16 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+affinity / kill plus all r6-r15 arms — delegated verbatim to
+scratch/r16_fleet.py.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "data"
+
+_R16_ARMS = ("affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R16_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r16_fleet.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r17_data.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("data", "resume"), f"unknown variant {VARIANT!r}"
+
+ROOT = os.path.dirname(HERE)
+
+if VARIANT == "data":
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--data"]
+        + sys.argv[2:]).returncode)
+
+
+# ----------------------------------------------------------- resume arm
+# One child process runs the checkpointed streaming loop with injected
+# data.read kills and gets SIGKILLed mid-run (reads in flight); the
+# parent resumes from the cursor in the snapshot extras and diffs the
+# loss tail against an uninterrupted run.
+STEPS, BATCH, SEQ, EVERY = 12, 8, 256, 2
+
+CHILD = f"""
+import os, sys
+sys.path.insert(0, {ROOT!r})
+import jax, jax.numpy as jnp
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.resilience import TrainCheckpointer, run_train_stream_loop
+
+cfg = GPTConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=4,
+                max_seq={SEQ}, dtype=jnp.bfloat16)
+d = sys.argv[1]
+with TrainCheckpointer(d, every={EVERY}, keep=3) as ck:
+    def on_step(step):
+        print("STEP", step, flush=True)
+    run_train_stream_loop(cfg, steps={STEPS}, batch_size={BATCH},
+                          seq_len={SEQ}, seed=0, ckpt=ck,
+                          on_step=on_step)
+print("DONE", flush=True)
+"""
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.resilience import (TrainCheckpointer,  # noqa: E402
+                                run_train_stream_loop)
+from ray_tpu.util import chaos  # noqa: E402
+
+cfg = GPTConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=4,
+                max_seq=SEQ, dtype=jnp.bfloat16)
+
+# reference: uninterrupted fixed-seed run with the same reader kills
+chaos.install_faults("data.read@2")
+full = run_train_stream_loop(cfg, steps=STEPS, batch_size=BATCH,
+                             seq_len=SEQ, seed=0)
+chaos.clear_faults()
+
+d = tempfile.mkdtemp(prefix="r17_resume_")
+env = dict(os.environ, RAY_TPU_FAULTS="data.read@2")
+proc = subprocess.Popen([sys.executable, "-c", CHILD, d], env=env,
+                        stdout=subprocess.PIPE, text=True)
+killed_at = None
+t0 = time.time()
+for line in proc.stdout:
+    if line.startswith("STEP"):
+        step = int(line.split()[1])
+        if step >= STEPS // 2:           # mid-run, queue non-empty
+            killed_at = step
+            proc.kill()                   # SIGKILL, no cleanup
+            break
+proc.wait()
+assert killed_at is not None, "child finished before the kill point"
+
+with TrainCheckpointer(d, every=EVERY, keep=3) as ck:
+    rest = run_train_stream_loop(cfg, steps=STEPS, batch_size=BATCH,
+                                 seq_len=SEQ, seed=0, ckpt=ck,
+                                 resume=True)
+
+tail = full["losses"][rest["start_step"]:]
+print(json.dumps({
+    "metric": "stream_resume_bit_exact",
+    "value": bool(rest["losses"] == tail),
+    "killed_at_step": killed_at,
+    "resumed_from_step": rest["start_step"],
+    "reader_restarts_reference": full["data"]["reader_restarts"],
+    "losses_resumed": rest["losses"],
+    "losses_reference_tail": tail,
+    "wall_s": round(time.time() - t0, 1),
+}))
+sys.exit(0 if rest["losses"] == tail else 1)

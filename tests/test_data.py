@@ -151,6 +151,7 @@ def test_dataset_in_trainer(ray_start_regular, tmp_path):
     assert all(per_worker), "a worker saw no data"
 
 
+@pytest.mark.slow
 def test_actor_pool_map_operator(ray_start_regular):
     """map_batches with a callable class runs on a fixed actor pool,
     constructed once per actor (parity: actor_pool_map_operator.py)."""
@@ -392,6 +393,7 @@ def test_streaming_split_abandoned_epoch_not_wedged(ray_start_regular):
     assert sorted(epoch2) == list(range(40))
 
 
+@pytest.mark.slow
 def test_streaming_split_equal_splits_leftover_blocks(ray_start_regular):
     """equal=True with a block count not divisible by n row-splits the
     leftover round so consumers stay in lock step."""

@@ -69,6 +69,7 @@ def test_tune_callbacks_and_loggers(ray_start_2_cpus, tmp_path):
         assert "score" in csv_text.splitlines()[0]
 
 
+@pytest.mark.slow
 def test_webdataset_roundtrip(ray_start_2_cpus, tmp_path):
     import ray_tpu.data as rd
     ds = rd.from_items([

@@ -377,6 +377,7 @@ def test_rl_loop_reward_improves_end_to_end(tiny_rl):
         assert not eng.scheduler.active and not eng.scheduler.waiting
 
 
+@pytest.mark.slow
 def test_rl_loop_staleness_drops_over_lag_batches(tiny_rl):
     """max_lag=0 with three actor replicas racing one learner: the
     later replicas' batches go stale mid-round and must be DROPPED,
@@ -397,6 +398,7 @@ def test_rl_loop_staleness_drops_over_lag_batches(tiny_rl):
     assert res["leftover_batches"] <= rlcfg.actors
 
 
+@pytest.mark.slow
 def test_rl_loop_wait_policy_backpressure(tiny_rl):
     """overflow="wait" end to end: a full queue rejects the put, the
     actor HOLDS the batch and re-enqueues it once the learner drains —

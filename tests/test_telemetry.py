@@ -264,6 +264,38 @@ def test_ckpt_telemetry_summary():
     assert off.summary() == {"enabled": False}
 
 
+def test_data_telemetry_summary():
+    """r17: the input-pipeline recorder's summary block — produced
+    batches with packed-token counts and input tok/s, trainer-blocked
+    stall accounting, reader-restart and pack-retry counters — plus
+    the disabled no-op."""
+    from ray_tpu.telemetry import DataTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = DataTelemetry(config=TelemetryConfig(enabled=True))
+    assert tel.summary()["batches"] == 0
+    tel.record_batch(100, 0.5, queue_depth=2)
+    tel.record_batch(60, 0.3, queue_depth=1)
+    tel.record_stall(0.01)
+    tel.record_stall(0.05)
+    tel.record_reader_restart()
+    tel.record_pack_retry()
+    out = tel.summary()
+    assert out["enabled"] and out["label"] == "train"
+    assert out["batches"] == 2 and out["input_tokens"] == 160
+    assert out["input_tok_s"] == pytest.approx(200.0)
+    assert out["packed_tokens_per_batch"] == pytest.approx(80.0)
+    assert out["prefetch_depth_mean"] == pytest.approx(1.5)
+    assert out["stall_s_total"] == pytest.approx(0.06)
+    assert out["stall_s_max"] == pytest.approx(0.05)
+    assert out["reader_restarts"] == 1 and out["pack_retries"] == 1
+    off = DataTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_batch(10, 0.1)
+    off.record_stall(1.0)
+    off.record_reader_restart()
+    assert off.summary() == {"enabled": False}
+
+
 def test_fleet_telemetry_summary():
     """r16: the fleet recorder's summary block — router retries split
     by cause, replica restarts, affinity hit rate and the per-replica
@@ -417,14 +449,20 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert steps, [ev.get("name") for ev in timeline][:20]
     assert all(ev["ph"] == "X" and ev["dur"] > 0 for ev in steps)
 
-    # r15 resilience + r16 fleet series ride the same control plane
-    from ray_tpu.telemetry import (CkptTelemetry, FleetTelemetry,
-                                   InferTelemetry, RLTelemetry)
+    # r15 resilience + r16 fleet + r17 data-plane series ride the same
+    # control plane
+    from ray_tpu.telemetry import (CkptTelemetry, DataTelemetry,
+                                   FleetTelemetry, InferTelemetry,
+                                   RLTelemetry)
     from ray_tpu.telemetry.config import TelemetryConfig
     on = TelemetryConfig(enabled=True)
     CkptTelemetry(config=on).record_write(0.1, step=2)
     RLTelemetry(config=on).record_actor_restart()
     InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
+    data = DataTelemetry(config=on)
+    data.record_batch(128, 0.2, queue_depth=2)
+    data.record_stall(0.003)
+    data.record_reader_restart()
     fleet = FleetTelemetry(config=on)
     fleet.record_retry("dead")
     fleet.record_restart()
@@ -449,3 +487,8 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "serve_replica_queue_depth" in text
     assert 'replica="r0"' in text        # gauges carry real labels
     assert "serve_fleet_affinity_hit_rate" in text
+    # r17 input-pipeline series
+    assert "data_input_tokens_per_sec" in text
+    assert "data_prefetch_depth" in text
+    assert "data_stall_seconds" in text
+    assert "data_reader_restarts_total" in text
