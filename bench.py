@@ -751,6 +751,135 @@ def bench_data():
     print(json.dumps(result))
 
 
+def bench_elastic():
+    """Elastic-training A/B: gradient-accumulation overhead + the
+    cross-mesh reshard cost.
+
+    ``python bench.py --elastic``.  Two questions, one JSON line:
+
+    (a) What does global-batch invariance cost?  The same global batch
+    runs through ``build_gpt_train(accum_steps=k)`` for k in {1, 2, 4}
+    — identical arithmetic, k sequential microbatches — so the step
+    delta vs k=1 is pure accumulation overhead (per-microbatch
+    dispatch + the f32 grad-accumulator traffic).  Acceptance target:
+    the added cost per extra microbatch stays ~ the per-microbatch
+    dispatch cost, not a step-shaped constant.
+
+    (b) What does a topology transition cost?  ``reshard_state`` moves
+    the full TrainState host->new-mesh for an 8->4 shrink and the 4->8
+    expand (the window in which no step runs — the elastic loop's
+    ``train_reshard_seconds``).
+
+    Needs 8 visible devices for (b); with fewer, re-execs on a
+    host-simulated 8-device CPU mesh and says so loudly (schedule
+    check, NOT a hardware measurement).
+    """
+    import re
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        print(f"only {len(jax.devices())} device(s) visible; re-running "
+              "--elastic on a host-simulated 8-device CPU mesh "
+              "(schedule check, NOT a hardware measurement)",
+              file=sys.stderr)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8").strip()
+        proc = subprocess.run(
+            [sys.executable, __file__] + sys.argv[1:], env=env)
+        sys.exit(proc.returncode)
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models import training
+    from ray_tpu.models.gpt import GPTConfig
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.resilience.elastic import host_state, reshard_state
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    # global batch 32: divisible by fsdp=8 x accum 4, so every arm
+    # shards whole microbatches (validate_divisibility would name the
+    # fix otherwise)
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        batch, seq, steps = 32, 128, 6
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16, remat=False,
+                             unroll_layers=True, ce_chunk=-1)
+        batch, seq, steps = 32, 1024, 12
+    mesh = make_mesh(fsdp=8, devices=devices[:8])
+    from ray_tpu.parallel.mesh import validate_divisibility
+    validate_divisibility(mesh, batch=batch, accum_steps=4)
+    batch_data = training.synthetic_lm_batch(
+        jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+
+    # (a) accumulation overhead at fixed global batch
+    arms = []
+    for k in (1, 2, 4):
+        fns = training.build_gpt_train(cfg, mesh, accum_steps=k,
+                                       telemetry=False)
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        for _ in range(2):                       # warmup/compile
+            state, metrics = fns["step_fn"](state, batch_data)
+            float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = fns["step_fn"](state, batch_data)
+        final_loss = float(metrics["loss"])       # forces the chain
+        step_s = (time.perf_counter() - t0) / steps
+        arms.append({"accum_steps": k, "step_s": round(step_s, 6),
+                     "loss": round(final_loss, 4)})
+        del state, fns
+    base_s = arms[0]["step_s"]
+    for a in arms:
+        a["overhead_frac"] = round((a["step_s"] - base_s) / base_s, 4) \
+            if base_s else 0.0
+        if a["accum_steps"] > 1:
+            a["overhead_per_microbatch_s"] = round(
+                (a["step_s"] - base_s) / (a["accum_steps"] - 1), 6)
+
+    # (b) reshard cost: 8 -> 4 (accum doubles) and back
+    full = training.build_gpt_train(cfg, mesh, accum_steps=1,
+                                    telemetry=False)
+    half_mesh = make_mesh(fsdp=4, devices=devices[:4])
+    half = training.build_gpt_train(cfg, half_mesh, accum_steps=2,
+                                    telemetry=False)
+    state = full["init_fn"](jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    snap = host_state(state)
+    state4 = reshard_state(snap, half["state_shardings"])
+    jax.block_until_ready(state4)
+    shrink_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state8 = reshard_state(state4, full["state_shardings"])
+    jax.block_until_ready(state8)
+    expand_s = time.perf_counter() - t0
+
+    result = {
+        "metric": "elastic_accum_overhead",
+        "value": arms[1]["overhead_frac"],
+        "unit": "frac step time at accum_steps=2 vs 1 (global batch "
+                "fixed)",
+        "platform": platform,
+        "n_devices": len(devices),
+        "batch": batch, "seq": seq, "steps": steps,
+        "mesh": dict(mesh.shape),
+        "accum_arms": arms,
+        "reshard": {"shrink_8_to_4_s": round(shrink_s, 6),
+                    "expand_4_to_8_s": round(expand_s, 6)},
+    }
+    print(json.dumps(result))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -759,6 +888,9 @@ def main():
     from ray_tpu.models.gpt import GPTConfig
     from ray_tpu.parallel.mesh import make_mesh
 
+    if "--elastic" in sys.argv:
+        bench_elastic()
+        return
     if "--data" in sys.argv:
         bench_data()
         return

@@ -511,6 +511,22 @@ class StreamingLoader:
             self._pending_error = e
         return out
 
+    def set_sharding(self, sharding) -> None:
+        """Re-point delivery at a new mesh (the elastic shrink/expand
+        path): subsequent batches ``device_put`` onto ``sharding``,
+        and the already-staged double-buffered batch is re-placed so
+        the very next :meth:`next` also lands on the new topology —
+        the cursor, packer residue and prefetch queue are untouched,
+        which is what keeps the consumed document sequence identical
+        across topology changes."""
+        self.sharding = sharding
+        if self.device_put and self._primed and \
+                self._staged is not None:
+            import jax
+            self._staged.batch = jax.device_put(
+                jax.tree.map(lambda x: np.asarray(x),
+                             self._staged.batch), sharding)
+
     def __iter__(self) -> Iterator[StreamBatch]:
         while True:
             try:

@@ -10,6 +10,7 @@ TPU-native equivalent of the reference's DDP/FSDP wrapper selection
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -26,6 +27,74 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     step: jnp.ndarray
+
+
+def default_accum_steps() -> int:
+    """``RAY_TPU_ACCUM`` (default 1): gradient-accumulation microbatch
+    count the train builders use when ``accum_steps`` is not pinned —
+    the global-batch-invariance knob of the elastic story (an 8->4
+    mesh shrink doubles it so the optimization trajectory, not just
+    the arithmetic, survives the topology change)."""
+    import sys
+    raw = os.environ.get("RAY_TPU_ACCUM", "1")
+    try:
+        k = int(raw)
+    except ValueError:
+        print(f"RAY_TPU_ACCUM={raw!r} is not an integer; using 1",
+              file=sys.stderr)
+        return 1
+    if k < 1:
+        print(f"RAY_TPU_ACCUM={k} must be >= 1; using 1",
+              file=sys.stderr)
+        return 1
+    return k
+
+
+def _split_microbatches(batch: Dict[str, Any], accum_steps: int):
+    """Reshape every batch leaf ``[B, ...] -> [k, B/k, ...]`` for the
+    accumulation scan; loud on an indivisible batch (the
+    ``validate_divisibility`` suggestion names the fix)."""
+    sizes = {k: v.shape[0] for k, v in batch.items()}
+    bad = {k: b for k, b in sizes.items() if b % accum_steps}
+    if bad:
+        raise ValueError(
+            f"batch dims {bad} not divisible by accum_steps="
+            f"{accum_steps}: gradient accumulation scans whole "
+            "microbatches (see parallel.mesh.suggest_accum_steps "
+            "for a legal factor)")
+    return {k: v.reshape((accum_steps, v.shape[0] // accum_steps)
+                         + v.shape[1:])
+            for k, v in batch.items()}
+
+
+def _accum_value_and_grad(value_and_grad, params, batch,
+                          accum_steps: int):
+    """``value_and_grad`` over ``accum_steps`` microbatches with f32
+    gradient accumulation inside a ``lax.scan`` — the backward runs
+    per microbatch (activation memory is one microbatch's, the whole
+    point), partial gradients accumulate in f32 regardless of the
+    model dtype (bf16 partial sums would drift with ``k``), and the
+    mean loss/grads match the unaccumulated full-batch step to fp32
+    tolerance when microbatches carry equal valid-token counts (the
+    synthetic and packed batches here do; the residual difference is
+    reduction order only)."""
+    micro = _split_microbatches(batch, accum_steps)
+
+    def body(carry, mb):
+        loss_sum, grad_acc = carry
+        loss, grads = value_and_grad(params, mb)
+        grad_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grad_acc, grads)
+        return (loss_sum + loss.astype(jnp.float32), grad_acc), None
+
+    zeros = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (loss_sum, grad_acc), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), micro)
+    inv_k = 1.0 / accum_steps
+    grads = jax.tree.map(
+        lambda g, p: (g * inv_k).astype(p.dtype), grad_acc, params)
+    return loss_sum * inv_k, grads
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
@@ -97,6 +166,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     comm_mode: Optional[str] = None,
                     comm_quant: Optional[str] = None,
                     fuse_norm: Optional[bool] = None,
+                    accum_steps: Optional[int] = None,
                     telemetry: Optional[bool] = None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
@@ -130,7 +200,20 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     The overlap step/loss
     use their own block formulation (einsum attention, vocab-parallel
     CE), so ``attn_pack2``/``ce_mode`` only affect the GSPMD-side
-    ``forward_fn`` there.  ``telemetry`` (default: env
+    ``forward_fn`` there.  ``accum_steps`` (default: env
+    ``RAY_TPU_ACCUM``, 1) runs the step as ``k`` sequential
+    microbatches of ``B/k`` rows under a ``lax.scan`` with f32
+    gradient accumulation and ONE optimizer update — the global batch
+    (and with it the optimization trajectory) is invariant to the
+    device count, which is what lets an elastic 8->4 mesh shrink keep
+    training the *same* run (``resilience/elastic.py``); loss and
+    per-param grads match the unaccumulated full-batch step to fp32
+    tolerance (reduction order is the only difference), and the
+    effective value is returned as ``fns["accum_steps"]``.
+    ``accum_steps > 1`` declines the overlap schedule loudly (the
+    shard_map schedule has its own scan carry; nesting the microbatch
+    scan inside it is untested) and falls back to gspmd.
+    ``telemetry`` (default: env
     ``RAY_TPU_TELEMETRY``) wraps ``step_fn`` with a per-step
     :class:`ray_tpu.telemetry.StepTelemetry` recorder — the returned
     dict then also carries ``telemetry`` and ``raw_step_fn``.
@@ -139,6 +222,12 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     from ray_tpu.parallel import overlap as ovl
 
     tx = optimizer or default_optimizer()
+    if accum_steps is None:
+        accum_steps = default_accum_steps()
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps} "
+                         "(check RAY_TPU_ACCUM)")
     if comm_mode is None:
         comm_mode = ovl.comm_config().mode
     if comm_mode not in ("gspmd", "overlap"):
@@ -147,6 +236,13 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     if comm_mode == "overlap":
         if getattr(mesh, "size", 1) <= 1:
             comm_mode = "gspmd"   # single device: nothing to schedule
+        elif accum_steps > 1:
+            import sys
+            print(f"comm_mode=overlap does not support accum_steps="
+                  f"{accum_steps} (the schedule's prefetch scan would "
+                  "nest inside the microbatch scan); falling back to "
+                  "gspmd", file=sys.stderr)
+            comm_mode = "gspmd"
         else:
             reason = ovl.overlap_supported(cfg, mesh)
             if reason is not None:
@@ -224,7 +320,11 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
                        out_shardings=(st_sh, None), donate_argnums=(0,))
     def step(state: TrainState, batch):
-        loss_val, grads = value_and_grad(state.params, batch)
+        if accum_steps > 1:
+            loss_val, grads = _accum_value_and_grad(
+                value_and_grad, state.params, batch, accum_steps)
+        else:
+            loss_val, grads = value_and_grad(state.params, batch)
         updates, opt_state = tx.update(grads, state.opt_state,
                                        state.params)
         params = optax.apply_updates(state.params, updates)
@@ -258,6 +358,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "attn_fn": attn_fn,
         "comm_mode": comm_mode,
         "comm_quant": comm_quant,
+        "accum_steps": accum_steps,
     }
     return _maybe_instrument(fns, cfg, mesh, comm_mode=comm_mode,
                              comm_quant=comm_quant,
@@ -291,7 +392,8 @@ def rl_advantages(rewards, baseline: str = "rloo"):
 def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                        optimizer=None,
                        baseline: str = "rloo",
-                       attn_pack2: Optional[bool] = None
+                       attn_pack2: Optional[bool] = None,
+                       accum_steps: int = 1
                        ) -> Dict[str, Callable]:
     """Policy-gradient (REINFORCE/RLOO) step builder for the GPT family
     — the learner half of the ``ray_tpu.rl`` actor/learner split,
@@ -328,10 +430,22 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     hand-computed-gradient parity test and for LearnerGroup hosting
     (gradients leave jit, get allreduced, come back through
     ``apply_grads_fn``).
+
+    ``accum_steps > 1`` microbatches the trajectories ``B -> k x B/k``
+    under a ``lax.scan`` with f32 grad accumulation, mirroring
+    :func:`build_gpt_train` — crucially the RLOO/mean **baseline is
+    computed over the FULL batch first** (the r14 LearnerGroup lesson:
+    per-microbatch leave-one-out is a different, worse estimator), so
+    the accumulated step is the same policy gradient to reduction
+    order: the score-function loss is a plain sum over trajectories
+    and decomposes exactly across microbatches.
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
 
     rl_advantages(jnp.zeros((2,)), baseline)   # validate loudly, once
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     # NOT default_optimizer(): its warmup schedule starts at lr 0, so
     # an RL run's first (often only) handful of steps would be no-ops
     tx = optimizer or optax.chain(optax.clip_by_global_norm(1.0),
@@ -374,6 +488,69 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         }
         return loss, metrics
 
+    def _accum_pg_value_and_grad(params, batch):
+        """The accumulated policy-gradient step: advantages over the
+        FULL batch, then the score-function loss — a plain sum over
+        trajectories — split exactly across ``accum_steps``
+        microbatches whose grads accumulate in f32 (each microbatch's
+        partial is already ``/B``-scaled, so the accumulated sum IS
+        the full-batch gradient, no mean at the end)."""
+        B = batch["tokens"].shape[0]
+        adv = rl_advantages(batch["rewards"], baseline)
+        micro = _split_microbatches(
+            {"tokens": batch["tokens"], "targets": batch["targets"],
+             "adv": adv}, accum_steps)
+
+        def micro_loss(p, mb):
+            tokens, targets = mb["tokens"], mb["targets"]
+            logits, _aux = gpt_mod.forward(p, tokens, cfg,
+                                           attn_fn=attn_fn, mesh=mesh)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            chosen = jnp.take_along_axis(
+                logp, jnp.maximum(targets, 0)[..., None],
+                axis=-1)[..., 0]
+            mask = (targets >= 0).astype(jnp.float32)
+            seq_logp = jnp.sum(chosen * mask, axis=-1)
+            part = -jnp.sum(mb["adv"] * seq_logp) / B
+            ent_sum = -jnp.sum(
+                jnp.sum(jnp.exp(logp) * logp, -1) * mask)
+            sums = jnp.stack([jnp.sum(chosen * mask), ent_sum,
+                              jnp.sum(mask)])
+            return part, sums
+
+        def body(carry, mb):
+            loss_sum, grad_acc, sums = carry
+            (part, s), grads = jax.value_and_grad(
+                micro_loss, has_aux=True)(params, mb)
+            grad_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc,
+                grads)
+            return (loss_sum + part.astype(jnp.float32),
+                    grad_acc, sums + s), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss, grad_acc, sums), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros,
+                   jnp.zeros((3,), jnp.float32)), micro)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                             grad_acc, params)
+        n_act = jnp.maximum(sums[2], 1.0)
+        metrics = {
+            "pg_loss": loss,
+            "reward_mean": jnp.mean(batch["rewards"]),
+            "reward_max": jnp.max(batch["rewards"]),
+            "logp_mean": sums[0] / n_act,
+            "entropy": sums[1] / n_act,
+            "action_tokens": sums[2],
+        }
+        return (loss, metrics), grads
+
+    def pg_value_and_grad(params, batch):
+        if accum_steps > 1:
+            return _accum_pg_value_and_grad(params, batch)
+        return jax.value_and_grad(pg_loss, has_aux=True)(params, batch)
+
     def init(key) -> TrainState:
         params = gpt_mod.init_params(cfg, key)
         return TrainState(params, tx.init(params),
@@ -385,8 +562,8 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
                        out_shardings=(st_sh, None), donate_argnums=(0,))
     def step(state: TrainState, batch):
-        (loss_val, metrics), grads = jax.value_and_grad(
-            pg_loss, has_aux=True)(state.params, batch)
+        (loss_val, metrics), grads = pg_value_and_grad(state.params,
+                                                       batch)
         updates, opt_state = tx.update(grads, state.opt_state,
                                        state.params)
         params = optax.apply_updates(state.params, updates)
@@ -397,7 +574,7 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     @functools.partial(jax.jit,
                        in_shardings=(st_sh.params, batch_sh))
     def grad_fn(params, batch):
-        return jax.value_and_grad(pg_loss, has_aux=True)(params, batch)
+        return pg_value_and_grad(params, batch)
 
     # split apply for the LearnerGroup DDP path (grads leave jit for
     # the host allreduce ring and come back — the PPOLearner pattern)
@@ -422,6 +599,7 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "batch_sharding": batch_sh,
         "attn_fn": attn_fn,
         "baseline": baseline,
+        "accum_steps": accum_steps,
     }
 
 

@@ -42,6 +42,34 @@ class MeshSpec:
         axes = tuple((a, int(sizes[a])) for a in AXIS_ORDER if a in sizes)
         return cls(axes)
 
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshSpec":
+        """The logical shape of a live ``jax.sharding.Mesh`` (or a
+        MeshSpec, passed through) — what a checkpoint sidecar records
+        as the *writing* topology so a restore onto a different mesh
+        can be refused or resharded deliberately."""
+        if isinstance(mesh, cls):
+            return mesh
+        return cls(tuple((str(a), int(s))
+                         for a, s in dict(mesh.shape).items()))
+
+    # ----------------------------------------------- sidecar (de)serialization
+    def to_dict(self) -> Dict[str, int]:
+        """JSON-safe image for checkpoint sidecars (axis order is the
+        identity: ``{"fsdp": 8}`` and ``{"fsdp": 4, "tp": 2}`` are
+        different topologies even at equal size)."""
+        return {a: s for a, s in self.axes}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        # not create(): a sidecar written by a future axis set must
+        # still round-trip for the mismatch report instead of raising
+        # an unknown-axis error before the real message
+        return cls(tuple((str(a), int(s)) for a, s in d.items()))
+
+    def describe(self) -> str:
+        return ",".join(f"{a}={s}" for a, s in self.axes) or "dp=1"
+
     @property
     def size(self) -> int:
         return math.prod(s for _, s in self.axes) if self.axes else 1
@@ -124,13 +152,42 @@ def mesh_axis_size(mesh, axis: str) -> int:
     return mesh.shape.get(axis, 1) if hasattr(mesh, "shape") else 1
 
 
+def suggest_accum_steps(batch: int, div: int,
+                        prefer: int = 1) -> Optional[int]:
+    """The gradient-accumulation factor that would make ``batch``
+    legal on a mesh whose data axes multiply to ``div``: each of the
+    ``k`` microbatches (``batch / k`` rows) must be whole AND divide
+    evenly over the data axes, so legal ``k`` are exactly the divisors
+    of ``batch // div``.  Returns the legal ``k`` closest to
+    ``prefer`` (ties go up — more microbatches, less memory), or
+    ``None`` when ``div`` does not divide ``batch`` at all: no
+    accumulation factor can fix plain indivisibility, only a batch or
+    mesh change can."""
+    if div <= 0 or batch % div:
+        return None
+    per = batch // div
+    legal = [k for k in range(1, per + 1) if per % k == 0]
+    return min(legal, key=lambda k: (abs(k - prefer), -k))
+
+
 def validate_divisibility(mesh, *, batch: Optional[int] = None,
                           seq: Optional[int] = None,
                           d_model: Optional[int] = None,
-                          n_heads: Optional[int] = None) -> None:
-    """Fail fast on shape/axis mismatches instead of inside XLA."""
+                          n_heads: Optional[int] = None,
+                          accum_steps: int = 1) -> None:
+    """Fail fast on shape/axis mismatches instead of inside XLA.
+
+    ``accum_steps``: gradient-accumulation microbatch count — the
+    batch check then validates the *microbatch* (``batch /
+    accum_steps`` must be whole and divide the data axes), and a
+    failure names the failing axes with their sizes and suggests the
+    ``accum_steps`` that would make this mesh legal (the elastic
+    degraded-restore path: an 8->4 shrink keeps the global batch by
+    doubling accumulation instead of dying here)."""
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps={accum_steps} must be >= 1")
     checks = [
-        (batch, ("dp", "fsdp"), "batch"),
         (seq, ("sp",), "sequence length"),
         (n_heads, ("tp",), "attention heads"),
         (d_model, ("tp",), "d_model"),
@@ -140,6 +197,29 @@ def validate_divisibility(mesh, *, batch: Optional[int] = None,
             continue
         div = math.prod(mesh.shape.get(a, 1) for a in axes)
         if value % div:
+            present = ", ".join(
+                f"{a}={mesh.shape.get(a, 1)}" for a in axes
+                if mesh.shape.get(a, 1) > 1) or "all size 1"
             raise ValueError(
                 f"{label}={value} not divisible by mesh axes {axes} "
-                f"(product {div})")
+                f"({present}; product {div})")
+    if batch is None:
+        return
+    axes = ("dp", "fsdp")
+    div = math.prod(mesh.shape.get(a, 1) for a in axes)
+    if batch % (div * accum_steps) == 0:
+        return
+    present = ", ".join(f"{a}={mesh.shape.get(a, 1)}" for a in axes
+                        if mesh.shape.get(a, 1) > 1) or "all size 1"
+    suggestion = suggest_accum_steps(batch, div, prefer=accum_steps)
+    if suggestion is None:
+        hint = (f"no accum_steps can fix this — the data axes "
+                f"(product {div}) do not divide the global batch; "
+                "change the batch or the mesh")
+    else:
+        hint = (f"accum_steps={suggestion} would make this mesh "
+                f"legal (microbatch {batch // suggestion})")
+    raise ValueError(
+        f"batch={batch} with accum_steps={accum_steps} not divisible "
+        f"by mesh data axes {axes} ({present}; product {div}): each "
+        f"microbatch must be whole and shard evenly — {hint}")

@@ -13,6 +13,15 @@ bounded hiccups, spanning all four workloads built in r06–r14:
   run_train_stream_loop`, r17) the data-plane cursor rides the same
   extras — resume is float-equal even with reader deaths and
   SIGKILLs mid-stream.
+- **elastic** (r18) — the cluster that comes back may be *smaller*:
+  cross-mesh checkpoint restore (:func:`~ray_tpu.resilience.elastic.
+  reshard_state`, the mesh/accum sidecar +
+  :class:`~ray_tpu.resilience.elastic.MeshMismatchError` refusal),
+  global-batch-invariant gradient accumulation
+  (``build_gpt_train(accum_steps=)``), and the shrink/expand
+  supervisor :func:`~ray_tpu.resilience.elastic.
+  run_elastic_train_loop` driven by the ``mesh.loss`` /
+  ``mesh.restore`` chaos sites.
 - **RL** — the supervised actor/learner loop
   (:func:`~ray_tpu.resilience.supervisor.run_supervised_rl_loop`):
   dead rollout actors restart from the latest published weights with
@@ -37,6 +46,11 @@ from ray_tpu.resilience.checkpoint import (TrainCheckpointer,  # noqa: F401
                                            run_train_stream_loop)
 from ray_tpu.resilience.config import (ResilienceConfig,  # noqa: F401
                                        resilience_config)
+from ray_tpu.resilience.elastic import (ElasticError,  # noqa: F401
+                                        MeshMismatchError,
+                                        ReshardError,
+                                        reshard_state,
+                                        run_elastic_train_loop)
 from ray_tpu.resilience.supervisor import run_supervised_rl_loop  # noqa: F401
 from ray_tpu.resilience.watchdog import EngineWatchdog  # noqa: F401
 
@@ -45,5 +59,7 @@ __all__ = [
     "TrainCheckpointer", "run_train_ckpt_loop",
     "run_train_stream_loop",
     "run_supervised_rl_loop",
+    "ElasticError", "MeshMismatchError", "ReshardError",
+    "reshard_state", "run_elastic_train_loop",
     "EngineWatchdog",
 ]

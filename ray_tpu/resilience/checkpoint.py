@@ -56,6 +56,14 @@ from ray_tpu.util import chaos
 _STATE_NAME = "train_state"
 
 
+def _mesh_spec(mesh):
+    """Mesh / MeshSpec / None -> MeshSpec or None (the sidecar form)."""
+    if mesh is None:
+        return None
+    from ray_tpu.parallel.mesh import MeshSpec
+    return MeshSpec.from_mesh(mesh)
+
+
 def _host_tree(tree):
     """Device pytree -> host (numpy) pytree.  Blocks until the leaves'
     producing computation is done — which is exactly the between-steps
@@ -129,6 +137,8 @@ class TrainCheckpointer:
     def __init__(self, directory: Optional[str] = None, *,
                  every: Optional[int] = None,
                  keep: Optional[int] = None,
+                 mesh=None,
+                 accum_steps: Optional[int] = None,
                  label: str = "train",
                  telemetry=None):
         rcfg = resilience_config()
@@ -153,6 +163,11 @@ class TrainCheckpointer:
         config = (TelemetryConfig(enabled=bool(telemetry))
                   if isinstance(telemetry, bool) else None)
         self.telemetry = CkptTelemetry(label=label, config=config)
+        # default elastic sidecar (per-save mesh=/accum_steps= override
+        # it — the elastic loop's topology changes mid-run)
+        self.mesh_spec = _mesh_spec(mesh)
+        self.accum_steps = (None if accum_steps is None
+                            else int(accum_steps))
         self.write_errors: List[str] = []
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._lock = threading.Lock()   # manager index/registration
@@ -163,23 +178,42 @@ class TrainCheckpointer:
 
     # -------------------------------------------------------- hot path
     def maybe_save(self, state, *, step: int,
-                   extras: Optional[Dict[str, Any]] = None) -> bool:
+                   extras: Optional[Dict[str, Any]] = None,
+                   mesh=None,
+                   accum_steps: Optional[int] = None) -> bool:
         """Checkpoint iff ``every`` is on and ``step % every == 0``.
         Returns True when a snapshot was taken (write still async)."""
         if not self.every or step % self.every:
             return False
-        self.save(state, step=step, extras=extras)
+        self.save(state, step=step, extras=extras, mesh=mesh,
+                  accum_steps=accum_steps)
         return True
 
     def save(self, state, *, step: int,
-             extras: Optional[Dict[str, Any]] = None) -> None:
-        """Snapshot now: host copy on this thread, write in background."""
+             extras: Optional[Dict[str, Any]] = None,
+             mesh=None,
+             accum_steps: Optional[int] = None) -> None:
+        """Snapshot now: host copy on this thread, write in background.
+
+        ``mesh``/``accum_steps`` override the constructor defaults for
+        this snapshot's elastic sidecar — the writing topology and
+        accumulation factor ride the checkpoint metadata so a restore
+        onto a *different* mesh is a decision
+        (:meth:`restore_latest` ``reshard=True``), never an accident."""
         payload = {
             "state": _host_tree(state),
             "extras": {k: np.asarray(v)
                        for k, v in (extras or {}).items()},
         }
-        self._q.put((payload, int(step)))
+        spec = _mesh_spec(mesh) if mesh is not None else self.mesh_spec
+        accum = self.accum_steps if accum_steps is None \
+            else int(accum_steps)
+        sidecar: Dict[str, Any] = {}
+        if spec is not None:
+            sidecar["mesh"] = spec.to_dict()
+        if accum is not None:
+            sidecar["accum_steps"] = accum
+        self._q.put((payload, int(step), sidecar))
 
     # ------------------------------------------------------- background
     def _writer(self) -> None:
@@ -188,7 +222,7 @@ class TrainCheckpointer:
             if job is None:
                 self._q.task_done()
                 return
-            payload, step = job
+            payload, step, sidecar = job
             try:
                 t0 = time.monotonic()
                 chaos.maybe_fail("ckpt.write")
@@ -197,9 +231,15 @@ class TrainCheckpointer:
                     dest = os.path.join(self.directory,
                                         f"checkpoint_{idx:06d}")
                     save_pytree(payload, dest, name=_STATE_NAME)
+                    ckpt_obj = Checkpoint(dest)
+                    if sidecar:
+                        # the elastic block rides the checkpoint's own
+                        # .metadata.json (one JSON for both the orbax
+                        # and npz state formats)
+                        ckpt_obj.set_metadata({"elastic": sidecar})
                     if chaos.should_fire("ckpt.truncate"):
                         _truncate_dir(dest)
-                    self.manager.register(Checkpoint(dest),
+                    self.manager.register(ckpt_obj,
                                           metrics={"step": step})
                 self.telemetry.record_write(time.monotonic() - t0,
                                             step=step)
@@ -228,7 +268,9 @@ class TrainCheckpointer:
         self.close()
 
     # ---------------------------------------------------------- restore
-    def restore_latest(self, example=None) -> Optional[Dict[str, Any]]:
+    def restore_latest(self, example=None, *, mesh=None,
+                       reshard: bool = False
+                       ) -> Optional[Dict[str, Any]]:
         """Newest restorable snapshot, or None when the directory holds
         nothing usable.
 
@@ -241,25 +283,59 @@ class TrainCheckpointer:
         model must cost one checkpoint interval of progress, not the
         run (and must never train on silently-wrong arrays).
 
-        Returns ``{"state", "extras", "step", "path"}``.
+        ``mesh``: the topology the caller intends to restore onto.
+        When the snapshot's elastic sidecar records a *different*
+        writing mesh, restore raises a typed
+        :class:`~ray_tpu.resilience.elastic.MeshMismatchError` unless
+        ``reshard=True`` — the state's host arrays place onto any
+        dividing mesh (``resilience.elastic.reshard_state``), but that
+        must be a decision, not a drive-by.  Snapshots written before
+        the sidecar existed (no ``elastic`` block) restore as before
+        — back-compat over strictness for data that cannot know.
+
+        Returns ``{"state", "extras", "step", "path", "mesh",
+        "accum_steps"}`` (``mesh``: the recorded
+        :class:`~ray_tpu.parallel.mesh.MeshSpec` or None;
+        ``accum_steps``: the recorded factor or None).
         """
         self.flush()
         with self._lock:
             candidates = list(self.manager.best_checkpoints())
         for ckpt, metrics in candidates:     # newest first (recency)
+            # the sidecar is one small JSON — check the topology
+            # BEFORE deserializing a potentially multi-GB state that
+            # a mismatch would only throw away
+            sidecar = ckpt.get_metadata().get("elastic", {})
+            recorded = sidecar.get("mesh")
+            if recorded is not None:
+                from ray_tpu.parallel.mesh import MeshSpec
+                recorded = MeshSpec.from_dict(recorded)
+                if mesh is not None and not reshard:
+                    current = _mesh_spec(mesh)
+                    if recorded != current:
+                        # NOT a fall-back case: every retained
+                        # snapshot of this run was written on the same
+                        # mesh — walking older ones would just repeat
+                        # the mismatch against staler state
+                        from ray_tpu.resilience.elastic import \
+                            MeshMismatchError
+                        raise MeshMismatchError(recorded, current)
             try:
                 payload = load_pytree(ckpt.path, name=_STATE_NAME,
                                       target=example)
                 if example is not None:
                     _validate_tree(payload, example)
-                return {"state": payload["state"],
-                        "extras": payload.get("extras", {}),
-                        "step": int(metrics.get("step", -1)),
-                        "path": ckpt.path}
             except Exception as e:  # noqa: BLE001 — fall back, loudly
                 print(f"checkpoint restore from {ckpt.path} failed "
                       f"({e!r}); falling back to the previous "
                       "retained snapshot", file=sys.stderr)
+                continue
+            return {"state": payload["state"],
+                    "extras": payload.get("extras", {}),
+                    "step": int(metrics.get("step", -1)),
+                    "path": ckpt.path,
+                    "mesh": recorded,
+                    "accum_steps": sidecar.get("accum_steps")}
         return None
 
 
@@ -304,7 +380,7 @@ def run_train_ckpt_loop(cfg, mesh=None, *, steps: int,
             raise ValueError("resume=True needs a TrainCheckpointer")
         example = {"state": state,
                    "extras": {"data_cursor": np.asarray(0)}}
-        restored = ckpt.restore_latest(example=example)
+        restored = ckpt.restore_latest(example=example, mesh=mesh)
         if restored is not None:
             state = jax.device_put(restored["state"],
                                    fns["state_shardings"])
@@ -323,7 +399,9 @@ def run_train_ckpt_loop(cfg, mesh=None, *, steps: int,
         cursor += 1
         if ckpt is not None:
             ckpt.maybe_save(state, step=cursor,
-                            extras={"data_cursor": cursor})
+                            extras={"data_cursor": cursor},
+                            mesh=mesh,
+                            accum_steps=fns.get("accum_steps"))
         if on_step is not None:
             on_step(cursor)
     if ckpt is not None:
@@ -398,7 +476,7 @@ def run_train_stream_loop(cfg, mesh=None, *, steps: int,
         example = {"state": state,
                    "extras": {"data_cursor":
                               np.zeros(capacity, np.uint8)}}
-        restored = ckpt.restore_latest(example=example)
+        restored = ckpt.restore_latest(example=example, mesh=mesh)
         if restored is not None:
             state = jax.device_put(restored["state"],
                                    fns["state_shardings"])
@@ -430,7 +508,9 @@ def run_train_stream_loop(cfg, mesh=None, *, steps: int,
             step = sb.cursor.batches
             if ckpt is not None:
                 ckpt.maybe_save(state, step=step,
-                                extras={"data_cursor": sb.cursor_array})
+                                extras={"data_cursor": sb.cursor_array},
+                                mesh=mesh,
+                                accum_steps=fns.get("accum_steps"))
             if on_step is not None:
                 on_step(step)
         data_summary = loader.telemetry.summary()

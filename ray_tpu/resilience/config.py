@@ -28,10 +28,20 @@ class ResilienceConfig:
     - ``RAY_TPU_CKPT_KEEP`` (default ``3``): retained snapshots —
       retention rides ``train/checkpoint_manager.py`` (newest-first;
       the corrupt-restore fallback walks these in order).
+    - ``RAY_TPU_ELASTIC_MIN_DEVICES`` (default ``1``): the smallest
+      mesh :func:`~ray_tpu.resilience.elastic.run_elastic_train_loop`
+      will degrade to on a ``mesh.loss`` event — below it the loss is
+      fatal (a 1-device "fleet" may be worse than waiting for quota).
+    - ``RAY_TPU_ELASTIC_GRACEFUL`` (default ``1``): whether a mesh
+      loss gets a final host snapshot (the TPU eviction-notice model:
+      zero lost steps) or must restore from the latest retained
+      checkpoint (hard preemption: lost work bounded by the cadence).
     """
     ckpt_every: int = 0
     ckpt_dir: Optional[str] = None
     ckpt_keep: int = 3
+    elastic_min_devices: int = 1
+    elastic_graceful: bool = True
 
 
 _CONFIG: Optional[ResilienceConfig] = None
@@ -53,9 +63,17 @@ def resilience_config(refresh: bool = False) -> ResilienceConfig:
                   "needs at least the latest snapshot); using 1",
                   file=sys.stderr)
             keep = 1
+        min_dev = int(env("RAY_TPU_ELASTIC_MIN_DEVICES", "1"))
+        if min_dev < 1:
+            print(f"RAY_TPU_ELASTIC_MIN_DEVICES={min_dev} must be "
+                  ">= 1; using 1", file=sys.stderr)
+            min_dev = 1
         _CONFIG = ResilienceConfig(
             ckpt_every=every,
             ckpt_dir=env("RAY_TPU_CKPT_DIR") or None,
             ckpt_keep=keep,
+            elastic_min_devices=min_dev,
+            elastic_graceful=env("RAY_TPU_ELASTIC_GRACEFUL", "1")
+            not in ("0", "false", "False"),
         )
     return _CONFIG

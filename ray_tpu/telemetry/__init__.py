@@ -27,6 +27,7 @@ from ray_tpu.telemetry.ckpt import CkptTelemetry  # noqa: F401
 from ray_tpu.telemetry.data import DataTelemetry  # noqa: F401
 from ray_tpu.telemetry.config import (TelemetryConfig,  # noqa: F401
                                       telemetry_config)
+from ray_tpu.telemetry.elastic import ElasticTelemetry  # noqa: F401
 from ray_tpu.telemetry.fleet import FleetTelemetry  # noqa: F401
 from ray_tpu.telemetry.flops import (chip_peak_tflops,  # noqa: F401
                                      gpt_fwd_flops_per_token,
@@ -43,6 +44,7 @@ __all__ = [
     "RLTelemetry",
     "CkptTelemetry",
     "DataTelemetry",
+    "ElasticTelemetry",
     "FleetTelemetry",
     "chrome_trace",
     "chip_peak_tflops", "gpt_fwd_flops_per_token",

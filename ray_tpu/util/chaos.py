@@ -45,7 +45,14 @@ itself).  Current sites:
   state (the plane retries; the replayed batch is bit-identical);
 - ``data.stall`` — the Nth shard read sleeps ``RAY_TPU_DATA_STALL_S``
   (slow-shard backpressure: the bounded prefetch queue drains and the
-  trainer's ``data_stall_seconds`` histogram shows the block).
+  trainer's ``data_stall_seconds`` histogram shows the block);
+- ``mesh.loss`` — at the Nth elastic-loop step the training mesh
+  loses devices (slice preemption): the loop snapshots (graceful) or
+  falls back to the latest retained checkpoint, rebuilds at the
+  surviving size with the gradient-accumulation factor scaled to keep
+  the global batch, and reshards (``resilience/elastic.py``);
+- ``mesh.restore`` — at the Nth step the lost capacity returns: the
+  loop re-expands to the full mesh the same way.
 
 Spec grammar: comma-separated ``site@N`` entries (``N`` = 1-based hit
 index, fires once; bare ``site`` means ``site@1``), e.g.

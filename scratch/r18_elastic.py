@@ -1,0 +1,130 @@
+"""Round-18 on-chip driver: elastic-training A/Bs.
+
+Usage: python scratch/r18_elastic.py <variant>
+
+Variants:
+  elastic — the shrink/expand acceptance A/B on real hardware: an
+            uninterrupted 8-device run vs an 8->4->8 run (mesh.loss
+            mid-training, degraded steps at accum_steps=2 with the
+            global batch unchanged, mesh.restore expand), both from
+            one fixed seed.  Reports max |loss drift| (host-sim is
+            exactly 0; on chip the collective reduction order may
+            drift — the documented tolerance), cursor-accounting
+            equality (must be exact), per-topology compile counts
+            (must be 1 each) and the measured reshard seconds — the
+            real number this arm prices is device_put across live ICI
+            vs the CPU host-sim's memcpy.
+  accum   — `bench.py --elastic`: gradient-accumulation overhead at
+            fixed global batch (k in {1,2,4}; the per-microbatch
+            dispatch cost on chip decides the default) + the 8->4->8
+            TrainState reshard wall seconds.
+
+Carried arms (no chip session yet; every r06-r17 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+data / resume plus all r6-r16 arms — delegated verbatim to
+scratch/r17_data.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "elastic"
+
+_R17_ARMS = ("data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R17_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r17_data.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r18_elastic.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("elastic", "accum"), f"unknown variant {VARIANT!r}"
+
+ROOT = os.path.dirname(HERE)
+
+if VARIANT == "accum":
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--elastic"]
+        + sys.argv[2:]).returncode)
+
+
+# ---------------------------------------------------------- elastic arm
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.resilience import run_elastic_train_loop  # noqa: E402
+from ray_tpu.util import chaos  # noqa: E402
+
+devices = jax.devices()
+platform = devices[0].platform
+if len(devices) < 8:
+    # host-sim re-exec (the r8+ idiom): schedule check, not hardware
+    import re
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count=8").strip()
+    print("re-exec on host-simulated 8-device CPU mesh",
+          file=sys.stderr)
+    sys.exit(subprocess.run([sys.executable, __file__, VARIANT],
+                            env=env).returncode)
+
+if platform == "cpu":
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    steps, batch, seq = 12, 32, 128
+else:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=False,
+                         unroll_layers=True, ce_chunk=-1)
+    steps, batch, seq = 12, 32, 1024
+
+t0 = time.time()
+base = run_elastic_train_loop(cfg, steps=steps, batch_size=batch,
+                              seq_len=seq, seed=0, telemetry=True)
+chaos.install_faults("mesh.loss@4,mesh.restore@9")
+rec = run_elastic_train_loop(cfg, steps=steps, batch_size=batch,
+                             seq_len=seq, seed=0, telemetry=True)
+chaos.clear_faults()
+
+drift = [abs(a - b) for a, b in zip(base["losses"], rec["losses"])]
+rel = [d / max(abs(a), 1e-9)
+       for d, a in zip(drift, np.abs(base["losses"]))]
+print(json.dumps({
+    "metric": "elastic_loss_drift_max_rel",
+    "value": round(float(max(rel)), 9),
+    "unit": "rel |loss delta| vs uninterrupted 8-dev run",
+    "platform": platform,
+    "steps": steps, "batch": batch, "seq": seq,
+    "transitions": rec["transitions"],
+    "cursor_accounting_exact":
+        rec["batch_cursors"] == base["batch_cursors"],
+    "compile_counts": rec["compile_counts"],
+    "degraded_devices": min(t["to"] for t in rec["transitions"]),
+    "reshard": rec["elastic"],
+    "losses_base": [round(x, 6) for x in base["losses"]],
+    "losses_elastic": [round(x, 6) for x in rec["losses"]],
+    "wall_s": round(time.time() - t0, 1),
+}))
+ok = (rec["batch_cursors"] == base["batch_cursors"]
+      and all(v == 1 for v in rec["compile_counts"].values())
+      and max(rel) < 5e-3)
+sys.exit(0 if ok else 1)

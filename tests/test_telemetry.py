@@ -296,6 +296,35 @@ def test_data_telemetry_summary():
     assert off.summary() == {"enabled": False}
 
 
+def test_elastic_telemetry_summary():
+    """r18: the elastic-loop recorder's summary block — live mesh
+    size, transitions split by kind, reshard-latency stats — plus the
+    disabled no-op and the unknown-kind guard."""
+    from ray_tpu.telemetry import ElasticTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = ElasticTelemetry(config=TelemetryConfig(enabled=True))
+    tel.record_mesh(8)
+    assert tel.summary()["mesh_devices"] == 8
+    assert tel.summary()["transitions_total"] == 0
+    tel.record_transition("shrink", 0.2, n_devices=4)
+    tel.record_transition("expand", 0.4, n_devices=8)
+    tel.record_transition("shrink", 0.1, n_devices=4)
+    out = tel.summary()
+    assert out["enabled"] and out["label"] == "train"
+    assert out["mesh_devices"] == 4
+    assert out["transitions"] == {"shrink": 2, "expand": 1}
+    assert out["transitions_total"] == 3
+    assert out["reshard_s"] == pytest.approx(0.2)
+    assert out["reshard_max_s"] == pytest.approx(0.4)
+    with pytest.raises(ValueError, match="shrink"):
+        tel.record_transition("sideways", 0.1, n_devices=4)
+    off = ElasticTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_mesh(8)
+    off.record_transition("shrink", 0.1, n_devices=4)
+    assert off.summary() == {"enabled": False}
+
+
 def test_fleet_telemetry_summary():
     """r16: the fleet recorder's summary block — router retries split
     by cause, replica restarts, affinity hit rate and the per-replica
@@ -449,14 +478,17 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert steps, [ev.get("name") for ev in timeline][:20]
     assert all(ev["ph"] == "X" and ev["dur"] > 0 for ev in steps)
 
-    # r15 resilience + r16 fleet + r17 data-plane series ride the same
-    # control plane
+    # r15 resilience + r16 fleet + r17 data-plane + r18 elastic series
+    # ride the same control plane
     from ray_tpu.telemetry import (CkptTelemetry, DataTelemetry,
-                                   FleetTelemetry, InferTelemetry,
-                                   RLTelemetry)
+                                   ElasticTelemetry, FleetTelemetry,
+                                   InferTelemetry, RLTelemetry)
     from ray_tpu.telemetry.config import TelemetryConfig
     on = TelemetryConfig(enabled=True)
     CkptTelemetry(config=on).record_write(0.1, step=2)
+    elastic = ElasticTelemetry(config=on)
+    elastic.record_mesh(8)
+    elastic.record_transition("shrink", 0.05, n_devices=4)
     RLTelemetry(config=on).record_actor_restart()
     InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
     data = DataTelemetry(config=on)
@@ -492,3 +524,8 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "data_prefetch_depth" in text
     assert "data_stall_seconds" in text
     assert "data_reader_restarts_total" in text
+    # r18 elastic series: gauge, reshard histogram, kind-split counter
+    assert "train_mesh_devices" in text
+    assert "user_histogram_train_reshard_seconds_bucket" in text
+    assert "train_elastic_transitions_total" in text
+    assert "shrink" in text
