@@ -439,6 +439,155 @@ def bench_infer_fleet(replicas_n: int):
         print(json.dumps(record))
 
 
+def _bench_gray_arm(cfg, params, replicas_n, slots, page, fcfg,
+                    executables, payloads, gap_s, fault_spec):
+    """One measured gray-failure arm (scoped so each arm's fleet frees
+    before the next allocates): builds the fleet, arms the slowdown
+    plan, runs the open-loop trace, returns the stream-level numbers."""
+    from ray_tpu.fleet import EngineReplica, FleetRouter
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.telemetry.config import TelemetryConfig
+    from ray_tpu.telemetry.fleet import FleetTelemetry
+    from ray_tpu.util import chaos
+
+    engines = [InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, telemetry=False,
+                               max_queue=0,
+                               executable_cache=executables)
+               for _ in range(replicas_n)]
+    router = FleetRouter(
+        [EngineReplica(f"r{i}", e) for i, e in enumerate(engines)],
+        cfg=fcfg, affinity=False, rng_seed=0, concurrent_steps=True,
+        telemetry=FleetTelemetry(config=TelemetryConfig(enabled=True)))
+    chaos.install_faults(fault_spec)
+    try:
+        dt, streams = _run_fleet_open_loop(router, payloads, gap_s)
+    finally:
+        chaos.clear_faults()
+    router.quiesce()
+    inter = [b - a for s in streams
+             for a, b in zip(s.token_ts, s.token_ts[1:])]
+    out = {
+        "wall_s": dt,
+        "generated_tokens": sum(len(s.generated) for s in streams),
+        "errors": sum(1 for s in streams if s.error is not None),
+        "ttfts": sorted(router.recent_ttfts()),
+        "inter_token": sorted(inter),
+        "compiles": [e.stats()["compiles"] for e in engines],
+        "fleet": router.telemetry.summary(),
+        "leak_free": router.leak_free(),
+    }
+    router.close()
+    return out
+
+
+def bench_infer_gray(replicas_n: int):
+    """Gray-failure A/B: ``python bench.py --infer --replicas N
+    --gray`` — the same open-loop trace twice over an N-replica fleet
+    whose replica r0 runs under a sustained ``serve.tick[r0]`` delay
+    window (slow, never dead), once with hedging + latency demotion ON
+    and once OFF.  Two JSON lines, one per arm, each carrying p50/p99
+    TTFT, inter-token p99, hedges issued/won/wasted and demotions —
+    the r19 acceptance A/B: with mitigation on, the fleet's tail must
+    stop tracking the straggler."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.fleet import FleetConfig
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.inference.config import infer_config
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        slots, page, max_new = 4, 16, 8
+        # the delay dwarfs a healthy tick (a few ms) so the injected
+        # gray failure dominates the tails; arrivals stretch past the
+        # straggler's first slow tick (the EWMA needs one completed
+        # tick before demotion can protect later arrivals), and N-1
+        # healthy replicas can absorb the whole trace without deep
+        # queues: the A/B isolates the gray failure, not generic
+        # overload (where no routing policy wins)
+        gap_s, delay_s = 0.03, 0.4
+        requests = 8 * replicas_n
+        suffix_lens = [9, 17, 5, 23, 12, 30, 7, 14]
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        icfg = infer_config()
+        slots, page, max_new = icfg.slots, icfg.page_size, 32
+        gap_s, delay_s = 0.02, 0.5
+        requests = 8 * replicas_n
+        suffix_lens = [32 + 23 * i % 224 for i in range(requests)]
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts, _ = _infer_trace(cfg, page, requests, rng_seed=1,
+                              shared_pages=1, suffix_lens=suffix_lens)
+    executables = {}
+    # warm both prefill flavors (the r16 fleet-bench precedent): the
+    # first measured arm must not pay a compile the second one rides
+    for warm_prefix in (False, True):
+        warm = InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, telemetry=False,
+                               max_queue=0, prefix=warm_prefix,
+                               executable_cache=executables)
+        _run_open_loop(warm, prompts, max_new, gap_s=0.0)
+        del warm
+
+    payloads = [{"tokens": p, "max_new_tokens": max_new}
+                for p in prompts]
+    # the slow window covers every r0 tick the trace can reach
+    fault_spec = f"serve.tick[r0]@1..100000:delay={delay_s}"
+    arms = {
+        "on": FleetConfig(slow_factor=3.0, hedge=True,
+                          hedge_factor=2.0, hedge_min=2 * gap_s),
+        "off": FleetConfig(slow_factor=0.0, hedge=False),
+    }
+    for name, fcfg in arms.items():
+        arm = _bench_gray_arm(cfg, params, replicas_n, slots, page,
+                              fcfg, executables, payloads, gap_s,
+                              fault_spec)
+        ttfts, inter = arm["ttfts"], arm["inter_token"]
+
+        def pct(xs, q):
+            if not xs:
+                return 0.0
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))], 4)
+
+        fleet = arm["fleet"]
+        record = {
+            "metric": "gpt_infer_gray_ttft_p99_s",
+            "value": pct(ttfts, 0.99),
+            "unit": "s",
+            "platform": platform,
+            "mitigation": name,
+            "replicas": replicas_n,
+            "requests": requests,
+            "slow_replica": "r0",
+            "slow_delay_s": delay_s,
+            "generated_tokens": arm["generated_tokens"],
+            "errors": arm["errors"],
+            "wall_s": round(arm["wall_s"], 3),
+            "tokens_per_sec": round(
+                arm["generated_tokens"] / arm["wall_s"], 1)
+            if arm["wall_s"] > 0 else 0.0,
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "inter_token_p99_s": pct(inter, 0.99),
+            "hedges": fleet.get("hedges", {}),
+            "demotions": fleet.get("replica_demotions", 0),
+            "compiles": arm["compiles"],
+            "leak_free": arm["leak_free"],
+            "open_loop_gap_s": gap_s,
+        }
+        print(json.dumps(record))
+
+
 def bench_infer():
     """Inference headline: continuous-batching decode throughput.
 
@@ -896,7 +1045,10 @@ def main():
         return
     if "--infer" in sys.argv:
         n = _replicas_arg()
-        if n > 1:
+        if "--gray" in sys.argv:
+            # the demotion median wants an odd-one-out: 3+ replicas
+            bench_infer_gray(n if n > 1 else 3)
+        elif n > 1:
             bench_infer_fleet(n)
         else:
             bench_infer()

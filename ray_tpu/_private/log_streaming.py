@@ -73,12 +73,16 @@ class _TeeStream(io.TextIOBase):
         return False
 
 
-def install_worker_tee(cp, worker_id: bytes) -> None:
+def install_worker_tee(cp, worker_id: bytes):
     """Route this worker's stdout/stderr lines to the CP pubsub.
 
     Lines go through a bounded queue drained by one background thread —
     a print must never block on a control-plane round trip, and a
     storm of output drops lines (counted) rather than stalling work.
+
+    Returns the drain function (also registered with ``atexit``, and
+    idempotent): the worker's fast-exit path calls it explicitly
+    before ``os._exit``, which skips atexit handlers.
     """
     import atexit
     import queue
@@ -109,7 +113,12 @@ def install_worker_tee(cp, worker_id: bytes) -> None:
         except queue.Full:
             dropped[0] += 1
 
+    drained = [False]
+
     def drain():
+        if drained[0]:
+            return
+        drained[0] = True
         try:
             sys.stdout.flush()
             sys.stderr.flush()
@@ -128,6 +137,7 @@ def install_worker_tee(cp, worker_id: bytes) -> None:
     atexit.register(drain)
     sys.stdout = _TeeStream(sys.stdout, publish, "out")
     sys.stderr = _TeeStream(sys.stderr, publish, "err")
+    return drain
 
 
 class DriverLogMonitor:
@@ -165,4 +175,8 @@ class DriverLogMonitor:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=3)
+            # the loop may be parked in a 2 s control-plane long-poll;
+            # it is a daemon thread and every print is exception-
+            # guarded, so abandon it rather than paying the remainder
+            # of the poll on every session shutdown
+            self._thread.join(timeout=0.2)

@@ -1825,3 +1825,11 @@ class NodeManager:
                     self._forksrv_proc.kill()
                 self._forksrv_proc = None
         self._server.shutdown()
+        # the caller destroys the shm store right after stop() returns
+        # (node.py / node_proc.py): join the loops that touch it so an
+        # in-flight owner sweep can't call into a detached native arena
+        cur = threading.current_thread()
+        for t in (self._hb_thread, self._dispatch_thread,
+                  self._dep_thread):
+            if t is not cur and t.is_alive():
+                t.join(timeout=5.0)

@@ -397,9 +397,12 @@ class ShmStore:
 
     # ------------------------------------------------------- delete -----
     def delete(self, object_id: bytes) -> bool:
-        arena_removed = (self._arena is not None
-                         and self._arena.delete(object_id))
         with self._lock:
+            # the native call must not race destroy()'s detach — the
+            # NM heartbeat's owner sweep can be mid-delete when the
+            # session tears the store down
+            arena_removed = (self._arena is not None
+                             and self._arena.delete(object_id))
             self._mapped.pop(object_id, None)
             entry = self._index.pop(object_id, None)
             if entry:
@@ -509,7 +512,8 @@ class ShmStore:
 
     def destroy(self) -> None:
         self.release_mappings()
-        if self._arena is not None:
-            self._arena.detach()
-            self._arena = None
+        with self._lock:
+            arena, self._arena = self._arena, None
+        if arena is not None:
+            arena.detach()
         shutil.rmtree(self.root, ignore_errors=True)

@@ -59,9 +59,11 @@ class WorkerProcess:
         from ray_tpu._private.ref_tracker import install_tracker
         install_tracker(self.worker_id.binary(), self.cp,
                         node_id=self.node_id)
+        self._log_drain = None
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") == "1":
             from ray_tpu._private.log_streaming import install_worker_tee
-            install_worker_tee(self.cp, self.worker_id.binary())
+            self._log_drain = install_worker_tee(
+                self.cp, self.worker_id.binary())
         # actor execution machinery (populated on creation)
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
@@ -100,7 +102,16 @@ class WorkerProcess:
             kind = msg.get("type")
             if kind == "exit":
                 self._send({"type": "exit"})
-                return
+                # fast exit: flush the log tee, then skip interpreter
+                # finalization (XLA backend teardown + atexit walks
+                # cost ~1.5 s per worker — every session shutdown on
+                # the tier-1 box paid it x workers).  The NM-died
+                # path above already exits this way.
+                if self._log_drain is not None:
+                    self._log_drain()
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(0)
             if kind != "task":
                 continue
             spec: TaskSpec = msg["spec"]

@@ -40,15 +40,24 @@ class DataConfig:
       reader is restarted and the fetch re-issued against the retry
       budget).  Raise it for cold/slow shard storage — a healthy slow
       fetch must not be converted into restarts.
-    - ``RAY_TPU_DATA_STALL_S`` (default ``0.2``): seconds the
-      ``data.stall`` chaos site sleeps inside a shard read — the
-      slow-shard backpressure injection, not a production knob.
+    - ``RAY_TPU_DATA_HEDGE`` (default ``0`` = off): shard-read hedge
+      budget in seconds — a read that has not returned within it is
+      re-issued to a standby reader, first response wins (the loser's
+      identical result is discarded; exactly-once holds because
+      sources are pure and only the cursor advances consumption).
+      The gray-failure mitigation for the slow-but-alive shard.
+    - ``RAY_TPU_DATA_STALL_S`` (default ``0.2``): **deprecated alias**
+      — seconds a bare ``data.stall@N`` chaos entry sleeps inside a
+      shard read.  Superseded by the unified ``site@N:delay=S`` /
+      ``site@N..M:delay=S`` latency grammar (``util/chaos.py``),
+      which needs no side-channel knob; kept so old specs replay.
     """
     prefetch: int = 2
     readers: int = 0
     retries: int = 3
     pack: bool = True
     read_timeout_s: float = 120.0
+    hedge_s: float = 0.0
     stall_s: float = 0.2
 
 
@@ -83,6 +92,7 @@ def data_config(refresh: bool = False) -> DataConfig:
             pack=env("RAY_TPU_DATA_PACK", "1") != "0",
             read_timeout_s=float(env("RAY_TPU_DATA_READ_TIMEOUT",
                                      "120")),
+            hedge_s=max(0.0, float(env("RAY_TPU_DATA_HEDGE", "0"))),
             stall_s=float(env("RAY_TPU_DATA_STALL_S", "0.2")),
         )
     return _CONFIG

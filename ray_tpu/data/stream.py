@@ -32,10 +32,17 @@ a source of randomness yet).
 
 Deterministic fault sites (``RAY_TPU_FAULTS``, ``util/chaos.py``):
 ``data.read`` (a shard fetch dies — the plane restarts the reader and
-re-issues), ``data.pack`` (a batch assembly dies before mutating packer
-state — retried), ``data.stall`` (a shard read sleeps
-``RAY_TPU_DATA_STALL_S`` — the slow-shard backpressure probe the
-``data_stall_seconds`` histogram watches).
+re-issues; a ``data.read@N..M:delay=S`` entry instead *slows* the
+fetch, the gray failure the hedged read mitigates), ``data.pack`` (a
+batch assembly dies before mutating packer state — retried),
+``data.stall`` (a shard read sleeps — prefer ``:delay=S``; the bare
+form sleeps the deprecated ``RAY_TPU_DATA_STALL_S`` alias).
+
+**Hedged reads** (r19): with ``RAY_TPU_DATA_HEDGE`` > 0, a shard read
+that outlives the hedge budget is re-issued to a standby reader and
+the first response wins.  Exactly-once needs no protocol: sources are
+pure (both responses are byte-identical) and only cursor advancement
+consumes a document — the loser's response is simply discarded.
 """
 
 from __future__ import annotations
@@ -228,23 +235,100 @@ class _DocSchedule:
     identically from a cursor."""
 
     def __init__(self, source: DocumentSource, cursor: StreamCursor, *,
-                 readers: int = 0, retries: int = 3, telemetry=None):
+                 readers: int = 0, retries: int = 3,
+                 hedge_s: Optional[float] = None, telemetry=None):
         self.source = source
         self.cursor = cursor
         self.retries = int(retries)
+        self.hedge_s = data_config().hedge_s if hedge_s is None \
+            else float(hedge_s)
         self.telemetry = telemetry
         self.reader_restarts = 0
+        # read_hedges counts hedges ISSUED; telemetry's
+        # record_read_hedge fires per hedge RESOLVED by a returning
+        # leg — an attempt where both legs fail is counted here but
+        # not there (it surfaces through the retry/restart counters)
+        self.read_hedges = 0
+        self.read_hedges_won = 0
         if readers > 0:
             self._readers = [_ActorReader(source) for _ in range(readers)]
         else:
             self._readers = [_InProcessReader(source)]
+        self._standby: Optional[_InProcessReader] = None
         self._buf: Dict[int, List] = {}      # shard -> [(start, docs)]
         self._buf_start: Dict[int, int] = {}
+
+    def _standby_reader(self, shard: int):
+        """The reader a hedge re-issues to: the next reader replica
+        when there is one, else a dedicated in-process reader over the
+        same pure source (identical bytes either way)."""
+        if len(self._readers) > 1:
+            return self._readers[(shard + 1) % len(self._readers)]
+        if self._standby is None:
+            self._standby = _InProcessReader(self.source)
+        return self._standby
+
+    @staticmethod
+    def _spawn_read(reader, shard: int, start: int, count: int,
+                    wake: threading.Event) -> dict:
+        """Run one read leg on a *daemon* thread (a leg parked in a
+        genuinely hung read must neither block interpreter exit nor
+        need a pool teardown the loader would have to own).  The box
+        gains ``docs`` or ``err``, written before ``wake`` fires."""
+        box: dict = {}
+
+        def run():
+            try:
+                box["docs"] = reader.read(shard, start, count)
+            except BaseException as e:  # noqa: BLE001 — leg lost
+                box["err"] = e
+            finally:
+                wake.set()
+
+        threading.Thread(target=run, name="data-read",
+                         daemon=True).start()
+        return box
+
+    def _hedged_read(self, reader, shard: int, start: int, count: int):
+        """One read attempt with a tail hedge: the primary runs on a
+        daemon thread; past ``hedge_s`` with no response, a standby
+        read races it and the first *successful* response wins.  The
+        loser's (identical, by purity) response is discarded; a leg
+        that errors just cedes the race, and only both legs failing
+        fails the attempt."""
+        wake = threading.Event()
+        pbox = self._spawn_read(reader, shard, start, count, wake)
+        wake.wait(self.hedge_s)
+        if "docs" in pbox:
+            return pbox["docs"]
+        if "err" in pbox:
+            raise pbox["err"]         # fast failure: the retry loop's
+        self.read_hedges += 1
+        sbox = self._spawn_read(self._standby_reader(shard), shard,
+                                start, count, wake)
+        while True:
+            wake.clear()
+            # primary checked first on a same-wake tie: "won" must
+            # mean the standby genuinely beat it (box writes happen
+            # before the wake, so a set flag means a decided leg)
+            for box, is_standby in ((pbox, False), (sbox, True)):
+                if "docs" in box:
+                    if is_standby:
+                        self.read_hedges_won += 1
+                    if self.telemetry is not None:
+                        self.telemetry.record_read_hedge(won=is_standby)
+                    return box["docs"]
+            if "err" in pbox and "err" in sbox:
+                raise sbox["err"]
+            wake.wait()
 
     def _fetch(self, shard: int, start: int, count: int):
         reader = self._readers[shard % len(self._readers)]
         for attempt in range(self.retries + 1):
             try:
+                if self.hedge_s > 0:
+                    return self._hedged_read(reader, shard, start,
+                                             count)
                 return reader.read(shard, start, count)
             except Exception as e:  # noqa: BLE001 — restart + re-issue
                 if attempt >= self.retries:
@@ -347,6 +431,7 @@ class StreamingLoader:
                  prefetch: Optional[int] = None,
                  readers: Optional[int] = None,
                  retries: Optional[int] = None,
+                 hedge_s: Optional[float] = None,
                  device_put: bool = True,
                  sharding=None,
                  cursor_capacity: int = CURSOR_CAPACITY,
@@ -389,7 +474,7 @@ class StreamingLoader:
             self._packer.load_state(cursor.packer)
         self._schedule = _DocSchedule(
             source, self._cursor, readers=readers, retries=self.retries,
-            telemetry=self.telemetry)
+            hedge_s=hedge_s, telemetry=self.telemetry)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         self._stop = threading.Event()
         self._staged: Optional[StreamBatch] = None

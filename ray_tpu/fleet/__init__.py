@@ -16,20 +16,30 @@ above it:
   ReplicaUnavailableError` only when retries exhaust).
 - :class:`~ray_tpu.fleet.reconciler.Reconciler` — an
   autoscaler-v2-style instance state machine (STARTING → RUNNING →
-  DRAINING → STOPPED / WEDGED → RESTARTING): watchdog-signalled
-  restarts with capped backoff, queue-depth / TTFT-SLO scale-up,
-  drain-based zero-dropped-streams scale-down, anti-flap dwell.
+  DRAINING → STOPPED / WEDGED → RESTARTING, plus the r19 gray-failure
+  arm RUNNING ⇄ DEGRADED → DRAINING): watchdog-signalled restarts
+  with capped backoff, dwell-gated drain-restart of chronically slow
+  replicas, queue-depth / TTFT-SLO scale-up, drain-based
+  zero-dropped-streams scale-down, anti-flap dwell.
+
+The router is also gray-failure tolerant (r19): per-replica EWMA
+tick-latency health scores penalize slow replicas in the pow-2 pick
+and demote outliers past ``RAY_TPU_FLEET_SLOW_FACTOR``x the fleet
+median, and over-deadline first-token waiters are **hedged** on a
+second replica (first responder wins, loser cancelled —
+``RAY_TPU_FLEET_HEDGE_*``).
 
 Recovery invariants are proven under deterministic ``RAY_TPU_FAULTS``
-plans (sites ``serve.replica`` / ``serve.route`` in
+plans (sites ``serve.replica`` / ``serve.route`` / ``serve.tick`` in
 :mod:`ray_tpu.util.chaos`).  Config via ``RAY_TPU_FLEET_*``
 (:func:`fleet_config`).
 """
 
 from ray_tpu.fleet.config import FleetConfig, fleet_config  # noqa: F401
-from ray_tpu.fleet.reconciler import (DRAINING, RESTARTING,  # noqa: F401
-                                      RUNNING, STARTING, STOPPED,
-                                      WEDGED, Instance, Reconciler)
+from ray_tpu.fleet.reconciler import (DEGRADED, DRAINING,  # noqa: F401
+                                      RESTARTING, RUNNING, STARTING,
+                                      STOPPED, WEDGED, Instance,
+                                      Reconciler)
 from ray_tpu.fleet.replica import EngineReplica  # noqa: F401
 from ray_tpu.fleet.router import (FleetRouter,  # noqa: F401
                                   FleetStream,
@@ -41,5 +51,5 @@ __all__ = [
     "ReplicaUnavailableError",
     "Reconciler", "Instance",
     "STARTING", "RUNNING", "DRAINING", "STOPPED", "WEDGED",
-    "RESTARTING",
+    "RESTARTING", "DEGRADED",
 ]

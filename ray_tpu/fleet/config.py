@@ -49,6 +49,24 @@ class FleetConfig:
       — a wedged/dead replica restarts after
       ``min(backoff * 2**restarts, backoff_max)`` seconds, so a
       crash-looping replica cannot hot-loop the factory.
+    - ``RAY_TPU_FLEET_SLOW_FACTOR`` (default ``3``, ``0`` = off): the
+      gray-failure demotion threshold — a replica whose EWMA tick
+      latency exceeds this multiple of the fleet median is excluded
+      from routing (soft demotion: when *every* replica is slow the
+      router still routes, a demotion must never be a dead-end) and
+      reported to the reconciler as DEGRADED.
+    - ``RAY_TPU_FLEET_HEDGE`` (default ``1``): tail-latency hedging —
+      a stream whose first token has not arrived by the hedge deadline
+      is re-admitted on a second replica; the first responder wins and
+      the loser is cancelled (at-most-once delivery is structural:
+      stream bindings are keyed ``(replica_id, rid)`` and the losing
+      binding drops before its token could land).
+    - ``RAY_TPU_FLEET_HEDGE_FACTOR`` (default ``2``): hedge deadline
+      as a multiple of the router's rolling p99 TTFT — informed by
+      observed tails, so healthy traffic almost never hedges.
+    - ``RAY_TPU_FLEET_HEDGE_MIN`` (default ``0.05``): hedge-deadline
+      floor in seconds (and the whole deadline until enough TTFT
+      samples exist) — a cold fleet must not hedge every request.
     """
     retries: int = 2
     affinity: bool = True
@@ -58,6 +76,10 @@ class FleetConfig:
     dwell: float = 5.0
     backoff: float = 0.5
     backoff_max: float = 30.0
+    slow_factor: float = 3.0
+    hedge: bool = True
+    hedge_factor: float = 2.0
+    hedge_min: float = 0.05
 
 
 _CONFIG: Optional[FleetConfig] = None
@@ -86,5 +108,9 @@ def fleet_config(refresh: bool = False) -> FleetConfig:
             dwell=nonneg("RAY_TPU_FLEET_DWELL", "5"),
             backoff=nonneg("RAY_TPU_FLEET_BACKOFF", "0.5"),
             backoff_max=nonneg("RAY_TPU_FLEET_BACKOFF_MAX", "30"),
+            slow_factor=nonneg("RAY_TPU_FLEET_SLOW_FACTOR", "3"),
+            hedge=env("RAY_TPU_FLEET_HEDGE", "1") != "0",
+            hedge_factor=nonneg("RAY_TPU_FLEET_HEDGE_FACTOR", "2"),
+            hedge_min=nonneg("RAY_TPU_FLEET_HEDGE_MIN", "0.05"),
         )
     return _CONFIG

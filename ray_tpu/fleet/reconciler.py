@@ -12,10 +12,21 @@ States::
 
     STARTING -> RUNNING -> DRAINING -> STOPPED
                    \\-> WEDGED -> RESTARTING -> RUNNING
+                   \\<-> DEGRADED -> DRAINING -> STOPPED (+replacement)
 
 - **WEDGED requires a health signal**: a replica only leaves RUNNING
   for WEDGED when it is dead (``alive`` False) or its r15 watchdog
-  wedge counter moved — a slow-but-ticking replica never restarts.
+  wedge counter moved — a slow-but-ticking replica never restarts
+  *immediately*.
+- **DEGRADED is the gray-failure arm** (r19): the router's latency
+  demotion signal (EWMA tick latency past
+  ``RAY_TPU_FLEET_SLOW_FACTOR``x the fleet median) moves a RUNNING
+  replica to DEGRADED.  A blip recovers to RUNNING; a demotion
+  sustained for the dwell triggers a **drain-restart**: the replica
+  drains (admission stops, in-flight streams finish — zero dropped)
+  while target restoration spawns its replacement, and the corpse
+  retires once drained.  A chronically slow replica is thus recycled
+  without ever being trusted to finish nothing.
 - **RESTARTING** replaces the corpse through the factory; replacement
   engines share the fleet's executable cache, so a restart costs
   construction, not XLA (the zero-steady-state-recompiles acceptance
@@ -48,6 +59,7 @@ DRAINING = "DRAINING"
 STOPPED = "STOPPED"
 WEDGED = "WEDGED"
 RESTARTING = "RESTARTING"
+DEGRADED = "DEGRADED"
 
 
 @dataclasses.dataclass
@@ -59,6 +71,7 @@ class Instance:
     restarts: int = 0
     wedges_seen: int = 0
     restart_at: float = 0.0      # backoff gate while WEDGED
+    degraded_since: float = 0.0  # dwell gate while DEGRADED
 
 
 class Reconciler:
@@ -87,6 +100,7 @@ class Reconciler:
             for r in router.replicas()}
         self._spawned = 0
         self.restarts_total = 0
+        self.demotion_restarts = 0   # gray-failure drain-restarts
         self._breach_since: Optional[float] = None
         self._idle_since: Optional[float] = None
         self._last_scale_ts = now
@@ -123,6 +137,10 @@ class Reconciler:
         transitions and scale decisions) for logs and tests."""
         now = time.monotonic() if now is None else now
         actions: List[str] = []
+        # the router's instantaneous latency verdict; the dwell below
+        # converts it into a decision (blip vs chronic)
+        slow = self.router.slow_replicas() \
+            if hasattr(self.router, "slow_replicas") else set()
 
         def move(rid, inst, state):
             actions.append(f"{rid}: {inst.state}->{state}")
@@ -142,6 +160,30 @@ class Reconciler:
                     inst.wedges_seen = r.wedges
                     inst.restart_at = now + self._backoff(inst.restarts)
                     move(rid, inst, WEDGED)
+                elif rid in slow:
+                    inst.degraded_since = now
+                    move(rid, inst, DEGRADED)
+            if inst.state == DEGRADED:
+                # gray turned black: death/wedge dominates slowness
+                if not r.alive or r.wedges > inst.wedges_seen:
+                    inst.wedges_seen = r.wedges
+                    inst.restart_at = now + self._backoff(inst.restarts)
+                    move(rid, inst, WEDGED)
+                elif rid not in slow:
+                    # a blip: the score recovered before the dwell —
+                    # re-promoted, nothing recycled
+                    move(rid, inst, RUNNING)
+                elif now - inst.degraded_since >= self.cfg.dwell:
+                    # chronically slow: drain-restart.  Admission
+                    # stops (the router re-routes), in-flight streams
+                    # finish (zero dropped), target restoration below
+                    # spawns the replacement this same pass, and the
+                    # DRAINING branch retires the corpse once drained.
+                    r.drain()
+                    self.demotion_restarts += 1
+                    self.router.telemetry.record_restart()
+                    move(rid, inst, DRAINING)
+                    actions[-1] += " (degraded drain-restart)"
             if inst.state == WEDGED and now >= inst.restart_at:
                 # replace the corpse: reap (slots/pages/refcounts
                 # release so the fleet audit stays clean), drop from
@@ -199,8 +241,12 @@ class Reconciler:
         sig = self._signals()
         # WEDGED counts as live: its 1:1 replacement is already
         # scheduled behind the backoff gate — spawning a restore on
-        # top would overshoot the target by one per wedge
-        live = self._count(STARTING, RUNNING, RESTARTING, WEDGED)
+        # top would overshoot the target by one per wedge.  DEGRADED
+        # counts too (it still serves); only its drain-restart drops
+        # it from this set, which is exactly what lets restoration
+        # spawn the replacement.
+        live = self._count(STARTING, RUNNING, RESTARTING, WEDGED,
+                           DEGRADED)
 
         # target restoration is failure recovery, not autoscaling: no
         # dwell gate — a killed replica's capacity comes back now

@@ -14,6 +14,12 @@ reconciler consume:
   chaos site fires at the top of :meth:`step`, before any engine
   mutation — an injected death leaves the engine state consistent for
   the host-side reap);
+- **latency**: an EWMA of tick wall seconds (the ``serve.tick`` /
+  ``serve.tick[<replica_id>]`` slowdown sites stretch exactly this
+  window, so an injected gray failure is visible to the same signal a
+  real one would be) — the router's health score: replicas past
+  ``RAY_TPU_FLEET_SLOW_FACTOR``x the fleet median are demoted from
+  routing and reported DEGRADED to the reconciler;
 - **wedged**: the r15 :class:`~ray_tpu.resilience.watchdog.
   EngineWatchdog` signal, probed manually by the router's poll loop
   (no background thread — deterministic under test clocks);
@@ -25,9 +31,29 @@ reconciler consume:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.inference.engine import InferenceEngine, StepEvent
+
+# EWMA smoothing for the tick-latency health score: new = a*x + (1-a)*old.
+# 0.25 converges on a sustained slowdown within ~8 ticks while a single
+# slow tick (GC pause, one long prefill) decays away instead of demoting
+# the replica — the blip-vs-sustained line the reconciler dwell also draws.
+LATENCY_EWMA_ALPHA = 0.25
+# An idle replica produces no fresh ticks, and demotion is exactly what
+# stops its traffic — without decay a demoted-then-idle replica's frozen
+# slow EWMA would keep it demoted forever and the reconciler's
+# blip-recovers-to-RUNNING arm could never fire for replicas without
+# continuous work.  Halving the score per 5 idle seconds lets a MILD
+# transient (a few x the fleet median) age back under the demotion
+# threshold and be re-probed by real traffic, while a severe outlier
+# stays demoted past the reconciler's dwell (default 5 s) and is
+# recycled — the severity of the score decides blip vs restart.  The
+# half-life must stay of the dwell's order: a fast decay flaps
+# demote/re-promote inside one routing episode (measured: it doubles
+# demotions and wastes hedges in the `bench.py --gray` scenario).
+LATENCY_IDLE_HALFLIFE_S = 5.0
 
 
 class EngineReplica:
@@ -67,6 +93,14 @@ class EngineReplica:
         # correctness one — admission re-walks the real index.)
         self._digest: Optional[frozenset] = None
         self._digest_ticks = -1
+        # EWMA tick wall seconds (None until the first worked tick) —
+        # the gray-failure health score.  _tick_t0 marks a step in
+        # flight (concurrent router mode): its age is a live lower
+        # bound on this tick's wall, so a sustained slowdown is
+        # scoreable BEFORE the first slow tick even completes.
+        self._latency_ewma: Optional[float] = None
+        self._tick_t0: Optional[float] = None
+        self._last_tick_done_ts = time.monotonic()
 
     # --------------------------------------------------------- admission
     def submit(self, prompt, *, max_new_tokens: int, sampling=None,
@@ -95,16 +129,31 @@ class EngineReplica:
         BEFORE the engine steps (donated buffers untouched, scheduler
         consistent) and any raise — injected or real — marks the
         replica dead before propagating, so the router's failover path
-        sees a consistent corpse."""
+        sees a consistent corpse.  The ``serve.tick`` slowdown sites
+        (fleet-wide, and ``serve.tick[<id>]`` addressing this replica
+        alone) stretch the timed window, so injected gray failure
+        lands in the same EWMA a genuinely slow device would."""
         from ray_tpu.util import chaos
         if self._stalled:
             return []                  # wedge: work pending, no tick
+        t0 = time.monotonic()
+        self._tick_t0 = t0
         try:
             chaos.maybe_fail("serve.replica")
-            return self.engine.step()
+            chaos.maybe_fail("serve.tick")
+            chaos.maybe_fail(f"serve.tick[{self.id}]")
+            events = self.engine.step()
         except BaseException:
             self.alive = False
             raise
+        finally:
+            self._tick_t0 = None
+            self._last_tick_done_ts = time.monotonic()
+        wall = time.monotonic() - t0
+        self._latency_ewma = wall if self._latency_ewma is None else (
+            LATENCY_EWMA_ALPHA * wall
+            + (1.0 - LATENCY_EWMA_ALPHA) * self._latency_ewma)
+        return events
 
     # ------------------------------------------------------------ health
     @property
@@ -136,6 +185,25 @@ class EngineReplica:
 
     def waiting_depth(self) -> int:
         return len(self.engine.scheduler.waiting)
+
+    def latency_score(self) -> float:
+        """EWMA tick wall seconds; 0.0 until the first worked tick
+        (an unmeasured replica is presumed healthy — a cold replica
+        must not start its life demoted).  A step in flight raises the
+        score to at least its age: a tick that has already run 0.4 s
+        *is* 0.4 s slow — demotion must not wait for it to finish
+        (benign cross-thread read: t0 is a monotonic stamp).  An
+        *idle* replica's score decays (``LATENCY_IDLE_HALFLIFE_S``):
+        stale slowness evidence must not demote forever."""
+        score = self._latency_ewma or 0.0
+        t0 = self._tick_t0
+        now = time.monotonic()
+        if t0 is not None:
+            return max(score, now - t0)
+        if score > 0.0 and not self.has_work():
+            score *= 0.5 ** ((now - self._last_tick_done_ts)
+                             / LATENCY_IDLE_HALFLIFE_S)
+        return score
 
     def prefix_digest(self) -> frozenset:
         ticks = self.engine.ticks
@@ -178,4 +246,5 @@ class EngineReplica:
         out["wedges"] = self.wedges
         out["last_wedge_ts"] = (self.watchdog.last_wedge_ts
                                 if self.watchdog is not None else None)
+        out["latency_score"] = self.latency_score()
         return out

@@ -18,6 +18,24 @@ the fleet-wide prefix cache.  Otherwise power-of-two-choices on queue
 depth (SURVEY: Serve's ``pow_2_scheduler.py``): sample two, take the
 shallower queue — near-least-loaded at O(1) probe cost.
 
+**Gray failure** (r19): binary health misses the replica that is slow
+without being dead — 10x tick latency still counts "alive", and tails
+are gated by the slowest participant (arXiv:2011.03641).  Three
+mitigations share one latency vocabulary: (1) every replica carries an
+EWMA tick-latency **health score**; the pow-2 comparison weighs queue
+depth by relative latency, and replicas past
+``RAY_TPU_FLEET_SLOW_FACTOR``x the fleet median are **demoted** —
+excluded from routing while any faster replica exists (soft: an
+all-slow fleet still routes) and surfaced via :meth:`FleetRouter.
+slow_replicas` for the reconciler's DEGRADED dwell.  (2) a stream
+whose first token misses the rolling-p99-informed **hedge deadline**
+(``RAY_TPU_FLEET_HEDGE_*``) is re-admitted on a second replica —
+first responder wins, the loser is cancelled; at-most-once delivery
+is preserved by the same ``(replica_id, rid)`` binding keys failover
+uses (the losing binding drops before its token could land).  (3) a
+hedged stream whose primary *dies* promotes the surviving binding
+instead of re-routing — the hedge was the failover.
+
 **Failover**: a replica death (``serve.replica`` chaos site, or any
 step raise) or a watchdog wedge mid-stream re-admits every bound
 request on a healthy replica — re-prefilling from the original prompt
@@ -36,7 +54,9 @@ running out of healthy replicas — surfaces a typed
 from __future__ import annotations
 
 import collections
+import queue
 import random
+import statistics
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -80,12 +100,18 @@ class FleetStream:
         # beside it; _cursor is how far the consumer has read
         self.generated: List[int] = []
         self.logprobs: List[float] = []
+        self.token_ts: List[float] = []   # per-token arrival stamps
         self._cursor = 0
         self.done = False
         self.error: Optional[BaseException] = None
         self.retries = 0                  # death/wedge failovers only
         self.replica_id: Optional[str] = None
         self.rid: Optional[int] = None
+        # tail-latency hedge: a second concurrent binding racing the
+        # primary for the first token (None when not hedged)
+        self.hedge_replica_id: Optional[str] = None
+        self.hedge_rid: Optional[int] = None
+        self.hedges = 0                   # hedges issued for this stream
 
     # ------------------------------------------------- router callbacks
     def _push(self, token: int, logprob: float) -> None:
@@ -97,12 +123,13 @@ class FleetStream:
                 f"stream got token {len(self.generated) + 1} of "
                 f"{self.max_new_tokens}: duplicate delivery after "
                 "failover")
+        now = time.monotonic()
         if self.first_token_ts is None:
-            self.first_token_ts = time.monotonic()
-            self._router._record_ttft(
-                self.first_token_ts - self.submitted_ts)
+            self.first_token_ts = now
+            self._router._record_ttft(now - self.submitted_ts)
         self.generated.append(int(token))
         self.logprobs.append(float(logprob))
+        self.token_ts.append(now)
 
     def _finish(self) -> None:
         self.done = True
@@ -154,6 +181,19 @@ class FleetRouter:
     hashes and re-admission lengths assume it — checked here).
     ``rng_seed`` pins the pow-2 sampling so routing distributions are
     reproducible in tests and benchmarks.
+
+    ``concurrent_steps``: step each replica on its own worker thread
+    (the engine already serves submit-vs-step concurrency — the
+    deployment pump's contract) instead of sequentially inside
+    :meth:`poll`.  Sequential is the default: every decision is
+    deterministic under a fault plan (the r16 acceptance-test
+    contract).  Concurrent exists because a *slowdown* cannot be
+    modeled sequentially — a straggling replica's tick would stall
+    the whole drive loop, taxing every replica equally, when the
+    point of gray-failure mitigation is that it must not
+    (``bench.py --gray`` and the r19 latency A/Bs run this mode;
+    event interleaving is timing-dependent there, so its tests assert
+    order-independent invariants).
     """
 
     _TTFT_WINDOW = 256
@@ -161,12 +201,20 @@ class FleetRouter:
     def __init__(self, replicas: List[EngineReplica], *,
                  cfg: Optional[FleetConfig] = None,
                  affinity: Optional[bool] = None,
-                 rng_seed: int = 0, telemetry=None):
+                 rng_seed: int = 0, telemetry=None,
+                 concurrent_steps: bool = False):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.cfg = cfg or fleet_config()
         self.affinity = (self.cfg.affinity if affinity is None
                          else bool(affinity))
+        self.concurrent_steps = bool(concurrent_steps)
+        # concurrent mode state: a worker pool stepping replicas, the
+        # completion queue workers report into, and the ids with a
+        # step in flight (never step one engine from two threads)
+        self._step_pool = None
+        self._step_results: Optional["queue.Queue"] = None
+        self._stepping: set = set()
         self._replicas: "collections.OrderedDict[str, EngineReplica]" \
             = collections.OrderedDict()
         self._rng = random.Random(rng_seed)
@@ -179,6 +227,11 @@ class FleetRouter:
             from ray_tpu.telemetry.fleet import FleetTelemetry
             telemetry = FleetTelemetry()
         self.telemetry = telemetry
+        # gray-failure health state, refreshed once per poll: the ids
+        # currently demoted (latency score past slow_factor x median)
+        # and the fleet median score backing the pow-2 penalty
+        self._demoted: set = set()
+        self._median_latency = 0.0
         self.page_size = replicas[0].engine.page_size
         self.buckets = replicas[0].engine.buckets
         for r in replicas:
@@ -226,6 +279,50 @@ class FleetRouter:
         return [r for r in self._replicas.values()
                 if r.alive and not r.draining and not r.wedged]
 
+    # ---------------------------------------------------- health scoring
+    def _update_health(self) -> None:
+        """Refresh the demoted set from live latency scores (once per
+        poll).  A replica is demoted while its EWMA tick latency
+        exceeds ``slow_factor`` x the fleet median score; uniform
+        slowness moves the median with it, so a fleet that is *all*
+        slow (shared cause: thermal throttle, noisy host) demotes
+        nobody — demotion is for the outlier, the gray failure."""
+        factor = self.cfg.slow_factor
+        newly: set = set()
+        med = 0.0
+        if factor > 0:
+            scored = [(r.id, r.latency_score()) for r in self.healthy()]
+            scores = [s for _, s in scored if s > 0]
+            if len(scores) >= 2:
+                # median_low: an even fleet takes the lower middle, so
+                # one outlier in a 2-replica fleet still stands out
+                # against the healthy score instead of their average
+                med = statistics.median_low(scores)
+                if med > 0:
+                    newly = {rid for rid, s in scored
+                             if s > factor * med}
+        for rid in sorted(newly - self._demoted):
+            self.telemetry.record_demotion(rid)
+        self._demoted = newly
+        self._median_latency = med
+
+    def slow_replicas(self) -> set:
+        """Ids currently demoted for latency (the reconciler's
+        DEGRADED signal — dwell-gating is the reconciler's job; this
+        is the instantaneous verdict)."""
+        return set(self._demoted)
+
+    def _effective_load(self, r: EngineReplica) -> float:
+        """Queue depth weighted by relative latency: the pow-2 signal.
+        ``depth + 1`` so an idle-but-slow replica still loses to an
+        idle fast one; the latency ratio only ever penalizes (a
+        faster-than-median replica is not rewarded — depth stays the
+        primary balance signal)."""
+        med = self._median_latency
+        score = r.latency_score()
+        rel = score / med if (med > 0 and score > 0) else 1.0
+        return (r.queue_depth() + 1) * max(rel, 1.0)
+
     # --------------------------------------------------------- routing
     def remote(self, payload: Dict[str, Any]) -> FleetStream:
         """Route one request (the ``GPTDeployment`` payload dict) and
@@ -272,7 +369,8 @@ class FleetRouter:
         if len(cands) == 1:
             return cands[0]
         a, b = self._rng.sample(cands, 2)
-        return a if a.queue_depth() <= b.queue_depth() else b
+        return a if self._effective_load(a) <= self._effective_load(b) \
+            else b
 
     def _route(self, stream: FleetStream) -> None:
         """Pick a replica and submit; draining/queue-full/route-fault
@@ -307,6 +405,12 @@ class FleetRouter:
                     f"{len(excluded)} rejected this attempt, "
                     f"{stream.retries} failover(s) used)",
                     retries=stream.retries)
+            # gray-failure demotion: route past latency-demoted
+            # replicas while any faster one exists — but an all-slow
+            # candidate set still routes (soft demotion, never a
+            # dead-end)
+            fast = [r for r in cands if r.id not in self._demoted]
+            cands = fast or cands
             replica = None
             if self.affinity:
                 replica = self._affinity_pick(prompt, cands)
@@ -347,12 +451,166 @@ class FleetRouter:
             self._by_rid[(replica.id, rid)] = stream
             return
 
+    # --------------------------------------------------------- hedging
+    def hedge_deadline_s(self) -> float:
+        """How long a stream may wait for its first token before the
+        router races a second replica: ``hedge_factor`` x the rolling
+        p99 TTFT once enough samples exist, floored at ``hedge_min``
+        (which is also the whole deadline on a cold fleet — a fleet
+        with no latency history must not hedge everything)."""
+        if len(self._ttfts) >= 16:
+            srt = sorted(self._ttfts)
+            p99 = srt[min(len(srt) - 1, int(0.99 * len(srt)))]
+            return max(self.cfg.hedge_min,
+                       self.cfg.hedge_factor * p99)
+        return self.cfg.hedge_min
+
+    def _maybe_hedge(self) -> None:
+        """Re-admit over-deadline first-token waiters on a second
+        replica.  The hedge races the primary: both bindings map to
+        the stream, the first token resolves the race and cancels the
+        loser — delivery stays at-most-once because exactly one
+        binding survives to push tokens.
+
+        Two gates keep hedging from amplifying load (the Tail-at-Scale
+        failure mode: a saturated fleet hedging itself deeper into
+        saturation): the stream must be past the p99-informed
+        deadline, AND the hedge target must have **spare capacity
+        now** (an empty waiting queue) — a stream that is slow because
+        the whole fleet is queued gains nothing from one more queue
+        slot, only the stream stuck behind a *relatively* slow replica
+        does.  Capacity is observable before the straggler's first
+        slow tick even completes, so the gate protects a cold fleet
+        without blinding the hedge exactly when it is needed."""
+        now = time.monotonic()
+        deadline = self.hedge_deadline_s()
+        for stream in list(dict.fromkeys(self._by_rid.values())):
+            # hedges > 0: a stream races at most ONE hedge in its
+            # lifetime.  Without the cap, a leg whose TTFT deadline
+            # expires is absorbed by the partner and the stream
+            # re-hedges next poll — an unmeetable deadline would spin
+            # fresh admissions forever (each restarts the engine-side
+            # deadline clock) instead of surfacing the typed error.
+            if (stream.done or stream.first_token_ts is not None
+                    or stream.hedge_rid is not None
+                    or stream.hedges > 0
+                    or stream.replica_id is None
+                    or now - stream.submitted_ts < deadline):
+                continue
+            self._submit_hedge(stream)
+
+    def _submit_hedge(self, stream: FleetStream) -> None:
+        from ray_tpu.inference.serve_gpt import ReplicaDrainingError
+        cands = [r for r in self.healthy()
+                 if r.id != stream.replica_id]
+        # fastest-first, demoted last: the hedge exists to dodge the
+        # slow replica — racing it against another slow one is waste
+        cands.sort(key=lambda r: (r.id in self._demoted,
+                                  self._effective_load(r)))
+        if not cands or cands[0].waiting_depth() > 0:
+            return      # no spare capacity anywhere: don't amplify
+        for replica in cands:
+            if replica.waiting_depth() > 0:
+                # the capacity gate holds per candidate, not just for
+                # the best one: a rejected submit must not fall
+                # through to a queued replica — that's the exact load
+                # amplification the gate exists to prevent
+                continue
+            try:
+                rid = replica.submit(
+                    stream.prompt,
+                    max_new_tokens=stream.max_new_tokens,
+                    sampling=stream.sampling,
+                    eos_token=stream.eos_token,
+                    ttft_deadline_s=stream.ttft_deadline_s,
+                    deadline_s=stream.deadline_s)
+            except (ReplicaDrainingError, QueueFullError, ValueError):
+                continue              # best-effort: primary still runs
+            stream.hedge_replica_id, stream.hedge_rid = replica.id, rid
+            stream.hedges += 1
+            self._by_rid[(replica.id, rid)] = stream
+            self.telemetry.record_hedge("issued")
+            return
+
+    def _other_binding(self, stream: FleetStream,
+                       key: Tuple[str, int]) -> Optional[Tuple[str, int]]:
+        """The stream's still-bound race partner of ``key`` (None when
+        the stream is not hedged or the partner is already unbound)."""
+        if stream.hedge_rid is None:
+            return None
+        primary = (stream.replica_id, stream.rid)
+        hedge = (stream.hedge_replica_id, stream.hedge_rid)
+        other = hedge if key == primary else (
+            primary if key == hedge else None)
+        return other if other is not None and other in self._by_rid \
+            else None
+
+    def _resolve_hedge(self, stream: FleetStream,
+                       winner: Tuple[str, int],
+                       loser: Optional[Tuple[str, int]]) -> None:
+        """Settle a hedge race: the winning binding becomes the
+        stream's one binding; the loser (if still bound) is unbound
+        and cancelled engine-side so its slot/pages/prefix refs free
+        within a tick."""
+        hedge_won = winner == (stream.hedge_replica_id,
+                               stream.hedge_rid)
+        if loser is not None:
+            self._by_rid.pop(loser, None)
+            rep = self._replicas.get(loser[0])
+            if rep is not None and rep.alive:
+                rep.engine.cancel(loser[1])
+        stream.replica_id, stream.rid = winner
+        stream.hedge_replica_id = stream.hedge_rid = None
+        self.telemetry.record_hedge("won" if hedge_won else "wasted")
+
     # ------------------------------------------------------- tick loop
+    def quiesce(self, timeout_s: float = 5.0) -> bool:
+        """Poll until no step is in flight and no replica holds work
+        (True when settled).  Post-run audits need this in
+        ``concurrent_steps`` mode: a cancelled hedge loser's tick may
+        still be sleeping in a worker when the last stream finishes,
+        and ``leak_free`` must not read an engine mid-step."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.poll()
+            if not self._stepping and not any(
+                    r.alive and r.has_work()
+                    for r in self._replicas.values()):
+                return True
+            time.sleep(0.002)
+        return False
+
+    def close(self) -> None:
+        """Release the concurrent-mode step pool (idempotent; a no-op
+        for sequential routers).  Worker threads are only created by
+        ``concurrent_steps`` polling — a dropped router would
+        otherwise park them until GC/interpreter exit."""
+        pool, self._step_pool = self._step_pool, None
+        # _stepping is NOT cleared: shutdown(wait=False) leaves already-
+        # running steps running, and a poll() after close() (a consumer
+        # draining a leftover stream) must still see their replicas as
+        # in flight — clearing would let it double-step an engine.  No
+        # id can be stranded either: the pool holds >= one worker per
+        # replica, so every submitted step runs (cancel_futures never
+        # finds a queued one) and its completion drain discards the id.
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
     def poll(self) -> bool:
-        """One fleet tick: probe watchdogs, step every live replica
+        """One fleet tick: refresh health scores, hedge over-deadline
+        first-token waiters, probe watchdogs, step every live replica
         with work, dispatch events, fail streams over from dead or
         wedged replicas.  Returns whether any replica made progress
         (consumers back off briefly when none did)."""
+        self._update_health()
+        if self.cfg.hedge:
+            self._maybe_hedge()
+        progressed = (self._poll_concurrent() if self.concurrent_steps
+                      else self._poll_sequential())
+        self._record_depths()
+        return progressed
+
+    def _poll_sequential(self) -> bool:
         progressed = False
         for replica in list(self._replicas.values()):
             if not replica.alive:
@@ -372,7 +630,64 @@ class FleetRouter:
             progressed = progressed or bool(events)
             for ev in events:
                 self._dispatch(replica, ev)
-        self._record_depths()
+        return progressed
+
+    def _poll_concurrent(self) -> bool:
+        """Concurrent-mode tick: launch one worker-thread step per
+        idle replica with work (the engine's submit-vs-step lock makes
+        main-thread admissions safe against it), then drain whatever
+        steps have completed and dispatch their events here on the
+        poll thread — all stream/binding state stays single-threaded.
+        A straggling replica's slow tick occupies only its own worker;
+        the fleet keeps polling at the healthy replicas' pace (the
+        whole point of the mode — see the class docstring)."""
+        if self._step_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._step_pool = ThreadPoolExecutor(
+                max_workers=max(4, len(self._replicas) + 2),
+                thread_name_prefix="fleet-step")
+            self._step_results = queue.Queue()
+
+        def run_step(rep: EngineReplica) -> None:
+            try:
+                self._step_results.put((rep, rep.step(), None))
+            except BaseException as e:  # noqa: BLE001 — death IS the event
+                self._step_results.put((rep, None, e))
+
+        for replica in list(self._replicas.values()):
+            in_flight = replica.id in self._stepping
+            if not replica.alive:
+                # an in-flight step's death report arrives via the
+                # completion queue; handling it here too would be fine
+                # (idempotent) but noisy
+                if not in_flight:
+                    self._on_replica_down(replica, reap=True)
+                continue
+            replica.check()
+            if replica.wedged:
+                # a hung in-flight step IS the wedge: re-home the
+                # streams now — a late completion's events drop on the
+                # stale (replica_id, rid) bindings, the r16 invariant
+                self._on_replica_down(replica, reap=False)
+                continue
+            if in_flight or not replica.has_work():
+                continue
+            self._stepping.add(replica.id)
+            self._step_pool.submit(run_step, replica)
+
+        progressed = False
+        while True:
+            try:
+                replica, events, err = self._step_results.get_nowait()
+            except queue.Empty:
+                break
+            self._stepping.discard(replica.id)
+            if err is not None:
+                self._on_replica_down(replica, reap=True)
+                continue
+            progressed = progressed or bool(events)
+            for ev in events:
+                self._dispatch(replica, ev)
         return progressed
 
     def _dispatch(self, replica: EngineReplica, ev) -> None:
@@ -382,11 +697,24 @@ class FleetRouter:
         if stream is None:
             return                       # cancelled/stale binding
         if ev.error is not None:
+            del self._by_rid[key]
+            other = self._other_binding(stream, key)
+            if other is not None:
+                # one leg of a hedge race expired (e.g. its TTFT
+                # deadline): the partner is still decoding — let it
+                # carry the stream instead of surfacing the error
+                self._resolve_hedge(stream, winner=other, loser=None)
+                return
             # deadline expiry: policy shed the request (everything
             # already released engine-side) — typed error, no failover
-            del self._by_rid[key]
             stream._fail(ev.error)
             return
+        if stream.first_token_ts is None and stream.hedge_rid is not None:
+            # the first token resolves the hedge race: this binding
+            # wins, the other is unbound BEFORE any of its tokens
+            # could land (at-most-once stays structural)
+            self._resolve_hedge(stream, winner=key,
+                                loser=self._other_binding(stream, key))
         stream._push(token, ev.logprob)
         if done:
             del self._by_rid[key]
@@ -406,6 +734,13 @@ class FleetRouter:
             del self._by_rid[key]
             if replica.alive:
                 replica.engine.cancel(key[1])
+            other = self._other_binding(stream, key)
+            if other is not None:
+                # a hedged stream lost one leg to the death/wedge: the
+                # surviving binding IS the failover — promote it, no
+                # re-route ("won" when the hedge saved the stream)
+                self._resolve_hedge(stream, winner=other, loser=None)
+                continue
             self._failover(stream)
         if reap and not replica.alive and not replica.reaped:
             replica.reap()
@@ -427,11 +762,16 @@ class FleetRouter:
     def _cancel_stream(self, stream: FleetStream) -> None:
         if stream.replica_id is None or stream.done:
             return
-        key = (stream.replica_id, stream.rid)
-        self._by_rid.pop(key, None)
-        replica = self._replicas.get(stream.replica_id)
-        if replica is not None and replica.alive:
-            replica.engine.cancel(stream.rid)
+        for rep_id, rid in ((stream.replica_id, stream.rid),
+                            (stream.hedge_replica_id,
+                             stream.hedge_rid)):
+            if rid is None:
+                continue
+            self._by_rid.pop((rep_id, rid), None)
+            replica = self._replicas.get(rep_id)
+            if replica is not None and replica.alive:
+                replica.engine.cancel(rid)
+        stream.hedge_replica_id = stream.hedge_rid = None
         stream._finish()
 
     # ------------------------------------------------------ observability
@@ -447,6 +787,8 @@ class FleetRouter:
         for r in self._replicas.values():
             if r.alive:
                 self.telemetry.record_queue_depth(r.id, r.queue_depth())
+                self.telemetry.record_latency_score(
+                    r.id, r.latency_score())
 
     def leak_free(self) -> bool:
         """Fleet-wide invariant: no slot/page/refcount held anywhere
@@ -458,8 +800,11 @@ class FleetRouter:
             "replicas": {r.id: {"alive": r.alive,
                                 "draining": r.draining,
                                 "wedged": r.wedged,
-                                "queue_depth": r.queue_depth()}
+                                "queue_depth": r.queue_depth(),
+                                "latency_score": r.latency_score(),
+                                "demoted": r.id in self._demoted}
                          for r in self._replicas.values()},
             "in_flight": len(self._by_rid),
             "affinity": self.affinity,
+            "hedge_deadline_s": self.hedge_deadline_s(),
         }

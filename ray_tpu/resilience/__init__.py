@@ -51,6 +51,7 @@ from ray_tpu.resilience.elastic import (ElasticError,  # noqa: F401
                                         ReshardError,
                                         reshard_state,
                                         run_elastic_train_loop)
+from ray_tpu.resilience.straggler import StragglerSupervisor  # noqa: F401
 from ray_tpu.resilience.supervisor import run_supervised_rl_loop  # noqa: F401
 from ray_tpu.resilience.watchdog import EngineWatchdog  # noqa: F401
 
@@ -61,5 +62,6 @@ __all__ = [
     "run_supervised_rl_loop",
     "ElasticError", "MeshMismatchError", "ReshardError",
     "reshard_state", "run_elastic_train_loop",
+    "StragglerSupervisor",
     "EngineWatchdog",
 ]

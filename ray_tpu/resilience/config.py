@@ -36,12 +36,24 @@ class ResilienceConfig:
       loss gets a final host snapshot (the TPU eviction-notice model:
       zero lost steps) or must restore from the latest retained
       checkpoint (hard preemption: lost work bounded by the cadence).
+    - ``RAY_TPU_STRAGGLER_FACTOR`` (default ``0`` = off): straggler
+      threshold — a train step slower than this multiple of the
+      rolling-median baseline counts as slow
+      (:class:`~ray_tpu.resilience.straggler.StragglerSupervisor`).
+    - ``RAY_TPU_STRAGGLER_DWELL`` (default ``3``): consecutive slow
+      steps before a straggle event fires — a cold compile or one GC
+      pause is a blip, never a shrink.
+    - ``RAY_TPU_STRAGGLER_WINDOW`` (default ``16``): rolling-baseline
+      window in accepted (non-slow) step samples.
     """
     ckpt_every: int = 0
     ckpt_dir: Optional[str] = None
     ckpt_keep: int = 3
     elastic_min_devices: int = 1
     elastic_graceful: bool = True
+    straggler_factor: float = 0.0
+    straggler_dwell: int = 3
+    straggler_window: int = 16
 
 
 _CONFIG: Optional[ResilienceConfig] = None
@@ -68,6 +80,22 @@ def resilience_config(refresh: bool = False) -> ResilienceConfig:
             print(f"RAY_TPU_ELASTIC_MIN_DEVICES={min_dev} must be "
                   ">= 1; using 1", file=sys.stderr)
             min_dev = 1
+        factor = float(env("RAY_TPU_STRAGGLER_FACTOR", "0"))
+        if factor < 0:
+            print(f"RAY_TPU_STRAGGLER_FACTOR={factor} negative; "
+                  "using 0 (straggler detection off)", file=sys.stderr)
+            factor = 0.0
+        dwell = int(env("RAY_TPU_STRAGGLER_DWELL", "3"))
+        if dwell < 1:
+            print(f"RAY_TPU_STRAGGLER_DWELL={dwell} must be >= 1; "
+                  "using 1", file=sys.stderr)
+            dwell = 1
+        window = int(env("RAY_TPU_STRAGGLER_WINDOW", "16"))
+        if window < 3:
+            print(f"RAY_TPU_STRAGGLER_WINDOW={window} must be >= 3 "
+                  "(the baseline is a median); using 3",
+                  file=sys.stderr)
+            window = 3
         _CONFIG = ResilienceConfig(
             ckpt_every=every,
             ckpt_dir=env("RAY_TPU_CKPT_DIR") or None,
@@ -75,5 +103,8 @@ def resilience_config(refresh: bool = False) -> ResilienceConfig:
             elastic_min_devices=min_dev,
             elastic_graceful=env("RAY_TPU_ELASTIC_GRACEFUL", "1")
             not in ("0", "false", "False"),
+            straggler_factor=factor,
+            straggler_dwell=dwell,
+            straggler_window=window,
         )
     return _CONFIG

@@ -188,6 +188,7 @@ def run_elastic_train_loop(cfg, *, steps: int,
                            graceful: Optional[bool] = None,
                            min_devices: Optional[int] = None,
                            telemetry: Optional[bool] = None,
+                           straggler=None,
                            on_step: Optional[Callable[[int], None]] = None,
                            topologies: Optional[Dict[int, Dict[str, Any]]]
                            = None) -> Dict[str, Any]:
@@ -209,6 +210,20 @@ def run_elastic_train_loop(cfg, *, steps: int,
       training.
     - ``mesh.restore`` — capacity returned: same dance back to the
       full mesh, accumulation scaled back down.
+    - ``mesh.step`` — gray failure (r19): a ``:delay=S`` window
+      stretches the step wall (a straggling host gates the
+      synchronous step).  Nothing is lost — but the run is paying the
+      straggler's pace.  With a straggler supervisor armed
+      (``straggler=True`` / a :class:`~ray_tpu.resilience.straggler.
+      StragglerSupervisor` / ``RAY_TPU_STRAGGLER_FACTOR`` > 0), a
+      sustained straggle is converted into the same graceful
+      shrink a ``mesh.loss`` takes (snapshot -> rebuild at the
+      degraded size with the global batch unchanged -> reshard), so
+      the run trades the straggler's capacity for its speed;
+      expansion still rides ``mesh.restore``.  A straggle already at
+      the ``min_devices`` floor is logged and ridden out — unlike a
+      declared device loss, the state is intact, so training on is
+      correct (just slow).
 
     Every batch is a pure function of ``(seed, cursor)`` (the
     ``run_train_ckpt_loop`` contract), so the returned
@@ -250,6 +265,17 @@ def run_elastic_train_loop(cfg, *, steps: int,
     tel = ElasticTelemetry(config=tel_config)
     tx = optimizer or training.default_optimizer()
 
+    from ray_tpu.resilience.straggler import StragglerSupervisor
+    if isinstance(straggler, StragglerSupervisor):
+        watch = straggler
+    elif straggler is None:
+        watch = StragglerSupervisor()      # env-armed (factor 0 = off)
+    elif straggler:
+        sfactor = rcfg.straggler_factor or 3.0
+        watch = StragglerSupervisor(factor=sfactor)
+    else:
+        watch = StragglerSupervisor(factor=0.0)
+
     if topologies is None:
         topologies = {}
     builds: List[int] = []
@@ -285,14 +311,15 @@ def run_elastic_train_loop(cfg, *, steps: int,
     losses: List[float] = []
     batch_cursors: List[int] = []
     transitions: List[Dict[str, Any]] = []
+    straggler_events: List[int] = []
 
-    def transition(kind: str, target: int):
+    def transition(kind: str, target: int, cause: str = "fault"):
         nonlocal state, topo, cursor
         src = topo["n"]
         if target == src:
             return                          # already there: no-op
         t0 = time.monotonic()
-        if kind == "shrink" and not graceful:
+        if kind == "shrink" and not graceful and cause != "straggler":
             if ckpt is None:
                 raise ElasticError(
                     "hard mesh loss (graceful=False) needs a "
@@ -321,8 +348,12 @@ def run_elastic_train_loop(cfg, *, steps: int,
         topo = new
         transitions.append({"kind": kind, "step": cursor,
                             "from": src, "to": target,
+                            "cause": cause,
                             "reshard_s": round(dt, 6)})
         tel.record_transition(kind, dt, n_devices=target)
+        # the new topology has a new normal step wall: a straggler
+        # baseline carried across it would misfire
+        watch.reset()
 
     while cursor < steps:
         if chaos.should_fire("mesh.loss"):
@@ -349,9 +380,29 @@ def run_elastic_train_loop(cfg, *, steps: int,
             jax.random.fold_in(data_key, cursor), batch_size, seq_len,
             cfg.vocab_size)
         batch_cursors.append(cursor)
+        t_step = time.monotonic()
+        # the mesh.step slowdown site stretches exactly the window the
+        # straggler supervisor watches — an injected gray failure is
+        # indistinguishable from a genuinely straggling host
+        chaos.maybe_fail("mesh.step")
         state, metrics = topo["fns"]["step_fn"](state, batch)
-        losses.append(float(metrics["loss"]))
+        losses.append(float(metrics["loss"]))   # blocks: the wall is real
+        step_wall = time.monotonic() - t_step
         cursor += 1
+        if watch.observe(step_wall):
+            straggler_events.append(cursor - 1)
+            tel.record_straggler()
+            target = (_shrink_target(topo["n"], min_devices)
+                      if degraded_devices >= topo["n"]
+                      else degraded_devices)
+            if target < topo["n"]:
+                # degraded-mesh event via the r18 machinery: ALWAYS a
+                # graceful snapshot — unlike a declared loss, the
+                # state is intact, the straggler just taxes it
+                transition("shrink", target, cause="straggler")
+            # at the min_devices floor there is nothing to shed:
+            # intact state, so training on (slow) is correct — the
+            # event is still counted for the operator
         if ckpt is not None:
             ckpt.maybe_save(state, step=cursor,
                             extras={"data_cursor": cursor},
@@ -370,6 +421,7 @@ def run_elastic_train_loop(cfg, *, steps: int,
         "losses": losses,
         "batch_cursors": batch_cursors,
         "transitions": transitions,
+        "straggler_events": straggler_events,
         "builds": builds,
         "compile_counts": compile_counts,
         "final_step": int(np.asarray(state.step)),

@@ -39,6 +39,8 @@ class DataTelemetry:
         self.stalls = 0
         self.reader_restarts = 0
         self.pack_retries = 0
+        self.read_hedges = 0
+        self.read_hedges_won = 0
         self._depth_sum = 0
         self._metrics = None
         self._metrics_dead = False
@@ -79,6 +81,16 @@ class DataTelemetry:
         if self.enabled:
             self.pack_retries += 1
 
+    def record_read_hedge(self, *, won: bool) -> None:
+        """A shard read outlived its hedge budget and a standby read
+        was raced against it (r19); ``won`` when the standby's
+        response was the one used."""
+        if not self.enabled:
+            return
+        self.read_hedges += 1
+        if won:
+            self.read_hedges_won += 1
+
     # ---------------------------------------------------------- summary
     def input_tok_s(self) -> float:
         return (self.input_tokens / self.producer_wall_s
@@ -97,6 +109,8 @@ class DataTelemetry:
             "stall_s_max": round(self.stall_s_max, 6),
             "reader_restarts": self.reader_restarts,
             "pack_retries": self.pack_retries,
+            "read_hedges": self.read_hedges,
+            "read_hedges_won": self.read_hedges_won,
         }
         if self.batches:
             out["prefetch_depth_mean"] = round(

@@ -34,6 +34,7 @@ class ElasticTelemetry:
         self.mesh_devices = 0
         self.transitions: Dict[str, int] = {}
         self.reshards: List[float] = []
+        self.straggler_events = 0
         self._metrics = None
         self._metrics_dead = False
 
@@ -61,6 +62,15 @@ class ElasticTelemetry:
         self.mesh_devices = int(n_devices)
         self._emit("transition", kind=kind, reshard_s=reshard_s)
 
+    def record_straggler(self) -> None:
+        """One sustained-straggle event from the straggler supervisor
+        (the r19 gray-failure counter — fires whether or not the loop
+        could shrink in response)."""
+        if not self.enabled:
+            return
+        self.straggler_events += 1
+        self._emit("straggler")
+
     # ---------------------------------------------------------- summary
     def summary(self) -> Dict[str, Any]:
         """The ``elastic`` block for driver JSON."""
@@ -71,6 +81,7 @@ class ElasticTelemetry:
             "mesh_devices": self.mesh_devices,
             "transitions": dict(self.transitions),
             "transitions_total": sum(self.transitions.values()),
+            "straggler_events": self.straggler_events,
         }
         if self.reshards:
             out["reshard_s"] = statistics.median(self.reshards)
@@ -99,6 +110,11 @@ class ElasticTelemetry:
                     "elastic mesh transitions, split by kind "
                     "(shrink/expand)",
                     tag_keys=tags + ("kind",)),
+                "stragglers": Counter(
+                    "train_straggler_events_total",
+                    "sustained train-step straggles detected by the "
+                    "straggler supervisor",
+                    tag_keys=tags),
             }
         return self._metrics
 
@@ -116,5 +132,7 @@ class ElasticTelemetry:
                 metrics["reshard"].observe(reshard_s, tags=tags)
                 metrics["transitions"].inc(
                     1.0, tags={**tags, "kind": kind})
+            elif what == "straggler":
+                metrics["stragglers"].inc(1.0, tags=tags)
         except Exception:  # noqa: BLE001 — never tax the train loop
             self._metrics_dead = True

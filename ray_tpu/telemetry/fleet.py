@@ -3,10 +3,14 @@
 The fourth recorder family, beside train/infer/RL: the fleet router
 and reconciler record every retry (split by cause — a dead replica, a
 draining one, a full queue), every replica restart, per-replica queue
-depth, and the prefix-affinity routing hit rate.  Sinks mirror r09:
-Prometheus through the control plane when a session is up
-(``serve_router_retries_total`` / ``serve_replica_restarts_total``
-counters, ``serve_replica_queue_depth`` /
+depth, and the prefix-affinity routing hit rate.  r19 adds the
+gray-failure series: every hedge split by outcome (``issued`` /
+``won`` / ``wasted``), every latency demotion, and the per-replica
+EWMA latency score.  Sinks mirror r09: Prometheus through the control
+plane when a session is up (``serve_router_retries_total`` /
+``serve_replica_restarts_total`` / ``serve_hedges_total`` /
+``serve_replica_demotions_total`` counters,
+``serve_replica_queue_depth`` / ``serve_replica_latency_score`` /
 ``serve_fleet_affinity_hit_rate`` gauges), and :meth:`summary` as the
 ``fleet`` block of ``bench.py --infer --replicas N`` JSON.
 
@@ -37,9 +41,16 @@ class FleetTelemetry:
         self.affinity_routed = 0
         self.affinity_decisions = 0
         self.queue_depths: Dict[str, int] = {}
+        # outcome -> count; outcomes: "issued" (hedge submitted),
+        # "won" (the hedge delivered the stream), "wasted" (the
+        # primary did — the hedge's work was thrown away)
+        self.hedges: Dict[str, int] = {}
+        self.replica_demotions = 0
+        self.latency_scores: Dict[str, float] = {}
         self._metrics = None
         self._metrics_dead = False
         self._depth_last: Dict[str, float] = {}
+        self._latency_last: Dict[str, float] = {}
         self._rate_last = 0.0
 
     # ---------------------------------------------------------- records
@@ -59,6 +70,44 @@ class FleetTelemetry:
             return
         self.replica_restarts += 1
         self._emit_restart()
+
+    def record_hedge(self, outcome: str) -> None:
+        """One hedge event: ``issued`` when the router races a second
+        replica for an over-deadline first token, then exactly one of
+        ``won`` (the hedge carried the stream) / ``wasted`` (the
+        primary did) when the race resolves."""
+        if outcome not in ("issued", "won", "wasted"):
+            raise ValueError(f"unknown hedge outcome {outcome!r}; "
+                             "expected issued/won/wasted")
+        if not self.enabled:
+            return
+        self.hedges[outcome] = self.hedges.get(outcome, 0) + 1
+        self._emit_hedge(outcome)
+
+    def record_demotion(self, replica_id: str) -> None:
+        """The router demoted a replica for latency (its EWMA tick
+        latency crossed slow_factor x the fleet median) — counted once
+        per demotion episode, not per routing decision."""
+        if not self.enabled:
+            return
+        self.replica_demotions += 1
+        self._emit_demotion(replica_id)
+
+    def record_latency_score(self, replica_id: str,
+                             score: float) -> None:
+        """Per-replica EWMA tick-latency gauge (throttled per replica
+        — the router records every poll)."""
+        if not self.enabled:
+            return
+        self.latency_scores[replica_id] = float(score)
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        if now - self._latency_last.get(replica_id, 0.0) \
+                < self._EMIT_INTERVAL_S:
+            return
+        self._latency_last[replica_id] = now
+        self._emit_latency(replica_id, score)
 
     def record_affinity(self, *, hit: bool) -> None:
         """One routing decision with affinity enabled: ``hit`` when a
@@ -90,6 +139,8 @@ class FleetTelemetry:
         """Drop a stopped replica's gauge state."""
         self.queue_depths.pop(replica_id, None)
         self._depth_last.pop(replica_id, None)
+        self.latency_scores.pop(replica_id, None)
+        self._latency_last.pop(replica_id, None)
 
     # ---------------------------------------------------------- summary
     @property
@@ -111,6 +162,9 @@ class FleetTelemetry:
             "affinity_routed": self.affinity_routed,
             "affinity_hit_rate": self.affinity_hit_rate,
             "replica_queue_depth": dict(self.queue_depths),
+            "hedges": dict(self.hedges),
+            "replica_demotions": self.replica_demotions,
+            "replica_latency_score": dict(self.latency_scores),
         }
 
     # ------------------------------------------------------- prometheus
@@ -139,8 +193,56 @@ class FleetTelemetry:
                     "share of routing decisions won by a prefix-"
                     "affinity digest match",
                     tag_keys=("label",)),
+                "hedges": Counter(
+                    "serve_hedges_total",
+                    "tail-latency hedges, by outcome (issued / won / "
+                    "wasted)",
+                    tag_keys=("label", "outcome")),
+                "demotions": Counter(
+                    "serve_replica_demotions_total",
+                    "replicas demoted from routing for EWMA tick "
+                    "latency past slow_factor x the fleet median",
+                    tag_keys=("label",)),
+                "latency": Gauge(
+                    "serve_replica_latency_score",
+                    "EWMA engine-tick wall seconds for one replica "
+                    "(the gray-failure health score)",
+                    tag_keys=("label", "replica")),
             }
         return self._metrics
+
+    def _emit_hedge(self, outcome: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["hedges"].inc(
+                    1.0, tags={"label": self.label,
+                               "outcome": outcome})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_demotion(self, replica_id: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["demotions"].inc(1.0,
+                                         tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_latency(self, replica_id: str, score: float):
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["latency"].set(
+                    float(score),
+                    tags={"label": self.label, "replica": replica_id})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
 
     def _emit_retry(self, cause: str):
         if self._metrics_dead:

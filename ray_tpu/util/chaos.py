@@ -43,20 +43,47 @@ itself).  Current sites:
   exactly-once sample accounting, no drop, no dup);
 - ``data.pack`` — the Nth batch assembly dies before mutating packer
   state (the plane retries; the replayed batch is bit-identical);
-- ``data.stall`` — the Nth shard read sleeps ``RAY_TPU_DATA_STALL_S``
-  (slow-shard backpressure: the bounded prefetch queue drains and the
-  trainer's ``data_stall_seconds`` histogram shows the block);
+- ``data.stall`` — the Nth shard read sleeps (slow-shard
+  backpressure: the bounded prefetch queue drains and the trainer's
+  ``data_stall_seconds`` histogram shows the block).  Prefer the
+  ``:delay=S`` grammar; a bare ``data.stall@N`` entry is the
+  deprecated alias that sleeps ``RAY_TPU_DATA_STALL_S``;
 - ``mesh.loss`` — at the Nth elastic-loop step the training mesh
   loses devices (slice preemption): the loop snapshots (graceful) or
   falls back to the latest retained checkpoint, rebuilds at the
   surviving size with the gradient-accumulation factor scaled to keep
   the global batch, and reshards (``resilience/elastic.py``);
 - ``mesh.restore`` — at the Nth step the lost capacity returns: the
-  loop re-expands to the full mesh the same way.
+  loop re-expands to the full mesh the same way;
+- ``serve.tick`` — per-replica engine-tick latency (the r19 gray-
+  failure site): a ``:delay=`` entry stretches the tick's wall time
+  instead of killing anything — the slow-but-alive replica the
+  health-scored router must demote and hedge around.  Counted twice:
+  once fleet-wide as ``serve.tick`` and once per replica as
+  ``serve.tick[<replica_id>]``, so a plan can slow exactly one
+  replica for a sustained window deterministically;
+- ``mesh.step`` — per-step train-loop latency: a ``:delay=`` window
+  stretches step wall time (a straggling host gates the synchronous
+  step), which the straggler supervisor must detect and convert into
+  a degraded-mesh shrink instead of stalling the run forever.
 
-Spec grammar: comma-separated ``site@N`` entries (``N`` = 1-based hit
-index, fires once; bare ``site`` means ``site@1``), e.g.
-``RAY_TPU_FAULTS="rl.rollout@3,rl.learner@5"``.
+Spec grammar: comma-separated entries::
+
+    site[@N[..M]][:delay=S]
+
+``N`` is the 1-based hit index (bare ``site`` means ``site@1``).
+Without ``:delay=``, the entry is a **fault**: hit ``N`` raises (or,
+for action sites, returns True) exactly once; a hit *range* is
+meaningless for faults and is rejected.  With ``:delay=S``, the entry
+is a **slowdown**: every hit in ``[N, M]`` (``M`` defaults to ``N``)
+sleeps ``S`` seconds inside the site before proceeding — gray failure,
+replayable because it is driven off the same deterministic hit
+counters.  E.g. ``RAY_TPU_FAULTS="rl.rollout@3,serve.tick[r0]@5..40:
+delay=0.1,data.read@2:delay=0.5"``.
+
+Hit counters are lock-protected: the ``StreamingLoader`` producer
+thread, hedged standby readers and the main thread may count sites
+concurrently, and deterministic replay must not race.
 """
 
 from __future__ import annotations
@@ -90,49 +117,103 @@ class InjectedFault(RuntimeError):
 class FaultPlan:
     """Parsed fault spec: deterministic per-site hit counters.
 
-    ``fires(site)`` counts one hit of ``site`` and reports whether an
-    armed fault triggers on exactly this hit.  Counters are process-
-    global per plan, so a fixed spec + deterministic call order (the
-    loops here are single-threaded drivers) reproduces the same
-    failure point every run.  ``fired`` logs every triggered
-    ``(site, hit)`` so tests can assert the fault actually landed.
+    ``fires(site)`` counts one hit of ``site``, sleeps any armed
+    slowdown for this hit, and reports whether an armed fault triggers
+    on exactly this hit.  Counters are process-global per plan and
+    lock-protected (producer threads and hedged standby readers count
+    sites concurrently with the main thread), so a fixed spec +
+    deterministic call order reproduces the same failure point every
+    run.  ``fired`` logs every triggered ``(site, hit)`` and
+    ``slowed`` every injected ``(site, hit, seconds)`` so tests can
+    assert the gray failure actually landed.
     """
 
     def __init__(self, spec: str = ""):
         self._armed: Dict[str, List[int]] = {}
+        # site -> [(first_hit, last_hit, delay_s)] slowdown windows
+        self._delays: Dict[str, List[Tuple[int, int, float]]] = {}
         self._hits: Dict[str, int] = {}
+        self._lock = threading.Lock()
         self.fired: List[Tuple[str, int]] = []
+        self.slowed: List[Tuple[str, int, float]] = []
         self.spec = spec.strip()
         for entry in self.spec.split(","):
             entry = entry.strip()
             if not entry:
                 continue
-            site, _, at = entry.partition("@")
+            head, _, tail = entry.partition(":")
+            delay = None
+            if tail:
+                key, _, val = tail.partition("=")
+                if key.strip() != "delay" or not val:
+                    raise ValueError(
+                        f"bad RAY_TPU_FAULTS entry {entry!r}: the "
+                        "only site modifier is ':delay=S' (seconds)")
+                try:
+                    delay = float(val)
+                except ValueError:
+                    raise ValueError(
+                        f"bad RAY_TPU_FAULTS entry {entry!r}: "
+                        f"delay {val!r} is not a number of seconds")
+                if delay < 0:
+                    raise ValueError(
+                        f"bad RAY_TPU_FAULTS entry {entry!r}: delay "
+                        "must be >= 0 seconds")
+            site, _, at = head.partition("@")
             site = site.strip()
+            lo, _, hi = at.partition("..")
             try:
-                hit = int(at) if at else 1
+                first = int(lo) if lo else 1
+                last = int(hi) if hi else first
             except ValueError:
                 raise ValueError(
                     f"bad RAY_TPU_FAULTS entry {entry!r}: expected "
-                    "'site' or 'site@N' (N = 1-based hit index)")
-            if hit < 1:
+                    "'site', 'site@N' or 'site@N..M' (1-based hit "
+                    "indices)")
+            if first < 1 or last < first:
                 raise ValueError(
                     f"bad RAY_TPU_FAULTS entry {entry!r}: hit index "
-                    "must be >= 1")
-            self._armed.setdefault(site, []).append(hit)
+                    "must be >= 1 (and N <= M for a window)")
+            if delay is None:
+                if hi:
+                    raise ValueError(
+                        f"bad RAY_TPU_FAULTS entry {entry!r}: a hit "
+                        "range only makes sense for a slowdown — add "
+                        "':delay=S' (a fault fires once, at one hit)")
+                self._armed.setdefault(site, []).append(first)
+            else:
+                self._delays.setdefault(site, []).append(
+                    (first, last, delay))
 
     def fires(self, site: str) -> bool:
-        """Count one hit of ``site``; True iff an armed fault triggers
-        on exactly this hit (each armed entry fires at most once)."""
-        hit = self._hits.get(site, 0) + 1
-        self._hits[site] = hit
-        if hit in self._armed.get(site, ()):
-            self.fired.append((site, hit))
-            return True
-        return False
+        """Count one hit of ``site``; sleep this hit's armed slowdown
+        (if any); True iff an armed fault triggers on exactly this hit
+        (each armed entry fires at most once)."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            delay = 0.0
+            for first, last, d in self._delays.get(site, ()):
+                if first <= hit <= last:
+                    delay += d
+            if delay > 0:
+                self.slowed.append((site, hit, delay))
+            fired = hit in self._armed.get(site, ())
+            if fired:
+                self.fired.append((site, hit))
+        if delay > 0:           # sleep OUTSIDE the lock: a slowed
+            time.sleep(delay)   # site must not block other counters
+        return fired
 
     def hits(self, site: str) -> int:
-        return self._hits.get(site, 0)
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def slowdown_s(self, site: str) -> float:
+        """Total injected delay the plan has charged to ``site`` so
+        far (test/telemetry accounting)."""
+        with self._lock:
+            return sum(d for s, _, d in self.slowed if s == site)
 
 
 _PLAN: Optional[FaultPlan] = None

@@ -1,0 +1,155 @@
+"""Round-19 on-chip driver: gray-failure A/Bs.
+
+Usage: python scratch/r19_gray.py <variant>
+
+Variants:
+  gray     — `bench.py --infer --replicas 3 --gray`: the serve-side
+             gray-failure A/B on real hardware — one replica under a
+             sustained `serve.tick[r0]:delay` window, hedging +
+             latency demotion ON vs OFF.  Reports p50/p99 TTFT,
+             inter-token p99, hedges issued/won/wasted, demotions,
+             compile counters (must be all-zero) and the leak audit.
+             The chip number this arm prices: on a real engine the
+             tick is device-bound, so the injected delay rides on top
+             of genuine dispatch — the ON arm's hedge deadline and
+             the demotion dwell must still separate the tails.
+  straggle — the training straggler A/B: an uninterrupted run vs one
+             whose steps straggle under a `mesh.step@..:delay` window
+             with the straggler supervisor armed (factor 3, dwell 2).
+             The supervisor converts the straggle into the r18
+             degraded-mesh shrink; reports loss drift vs base, cursor
+             equality (must be exact), the straggle event step and
+             per-topology compile counts.  On chip the real question
+             is the detection margin: step walls are ms-scale and
+             noisy, so the rolling-median baseline + dwell must hold
+             the false-positive rate at zero on a healthy run.
+
+Carried arms (no chip session yet; every r06-r18 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+elastic / accum plus all r6-r17 arms — delegated verbatim to
+scratch/r18_elastic.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "gray"
+
+_R18_ARMS = ("elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R18_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r18_elastic.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+try:
+    import ray_tpu  # noqa: F401
+except ModuleNotFoundError:   # run as `python scratch/r19_gray.py`
+    sys.path.insert(0, os.path.dirname(HERE))
+
+assert VARIANT in ("gray", "straggle"), f"unknown variant {VARIANT!r}"
+
+ROOT = os.path.dirname(HERE)
+
+if VARIANT == "gray":
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+         "--replicas", "3", "--gray"] + sys.argv[2:]).returncode)
+
+
+# --------------------------------------------------------- straggle arm
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.resilience import (StragglerSupervisor,  # noqa: E402
+                                run_elastic_train_loop)
+from ray_tpu.util import chaos  # noqa: E402
+
+devices = jax.devices()
+platform = devices[0].platform
+if len(devices) < 8:
+    # host-sim re-exec (the r8+ idiom): schedule check, not hardware
+    import re
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count=8").strip()
+    print("re-exec on host-simulated 8-device CPU mesh",
+          file=sys.stderr)
+    sys.exit(subprocess.run([sys.executable, __file__, VARIANT],
+                            env=env).returncode)
+
+if platform == "cpu":
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    steps, batch, seq = 12, 32, 128
+else:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                         dtype=jnp.bfloat16, remat=False,
+                         unroll_layers=True, ce_chunk=-1)
+    steps, batch, seq = 12, 32, 1024
+
+t0 = time.time()
+kw = dict(steps=steps, batch_size=batch, seq_len=seq, seed=0,
+          telemetry=True)
+base = run_elastic_train_loop(cfg, **kw)
+# a healthy run with the supervisor armed must detect NOTHING (the
+# false-positive arm — on chip, step-wall noise is the real test)
+clean_sup = StragglerSupervisor(factor=3.0, dwell=2, window=16)
+clean = run_elastic_train_loop(cfg, straggler=clean_sup,
+                               topologies=None, **kw)
+# the injected delay scales off the MEASURED healthy step wall (the
+# clean supervisor's rolling baseline), so the straggle is ~9x normal
+# on any platform — a fixed number would be invisible where steps are
+# slow and disruptive where they are fast.  9x against the factor-3
+# threshold leaves ~3x headroom: the straggled run forms its OWN
+# baseline from its first healthy steps, and run-to-run wall noise
+# (CPU contention, frequency) must not push the threshold past the
+# injected straggle
+delay = round(8.0 * clean_sup.baseline_s() + 0.1, 3)
+# the straggle window starts after the baseline forms and covers the
+# rest of the run; mesh.restore expands once capacity "returns"
+sup = StragglerSupervisor(factor=3.0, dwell=2, window=16)
+chaos.install_faults(
+    f"mesh.step@5..{steps * 2}:delay={delay},mesh.restore@10")
+rec = run_elastic_train_loop(cfg, straggler=sup, **kw)
+chaos.clear_faults()
+
+drift = [abs(a - b) / max(abs(a), 1e-9)
+         for a, b in zip(base["losses"], rec["losses"])]
+print(json.dumps({
+    "metric": "straggler_loss_drift_max_rel",
+    "value": round(float(max(drift)), 9),
+    "unit": "rel |loss delta| vs uninterrupted run",
+    "platform": platform,
+    "steps": steps, "batch": batch, "seq": seq,
+    "injected_delay_s": delay,
+    "straggler_events": rec["straggler_events"],
+    "false_positives_clean_run": clean_sup.events,
+    "transitions": rec["transitions"],
+    "cursor_accounting_exact":
+        rec["batch_cursors"] == base["batch_cursors"],
+    "compile_counts": rec["compile_counts"],
+    "elastic": rec["elastic"],
+    "wall_s": round(time.time() - t0, 1),
+}))
+ok = (rec["batch_cursors"] == base["batch_cursors"]
+      and clean_sup.events == 0
+      and len(rec["straggler_events"]) >= 1
+      and any(t["cause"] == "straggler" for t in rec["transitions"])
+      and max(drift) < 5e-3)
+sys.exit(0 if ok else 1)

@@ -389,6 +389,103 @@ def test_stall_site_shows_in_telemetry(monkeypatch):
         data_config(refresh=True)
 
 
+def test_read_delay_window_slows_but_never_drops():
+    """r19 gray failure: a ``data.read@N..M:delay=S`` window stretches
+    shard fetches without killing anything — the delivered sequence is
+    bit-identical to the clean run, zero reader restarts (slow is not
+    dead), and the plan's slowdown ledger shows the injected seconds."""
+    from ray_tpu.data import StreamingLoader
+    from ray_tpu.util import chaos
+    src = _source()
+    with StreamingLoader(src, batch_size=2, seq_len=24, seed=0,
+                         device_put=False) as clean:
+        ref = _collect(clean, 4)
+    plan = chaos.install_faults("data.read@2..3:delay=0.05")
+    with StreamingLoader(src, batch_size=2, seq_len=24, seed=0,
+                         device_put=False) as slowed:
+        got = _collect(slowed, 4)
+        restarts = slowed.telemetry.reader_restarts
+    assert [s[:2] for s in plan.slowed] == [("data.read", 2),
+                                            ("data.read", 3)]
+    assert plan.slowdown_s("data.read") == pytest.approx(0.1)
+    assert restarts == 0
+    for x, y in zip(ref, got):
+        np.testing.assert_array_equal(x.batch["tokens"],
+                                      y.batch["tokens"])
+        assert x.spans == y.spans
+
+
+class _SlowFirstRead:
+    """Pure source whose FIRST read sleeps: the slow-but-alive shard.
+    Responses stay byte-identical across calls (purity is what makes
+    first-response-wins exactly-once without a protocol)."""
+
+    def __init__(self, inner, sleep_s):
+        self._inner = inner
+        self._sleep_s = sleep_s
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def read(self, shard, start, count):
+        import time as _time
+        self.calls += 1
+        if self.calls == 1:
+            _time.sleep(self._sleep_s)
+        return self._inner.read(shard, start, count)
+
+
+def test_hedged_read_standby_wins_exactly_once():
+    """r19 hedged reads: a shard read that outlives the hedge budget
+    is re-issued to a standby reader; the standby's (identical, by
+    purity) response wins the race, the delivered stream matches the
+    unhedged run bit-for-bit, and the hedge counters record the win."""
+    from ray_tpu.data import StreamingLoader
+    ref_src = _source()
+    with StreamingLoader(ref_src, batch_size=2, seq_len=24, seed=0,
+                         device_put=False) as clean:
+        ref = _collect(clean, 4)
+    slow = _SlowFirstRead(_source(), sleep_s=0.5)
+    with StreamingLoader(slow, batch_size=2, seq_len=24, seed=0,
+                         hedge_s=0.05, device_put=False) as hedged:
+        got = _collect(hedged, 4)
+        sched = hedged._schedule
+        tel = hedged.telemetry.summary()
+    assert sched.read_hedges == 1 and sched.read_hedges_won == 1
+    assert tel["read_hedges"] == 1 and tel["read_hedges_won"] == 1
+    for x, y in zip(ref, got):
+        np.testing.assert_array_equal(x.batch["tokens"],
+                                      y.batch["tokens"])
+        assert x.spans == y.spans
+
+
+class _SlowFailRead:
+    """Every read sleeps, then dies: the hedge races a second leg and
+    BOTH fail — only then may the attempt fail into the retry loop."""
+
+    def __init__(self, inner, sleep_s):
+        self._inner = inner
+        self._sleep_s = sleep_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def read(self, shard, start, count):
+        import time as _time
+        _time.sleep(self._sleep_s)
+        raise RuntimeError("shard storage gone")
+
+
+def test_hedged_read_both_legs_fail_exhausts_typed():
+    from ray_tpu.data import DataPlaneError, StreamingLoader
+    src = _SlowFailRead(_source(), sleep_s=0.1)
+    with StreamingLoader(src, batch_size=2, seq_len=24, retries=1,
+                         hedge_s=0.02, device_put=False) as ld:
+        with pytest.raises(DataPlaneError, match="retry budget"):
+            ld.next()
+
+
 # --------------------------------------------------- kill/resume fuzzing
 def test_chaos_fuzz_kill_resume_exactly_once():
     """500 fuzzed operations (deliver / kill-the-loader-and-resume-from

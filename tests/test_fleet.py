@@ -88,6 +88,7 @@ class StubReplica:
         self._next_rid = 0
         self.submit_error = None       # raised once per set
         self.submitted = 0
+        self.latency = 0.0             # r19 health score (0 = unmeasured)
         self.engine = types.SimpleNamespace(
             page_size=page_size, buckets=(64,),
             cancel=lambda rid: None)
@@ -119,6 +120,9 @@ class StubReplica:
 
     def waiting_depth(self):
         return self._depth
+
+    def latency_score(self):
+        return self.latency
 
     def prefix_digest(self):
         return self._digest
@@ -527,6 +531,399 @@ def test_reconciler_dead_while_draining_is_retired_not_replaced():
     assert reps[2].reaped
     assert "r2" not in rec.states()
     assert len(router.replicas()) == 2 and made == []
+
+
+# ------------------------------------------------- gray failure (r19)
+def test_router_latency_demotion_is_soft():
+    """Health scoring: the latency outlier past slow_factor x the
+    fleet median is demoted (excluded from routing while faster
+    replicas exist), uniformly slow fleets demote NOBODY (the median
+    moves with the shared cause), and an all-demoted candidate set
+    still routes — demotion is never a dead-end."""
+    from ray_tpu.fleet import FleetRouter
+    reps = [StubReplica(f"r{i}") for i in range(3)]
+    tel = _tel()
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False,
+                                         slow_factor=3.0),
+                         rng_seed=1, telemetry=tel)
+    for r, lat in zip(reps, (0.01, 0.012, 0.1)):
+        r.latency = lat
+    router._update_health()
+    assert router.slow_replicas() == {"r2"}
+    assert tel.replica_demotions == 1
+    router._update_health()                  # same episode: no re-count
+    assert tel.replica_demotions == 1
+    # routing: the demoted replica receives nothing
+    for i in range(12):
+        s = router.remote({"tokens": [1, 2, 3], "max_new_tokens": 2})
+        assert s.replica_id != "r2"
+    # uniform slowness: median moves with it, nobody demoted
+    for r in reps:
+        r.latency = 0.1
+    router._update_health()
+    assert router.slow_replicas() == set()
+    # soft demotion: even with every candidate demoted, route anyway
+    router._demoted = {"r0", "r1", "r2"}
+    s = router.remote({"tokens": [1, 2, 3], "max_new_tokens": 2})
+    assert s.error is None and s.replica_id is not None
+    # slow_factor=0 disables scoring entirely
+    off = FleetRouter([StubReplica("a"), StubReplica("b")],
+                      cfg=_fcfg(slow_factor=0.0), telemetry=_tel())
+    off.replicas()[0].latency = 99.0
+    off._update_health()
+    assert off.slow_replicas() == set()
+
+
+def test_router_pow2_latency_penalty():
+    """The pow-2 comparison weighs queue depth by relative latency: a
+    2x-median (below the demotion threshold) replica loses the pick
+    at equal depth — slowness costs routing share before it costs
+    membership."""
+    from ray_tpu.fleet import FleetRouter
+    reps = [StubReplica("fast"), StubReplica("meh")]
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False,
+                                         slow_factor=3.0),
+                         rng_seed=5, telemetry=_tel())
+    reps[0].latency, reps[1].latency = 0.01, 0.02
+    router._update_health()
+    assert router.slow_replicas() == set()   # 2x < slow_factor 3x
+    # at equal depth the fast replica wins the pick outright ...
+    assert router._effective_load(reps[0]) < \
+        router._effective_load(reps[1])
+    s = router.remote({"tokens": [1, 2], "max_new_tokens": 1})
+    assert s.replica_id == "fast"
+    # ... and across a burst (depth feedback included: the slow
+    # replica still gets work once the fast one is 2x deeper —
+    # penalty, not starvation) the fast replica carries more
+    for _ in range(19):
+        router.remote({"tokens": [1, 2], "max_new_tokens": 1})
+    assert reps[0].submitted > reps[1].submitted
+
+
+def test_hedge_deadline_and_capacity_gate():
+    """The hedge deadline floors at hedge_min until enough TTFT
+    samples exist, then tracks hedge_factor x rolling p99; and a
+    hedge is only issued when the best alternative has spare capacity
+    NOW (empty waiting queue) — a saturated fleet never hedges itself
+    deeper into saturation."""
+    from ray_tpu.fleet import FleetRouter
+    reps = [StubReplica("h0"), StubReplica("h1")]
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False, hedge=True,
+                                         hedge_factor=2.0,
+                                         hedge_min=0.05),
+                         rng_seed=0, telemetry=_tel())
+    assert router.hedge_deadline_s() == pytest.approx(0.05)
+    for _ in range(20):
+        router._record_ttft(0.1)
+    assert router.hedge_deadline_s() == pytest.approx(0.2)
+    router._record_ttft(1.0)                 # a tail sample moves p99
+    assert router.hedge_deadline_s() == pytest.approx(2.0)
+    # capacity gate: the only alternative has waiting work -> no hedge
+    s = router.remote({"tokens": [1, 2, 3], "max_new_tokens": 2})
+    other = next(r for r in reps if r.id != s.replica_id)
+    other._depth = 5                         # its queue is backed up
+    s.submitted_ts -= 100.0                  # way past any deadline
+    router._maybe_hedge()
+    assert s.hedge_rid is None
+    other._depth = 0                         # capacity appears
+    router._maybe_hedge()
+    assert s.hedge_rid is not None and s.hedge_replica_id == other.id
+    assert router.telemetry.hedges == {"issued": 1}
+
+
+def test_hedge_race_hedge_wins_exactly_once(tiny_f32):
+    """Deterministic hedge race, hedge side wins: the first token
+    from the hedge binding resolves the race, the primary's leg is
+    unbound + cancelled (slot/pages/prefix refs released on its next
+    tick), and the delivered sequence equals the unhedged greedy run
+    exactly — at-most-once is structural."""
+    from ray_tpu.fleet import FleetRouter
+    cfg, _ = tiny_f32
+    prompt = _prompt(8, cfg.vocab_size, seed=40)
+    ref = _make_replica(tiny_f32, "ref-hw")
+    (expected,) = ref.engine.generate([prompt], max_new_tokens=4)
+
+    reps = [_make_replica(tiny_f32, "p0"), _make_replica(tiny_f32, "p1")]
+    tel = _tel()
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False, hedge=True,
+                                         hedge_min=0.05),
+                         rng_seed=2, telemetry=tel)
+    s = router.remote({"tokens": prompt, "max_new_tokens": 4})
+    primary = router._replicas[s.replica_id]
+    hedge_rep = next(r for r in reps if r.id != primary.id)
+    # the primary is "slow": no tick has delivered; force the deadline
+    s.submitted_ts -= 10.0
+    router._maybe_hedge()
+    assert (s.hedge_replica_id, s.hedges) == (hedge_rep.id, 1)
+    # step ONLY the hedge replica: its first token wins the race
+    for ev in hedge_rep.step():
+        router._dispatch(hedge_rep, ev)
+    assert s.hedge_rid is None and s.replica_id == hedge_rep.id
+    assert tel.hedges == {"issued": 1, "won": 1}
+    assert 1 <= len(s.generated) <= 2       # prefill (+maybe decode)
+    # the loser's binding is gone: the primary's late tick can no
+    # longer deliver anything for this stream (its rid was cancelled)
+    before = list(s.generated)
+    for ev in primary.step():
+        router._dispatch(primary, ev)
+    assert s.generated == before
+    # drain to completion: exactly one token sequence, greedy-exact
+    deadline = time.monotonic() + 5
+    while not s.done and time.monotonic() < deadline:
+        router.poll()
+    assert list(s.generated) == expected and s.error is None
+    while primary.has_work() or hedge_rep.has_work():
+        router.poll()
+    assert all(r.leak_free() for r in reps)
+
+
+def test_hedge_race_primary_recovers_after_fire(tiny_f32):
+    """Deterministic hedge race, primary side recovers AFTER the
+    hedge fired: the primary's first token wins, the hedge leg is
+    cancelled and counted ``wasted``, its slot/pages/prefix refs
+    release, and the output equals the unhedged run exactly."""
+    from ray_tpu.fleet import FleetRouter
+    cfg, _ = tiny_f32
+    prompt = _prompt(19, cfg.vocab_size, seed=41)
+    ref = _make_replica(tiny_f32, "ref-pw")
+    (expected,) = ref.engine.generate([prompt], max_new_tokens=4)
+
+    reps = [_make_replica(tiny_f32, "q0"), _make_replica(tiny_f32, "q1")]
+    tel = _tel()
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False, hedge=True,
+                                         hedge_min=0.05),
+                         rng_seed=2, telemetry=tel)
+    s = router.remote({"tokens": prompt, "max_new_tokens": 4})
+    primary = router._replicas[s.replica_id]
+    hedge_rep = next(r for r in reps if r.id != primary.id)
+    s.submitted_ts -= 10.0
+    router._maybe_hedge()
+    assert s.hedge_rid is not None
+    hedge_key = (s.hedge_replica_id, s.hedge_rid)
+    # the primary recovers: ITS first token resolves the race
+    for ev in primary.step():
+        router._dispatch(primary, ev)
+    assert s.hedge_rid is None and s.replica_id == primary.id
+    assert tel.hedges == {"issued": 1, "wasted": 1}
+    assert hedge_key not in router._by_rid
+    # the hedge replica ticks once to process the cancel: released
+    hedge_rep.step()
+    assert hedge_rep.leak_free() and not hedge_rep.has_work()
+    deadline = time.monotonic() + 5
+    while not s.done and time.monotonic() < deadline:
+        router.poll()
+    assert list(s.generated) == expected and s.error is None
+    assert s.retries == 0                    # a hedge is not a failover
+    assert all(r.leak_free() for r in reps)
+
+
+def test_hedged_stream_survives_primary_death(tiny_f32):
+    """A hedged stream whose primary DIES promotes the surviving
+    binding instead of re-routing: the hedge was the failover (no
+    retry consumed, no re-prefill), and the stream completes exactly."""
+    from ray_tpu.fleet import FleetRouter
+    cfg, _ = tiny_f32
+    prompt = _prompt(8, cfg.vocab_size, seed=42)
+    ref = _make_replica(tiny_f32, "ref-pd")
+    (expected,) = ref.engine.generate([prompt], max_new_tokens=3)
+
+    reps = [_make_replica(tiny_f32, "k0"), _make_replica(tiny_f32, "k1")]
+    tel = _tel()
+    router = FleetRouter(reps, cfg=_fcfg(affinity=False, hedge=True,
+                                         hedge_min=0.05),
+                         rng_seed=2, telemetry=tel)
+    s = router.remote({"tokens": prompt, "max_new_tokens": 3})
+    primary = router._replicas[s.replica_id]
+    s.submitted_ts -= 10.0
+    router._maybe_hedge()
+    assert s.hedge_rid is not None
+    primary.alive = False                    # gray turned black
+    deadline = time.monotonic() + 5
+    while not s.done and time.monotonic() < deadline:
+        router.poll()
+    assert list(s.generated) == expected and s.error is None
+    assert s.retries == 0                    # promoted, not re-routed
+    assert tel.hedges == {"issued": 1, "won": 1}
+    assert primary.reaped                    # corpse audits clean
+    assert all(r.leak_free() for r in reps)
+
+
+def test_reconciler_degraded_blip_sustained_and_death():
+    """Table-driven DEGRADED rows: the router's latency verdict moves
+    a RUNNING replica to DEGRADED; a blip re-promotes before the
+    dwell; a demotion sustained past the dwell drain-restarts (drain
+    + replacement spawn + retire once drained — zero dropped); death
+    while DEGRADED escalates to WEDGED (black dominates gray)."""
+    from ray_tpu.fleet import DEGRADED, RUNNING
+    reps, router, rec, made = _stub_fleet(3, dwell=2.0, backoff=0.0,
+                                          slow_factor=3.0)
+    for r in reps:
+        r.latency = 0.01       # measured and healthy (the median)
+
+    def set_latency(rid, lat):
+        router._replicas[rid].latency = lat
+        router._update_health()
+
+    # blip: demoted, then the score recovers before the dwell
+    set_latency("r2", 0.5)
+    assert rec.reconcile(now=1.0) == ["r2: RUNNING->DEGRADED"]
+    set_latency("r2", 0.01)
+    assert rec.reconcile(now=1.5) == ["r2: DEGRADED->RUNNING"]
+    assert rec.demotion_restarts == 0 and made == []
+
+    # sustained: dwell passes -> drain-restart (the only gray path
+    # that recycles) with the replacement spawned the same pass
+    set_latency("r2", 0.5)
+    assert rec.reconcile(now=2.0) == ["r2: RUNNING->DEGRADED"]
+    assert rec.reconcile(now=3.5) == []      # dwell not yet served
+    acts = rec.reconcile(now=4.1)
+    assert "r2: DEGRADED->DRAINING (degraded drain-restart)" in acts
+    assert any("STARTING (restore" in a for a in acts)
+    assert reps[2].draining and rec.demotion_restarts == 1
+    assert len(made) == 1
+    # retire once drained; the replacement goes RUNNING
+    reps[2]._drained = True
+    acts = rec.reconcile(now=4.2)
+    assert "r2: DRAINING->STOPPED" in acts
+    assert "r2" not in rec.states()
+    assert sorted(rec.states().values()).count(RUNNING) == 3
+
+    # death while DEGRADED: WEDGED immediately (no dwell on failures)
+    set_latency("r1", 0.5)
+    rec.reconcile(now=5.0)
+    assert rec.states()["r1"] == DEGRADED
+    reps[1].alive = False
+    acts = rec.reconcile(now=5.5)
+    assert "r1: DEGRADED->WEDGED" in acts
+    # backoff=0: the corpse is replaced the same pass (1:1 restart,
+    # not a drain) — the gray path never ran
+    assert any("RESTARTING" in a for a in acts)
+    assert "r1" not in rec.states()
+    assert rec.demotion_restarts == 1        # unchanged by the death
+
+
+def test_gray_failure_acceptance(tiny_f32):
+    """THE r19 acceptance test: one replica runs a sustained
+    ``serve.tick`` slowdown window mid-traffic (slow, never dead).
+    With health-scored routing + hedging ON, every stream completes
+    with greedy continuations exactly matching the unfailed run, the
+    fleet p99 TTFT beats the mitigation-OFF arm by >= 2x, the slow
+    replica is demoted then recycled by the reconciler (DEGRADED ->
+    drain-restart) with zero dropped streams and ZERO recompiles, and
+    the fleet-wide leak audit passes."""
+    from ray_tpu.fleet import FleetRouter, Reconciler, RUNNING
+    from ray_tpu.util import chaos
+    cfg, _ = tiny_f32
+    prompts = [_prompt(8 + i, cfg.vocab_size, seed=50 + i)
+               for i in range(9)]
+    ref = _make_replica(tiny_f32, "gray-ref")
+    expected = ref.engine.generate(prompts, max_new_tokens=4)
+    delay, gap = 0.4, 0.05
+
+    def run_arm(mitigate):
+        fcfg = _fcfg(retries=2, dwell=0.3, backoff=0.0,
+                     slow_factor=3.0 if mitigate else 0.0,
+                     hedge=mitigate, hedge_factor=2.0, hedge_min=0.06)
+        tag = "m" if mitigate else "u"
+        reps = [_make_replica(tiny_f32, f"{tag}{i}") for i in range(3)]
+        slow_id = reps[0].id
+        router = FleetRouter(reps, cfg=fcfg, affinity=False,
+                             rng_seed=1, concurrent_steps=True,
+                             telemetry=_tel())
+        rec = Reconciler(
+            router, lambda rid: _make_replica(tiny_f32, rid),
+            target=3, cfg=fcfg)
+        chaos.install_faults(
+            f"serve.tick[{slow_id}]@1..100000:delay={delay}")
+        streams, i = [], 0
+        t0 = time.monotonic()
+        try:
+            while i < len(prompts) or any(not s.done for s in streams):
+                now = time.monotonic() - t0
+                while i < len(prompts) and i * gap <= now:
+                    streams.append(router.remote(
+                        {"tokens": prompts[i], "max_new_tokens": 4}))
+                    i += 1
+                progressed = router.poll()
+                if mitigate:
+                    rec.reconcile()
+                if not progressed:
+                    time.sleep(0.002)
+                assert time.monotonic() - t0 < 60, "gray arm hung"
+            if mitigate:
+                # keep reconciling until the chronically slow replica
+                # has been recycled: demoted -> DEGRADED -> (dwell)
+                # drain-restart -> STOPPED, replacement RUNNING
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    router.poll()
+                    rec.reconcile()
+                    if (slow_id not in rec.states() and sorted(
+                            rec.states().values()).count(RUNNING) == 3):
+                        break
+                    time.sleep(0.005)
+        finally:
+            chaos.clear_faults()
+        return streams, router, rec, reps, slow_id
+
+    streams_on, router_on, rec_on, reps_on, slow_on = run_arm(True)
+    streams_off, router_off, _, reps_off, _ = run_arm(False)
+
+    # zero dropped streams, exact greedy continuations, both arms
+    for streams in (streams_on, streams_off):
+        assert all(s.done and s.error is None for s in streams)
+        for s, want in zip(streams, expected):
+            assert list(s.generated) == want
+    # mitigation ON beats OFF >= 2x on fleet p99 TTFT: the tail must
+    # stop tracking the straggler (delay dwarfs a healthy tick, so
+    # the margin is wide even on a noisy box)
+    p99 = lambda xs: sorted(xs)[min(len(xs) - 1,       # noqa: E731
+                                    int(0.99 * len(xs)))]
+    p99_on = p99(router_on.recent_ttfts())
+    p99_off = p99(router_off.recent_ttfts())
+    assert p99_off >= 2 * p99_on, (p99_on, p99_off)
+    # the slow replica was demoted then recycled with zero dropped
+    tel = router_on.telemetry.summary()
+    assert tel["replica_demotions"] >= 1
+    assert rec_on.demotion_restarts == 1
+    assert slow_on not in rec_on.states()
+    assert sorted(rec_on.states().values()).count(RUNNING) == 3
+    # hedge accounting is consistent: every issue resolved one way
+    hedges = tel["hedges"]
+    assert hedges.get("issued", 0) == \
+        hedges.get("won", 0) + hedges.get("wasted", 0)
+    # ZERO recompiles anywhere (shared executable cache), and the
+    # fleet-wide leak audit passes in both arms
+    for router, reps in ((router_on, reps_on), (router_off, reps_off)):
+        for r in router.replicas():
+            assert r.engine.stats()["compiles"] == {
+                "prefill": 0, "prefill_cached": 0, "decode": 0}
+        assert router.leak_free()
+        assert all(r.leak_free() for r in reps)
+    router_on.close()
+    router_off.close()
+
+
+def test_latency_score_decays_when_idle(tiny_f32):
+    """Demotion stops a replica's traffic, so its EWMA gets no fresh
+    ticks — the score must decay while idle (stale slowness evidence
+    ages out, keeping the reconciler's blip-recovers-to-RUNNING arm
+    reachable for replicas without continuous work) while an
+    in-flight tick's age still floors it."""
+    rep = _make_replica(tiny_f32, "idle-decay")
+    rep._latency_ewma = 1.0
+    rep._last_tick_done_ts = time.monotonic()
+    assert rep.latency_score() == pytest.approx(1.0, rel=0.05)
+    rep._last_tick_done_ts = time.monotonic() - 60.0
+    assert rep.latency_score() < 0.01
+    # the decay is slow by design (half-life ~ the reconciler dwell):
+    # a short idle gap must NOT flap a demotion inside one episode
+    rep._last_tick_done_ts = time.monotonic() - 1.0
+    assert rep.latency_score() > 0.5
+    rep._tick_t0 = time.monotonic() - 0.4   # step in flight: age floor
+    assert rep.latency_score() >= 0.4
+    rep._tick_t0 = None
+    assert rep.leak_free()
 
 
 def test_fleet_stream_logprobs_parity(tiny_f32):

@@ -115,6 +115,135 @@ def test_fault_plan_spec_and_counters():
     assert str(back) == str(err)
 
 
+def test_fault_plan_delay_grammar():
+    """r19 slowdown entries: ``site@N:delay=S`` sleeps one hit,
+    ``site@N..M:delay=S`` a sustained window, both logged in
+    ``plan.slowed`` and charged to ``slowdown_s`` — and the grammar
+    rejects a hit range without a delay (a fault fires once)."""
+    import time as _time
+
+    from ray_tpu.util.chaos import FaultPlan
+    plan = FaultPlan("a.b@2:delay=0.02, a.b@4..6:delay=0.01, c.d@2")
+    t0 = _time.monotonic()
+    fired = [plan.fires("a.b") for _ in range(7)]
+    wall = _time.monotonic() - t0
+    assert fired == [False] * 7          # delays never raise
+    assert plan.slowed == [("a.b", 2, 0.02), ("a.b", 4, 0.01),
+                           ("a.b", 5, 0.01), ("a.b", 6, 0.01)]
+    assert plan.slowdown_s("a.b") == pytest.approx(0.05)
+    assert plan.slowdown_s("c.d") == 0.0
+    assert wall >= 0.05                  # the sleeps really happened
+    # a delay window and an armed fault coexist on one site
+    assert [plan.fires("c.d") for _ in range(3)] == \
+        [False, True, False]
+    # overlapping windows stack their delays on the shared hit
+    both = FaultPlan("x.y@1..2:delay=0.01,x.y@2:delay=0.02")
+    both.fires("x.y")
+    both.fires("x.y")
+    assert both.slowed == [("x.y", 1, 0.01), ("x.y", 2, 0.03)]
+    with pytest.raises(ValueError, match="delay"):
+        FaultPlan("a.b@1..3")            # range needs :delay=
+    with pytest.raises(ValueError, match="number of seconds"):
+        FaultPlan("a.b@1:delay=fast")
+    with pytest.raises(ValueError, match=">= 0"):
+        FaultPlan("a.b@1:delay=-1")
+    with pytest.raises(ValueError, match="modifier"):
+        FaultPlan("a.b@1:jitter=1")
+    with pytest.raises(ValueError, match="N <= M"):
+        FaultPlan("a.b@5..2:delay=0.1")
+
+
+def test_fault_plan_counters_thread_safe():
+    """Hit counters are lock-protected: N threads hammering one site
+    count exactly N*K hits and the armed fault fires exactly once —
+    the data-plane producer thread and hedged standby readers count
+    sites concurrently with the main thread."""
+    import threading
+
+    from ray_tpu.util.chaos import FaultPlan
+    plan = FaultPlan("t.s@1500")
+    fired = []
+
+    def worker():
+        for _ in range(250):
+            if plan.fires("t.s"):
+                fired.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert plan.hits("t.s") == 2000
+    assert len(fired) == 1 and plan.fired == [("t.s", 1500)]
+
+
+# ------------------------------------------------- straggler supervisor
+def test_straggler_supervisor_blip_vs_sustained():
+    """r19 gray-failure detection: the rolling-median baseline forms
+    from accepted steps only, a single slow step (GC pause, cold
+    compile) never fires, and only ``dwell`` CONSECUTIVE slow steps
+    raise the event — after which the streak resets."""
+    from ray_tpu.resilience import StragglerSupervisor
+    sup = StragglerSupervisor(factor=3.0, dwell=3, window=8)
+    assert sup.enabled
+    # baseline forming: even a wild outlier is accepted silently (the
+    # cold-compile step) and the median stays robust to it
+    assert not any(sup.observe(w) for w in (0.01, 0.5, 0.01, 0.012))
+    assert sup.baseline_s() == pytest.approx(0.011)
+    # a blip: two slow steps, then recovery — no event, and the slow
+    # samples never entered the baseline
+    assert sup.observe(0.2) is False
+    assert sup.observe(0.2) is False
+    assert sup.observe(0.011) is False          # streak broken
+    assert sup.baseline_s() == pytest.approx(0.011)
+    assert sup.events == 0 and sup.slow_steps == 2
+    # sustained: dwell consecutive slow steps fire exactly one event
+    assert [sup.observe(0.2) for _ in range(3)] == \
+        [False, False, True]
+    assert sup.events == 1
+    assert sup.event_log[-1]["baseline_s"] == pytest.approx(0.011)
+    # reset forgets baseline AND streak (topology changed)
+    sup.reset()
+    assert sup.baseline_s() == 0.0
+    assert sup.observe(10.0) is False           # new normal, accepted
+    # disabled: factor=0 never observes anything
+    off = StragglerSupervisor(factor=0.0, dwell=1, window=8)
+    assert not off.enabled
+    assert not any(off.observe(100.0) for _ in range(10))
+    with pytest.raises(ValueError, match="dwell"):
+        StragglerSupervisor(factor=2.0, dwell=0)
+    with pytest.raises(ValueError, match="min_samples"):
+        StragglerSupervisor(factor=2.0, window=2)
+
+
+def test_straggler_config_env_knobs(monkeypatch):
+    from ray_tpu.resilience import StragglerSupervisor
+    from ray_tpu.resilience.config import resilience_config
+    cfg = resilience_config(refresh=True)
+    assert cfg.straggler_factor == 0.0          # default off
+    assert cfg.straggler_dwell == 3
+    assert cfg.straggler_window == 16
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_FACTOR", "2.5")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_DWELL", "5")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_WINDOW", "32")
+    resilience_config(refresh=True)
+    sup = StragglerSupervisor()
+    assert (sup.factor, sup.dwell) == (2.5, 5)
+    assert sup._walls.maxlen == 32
+    # out-of-range knobs clamp loudly instead of crashing the loop
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_FACTOR", "-1")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_DWELL", "0")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_WINDOW", "1")
+    cfg = resilience_config(refresh=True)
+    assert (cfg.straggler_factor, cfg.straggler_dwell,
+            cfg.straggler_window) == (0.0, 1, 3)
+    monkeypatch.delenv("RAY_TPU_STRAGGLER_FACTOR")
+    monkeypatch.delenv("RAY_TPU_STRAGGLER_DWELL")
+    monkeypatch.delenv("RAY_TPU_STRAGGLER_WINDOW")
+    resilience_config(refresh=True)
+
+
 def test_fault_plan_env_and_install(monkeypatch):
     from ray_tpu.util import chaos
     # env spec is read lazily, once

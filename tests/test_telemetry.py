@@ -280,6 +280,8 @@ def test_data_telemetry_summary():
     tel.record_stall(0.05)
     tel.record_reader_restart()
     tel.record_pack_retry()
+    tel.record_read_hedge(won=True)
+    tel.record_read_hedge(won=False)
     out = tel.summary()
     assert out["enabled"] and out["label"] == "train"
     assert out["batches"] == 2 and out["input_tokens"] == 160
@@ -289,10 +291,12 @@ def test_data_telemetry_summary():
     assert out["stall_s_total"] == pytest.approx(0.06)
     assert out["stall_s_max"] == pytest.approx(0.05)
     assert out["reader_restarts"] == 1 and out["pack_retries"] == 1
+    assert out["read_hedges"] == 2 and out["read_hedges_won"] == 1
     off = DataTelemetry(config=TelemetryConfig(enabled=False))
     off.record_batch(10, 0.1)
     off.record_stall(1.0)
     off.record_reader_restart()
+    off.record_read_hedge(won=True)
     assert off.summary() == {"enabled": False}
 
 
@@ -317,11 +321,17 @@ def test_elastic_telemetry_summary():
     assert out["transitions_total"] == 3
     assert out["reshard_s"] == pytest.approx(0.2)
     assert out["reshard_max_s"] == pytest.approx(0.4)
+    # r19: sustained-straggle events ride the same recorder
+    assert out["straggler_events"] == 0
+    tel.record_straggler()
+    tel.record_straggler()
+    assert tel.summary()["straggler_events"] == 2
     with pytest.raises(ValueError, match="shrink"):
         tel.record_transition("sideways", 0.1, n_devices=4)
     off = ElasticTelemetry(config=TelemetryConfig(enabled=False))
     off.record_mesh(8)
     off.record_transition("shrink", 0.1, n_devices=4)
+    off.record_straggler()
     assert off.summary() == {"enabled": False}
 
 
@@ -342,6 +352,17 @@ def test_fleet_telemetry_summary():
         tel.record_affinity(hit=hit)
     tel.record_queue_depth("r0", 3)
     tel.record_queue_depth("r1", 0)
+    # r19 gray-failure series: hedges by outcome, demotion episodes,
+    # per-replica latency-score gauge
+    tel.record_hedge("issued")
+    tel.record_hedge("issued")
+    tel.record_hedge("won")
+    tel.record_hedge("wasted")
+    tel.record_demotion("r1")
+    tel.record_latency_score("r0", 0.002)
+    tel.record_latency_score("r1", 0.31)
+    with pytest.raises(ValueError, match="issued"):
+        tel.record_hedge("lost")
     out = tel.summary()
     assert out["enabled"] and out["label"] == "fleet"
     assert out["router_retries"] == {"dead": 2, "draining": 1,
@@ -351,13 +372,20 @@ def test_fleet_telemetry_summary():
     assert out["affinity_decisions"] == 4
     assert out["affinity_hit_rate"] == pytest.approx(0.75)
     assert out["replica_queue_depth"] == {"r0": 3, "r1": 0}
+    assert out["hedges"] == {"issued": 2, "won": 1, "wasted": 1}
+    assert out["replica_demotions"] == 1
+    assert out["replica_latency_score"] == {"r0": 0.002, "r1": 0.31}
     # a stopped replica's gauge state drops out of the snapshot
     tel.forget_replica("r1")
     assert tel.summary()["replica_queue_depth"] == {"r0": 3}
+    assert tel.summary()["replica_latency_score"] == {"r0": 0.002}
     off = FleetTelemetry(config=TelemetryConfig(enabled=False))
     off.record_retry("dead")
     off.record_restart()
     off.record_affinity(hit=True)
+    off.record_hedge("issued")
+    off.record_demotion("r0")
+    off.record_latency_score("r0", 1.0)
     assert off.summary() == {"enabled": False}
 
 
@@ -489,6 +517,7 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     elastic = ElasticTelemetry(config=on)
     elastic.record_mesh(8)
     elastic.record_transition("shrink", 0.05, n_devices=4)
+    elastic.record_straggler()
     RLTelemetry(config=on).record_actor_restart()
     InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
     data = DataTelemetry(config=on)
@@ -500,6 +529,10 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     fleet.record_restart()
     fleet.record_affinity(hit=True)
     fleet.record_queue_depth("r0", 2)
+    fleet.record_hedge("issued")
+    fleet.record_hedge("won")
+    fleet.record_demotion("r0")
+    fleet.record_latency_score("r0", 0.25)
 
     text = requests.get(f"http://127.0.0.1:{port}/metrics",
                         timeout=10).text
@@ -529,3 +562,10 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "user_histogram_train_reshard_seconds_bucket" in text
     assert "train_elastic_transitions_total" in text
     assert "shrink" in text
+    # r19 gray-failure series: hedges by outcome, demotions, the
+    # per-replica latency-score gauge, train straggle events
+    assert "serve_hedges_total" in text
+    assert "outcome" in text and "issued" in text
+    assert "serve_replica_demotions_total" in text
+    assert "serve_replica_latency_score" in text
+    assert "train_straggler_events_total" in text
