@@ -299,6 +299,14 @@ def _run_open_loop(engine, prompts, max_new, gap_s):
     return _time.perf_counter() - t0, total
 
 
+def _fleet_disagg_env() -> bool:
+    """``RAY_TPU_FLEET_DISAGG=1`` selects the disagg A/B without the
+    ``--disagg`` flag (resolved through fleet_config so the knob has
+    one parser)."""
+    from ray_tpu.fleet import fleet_config
+    return fleet_config().disagg
+
+
 def _replicas_arg() -> int:
     if "--replicas" not in sys.argv:
         return 1
@@ -584,6 +592,180 @@ def bench_infer_gray(replicas_n: int):
             "compiles": arm["compiles"],
             "leak_free": arm["leak_free"],
             "open_loop_gap_s": gap_s,
+        }
+        print(json.dumps(record))
+
+
+def _bench_disagg_arm(cfg, params, mode, replicas_n, prefill_n, slots,
+                      page, kv_dtype, executables, payloads, gap_s):
+    """One measured arm of the disagg A/B (scoped so each arm's fleet
+    frees before the next allocates).  ``mode``: "colocated" runs N
+    replicas behind the FleetRouter; "disagg" splits the SAME N chips
+    into prefill_n prefill + (N - prefill_n) decode replicas behind
+    the DisaggRouter — equal chip count, different topology."""
+    from ray_tpu.fleet import (DisaggRouter, EngineReplica, FleetRouter,
+                               fleet_config)
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.telemetry.config import TelemetryConfig
+    from ray_tpu.telemetry.fleet import FleetTelemetry
+
+    def mk(rid):
+        return EngineReplica(rid, InferenceEngine(
+            cfg, params, slots=slots, page_size=page, telemetry=False,
+            max_queue=0, kv_dtype=kv_dtype,
+            executable_cache=executables))
+
+    tel = FleetTelemetry(config=TelemetryConfig(enabled=True))
+    if mode == "colocated":
+        router = FleetRouter([mk(f"r{i}") for i in range(replicas_n)],
+                             cfg=fleet_config(), affinity=True,
+                             rng_seed=0, telemetry=tel)
+    else:
+        router = DisaggRouter(
+            [mk(f"p{i}") for i in range(prefill_n)],
+            [mk(f"d{i}") for i in range(replicas_n - prefill_n)],
+            cfg=fleet_config(), rng_seed=0, telemetry=tel)
+    dt, streams = _run_fleet_open_loop(router, payloads, gap_s)
+    router.quiesce()
+    inter = [b - a for s in streams
+             for a, b in zip(s.token_ts, s.token_ts[1:])]
+    return {
+        "wall_s": dt,
+        "generated_tokens": sum(len(s.generated) for s in streams),
+        "errors": sum(1 for s in streams if s.error is not None),
+        "ttfts": sorted(router.recent_ttfts()),
+        "inter_token": sorted(inter),
+        "compiles": [r.engine.stats()["compiles"]
+                     for r in router.replicas()],
+        "fleet": tel.summary(),
+        "leak_free": router.leak_free(),
+    }
+
+
+def bench_infer_disagg(replicas_n: int):
+    """Disaggregation A/B: ``python bench.py --infer --replicas N
+    --disagg`` (or ``RAY_TPU_FLEET_DISAGG=1``) — the same open-loop
+    shared-prefix trace over equal chip counts, three ways: N
+    co-located replicas (FleetRouter), 1 prefill + N-1 decode behind
+    the DisaggRouter (``RAY_TPU_FLEET_PREFILL_REPLICAS`` resizes the
+    split), and the disagg arm again on an int8 KV cache.  One JSON
+    line per arm carrying p50/p99 TTFT, decode inter-token p99,
+    aggregate tok/s, and the handoff byte accounting checked against
+    the analytic page-size math — the int8 arm's bytes/page are
+    ``(head_dim + 4) / (head_dim * itemsize)`` of the model-dtype
+    arm's (~half on a bf16 fleet).  All arms ride pre-warmed shared
+    executables: the compile counters in every record must be
+    all-zero."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.fleet import fleet_config
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.inference.config import infer_config
+    from ray_tpu.inference.kv_cache import handoff_page_bytes
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        slots, page, max_new = 4, 16, 8
+        shared_pages, gap_s = 2, 0.005
+        requests = 8 * replicas_n
+        suffix_lens = [9, 17, 5, 23, 12, 30, 7, 14]
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        icfg = infer_config()
+        slots, page, max_new = icfg.slots, icfg.page_size, 32
+        shared_pages, gap_s = 3, 0.01
+        requests = 8 * replicas_n
+        suffix_lens = [32 + 23 * i % 224 for i in range(requests)]
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts, shared_len = _infer_trace(cfg, page, requests, rng_seed=1,
+                                       shared_pages=shared_pages,
+                                       suffix_lens=suffix_lens)
+    prefill_n = min(max(fleet_config().prefill_replicas, 1),
+                    replicas_n - 1)
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    page_bytes = {
+        "model": handoff_page_bytes(
+            n_layers=cfg.n_layers, page_size=page, n_heads=cfg.n_heads,
+            head_dim=cfg.head_dim, itemsize=itemsize, quantized=False),
+        "int8": handoff_page_bytes(
+            n_layers=cfg.n_layers, page_size=page, n_heads=cfg.n_heads,
+            head_dim=cfg.head_dim, itemsize=1, quantized=True),
+    }
+    payloads = [{"tokens": p, "max_new_tokens": max_new}
+                for p in prompts]
+    arms = (("colocated", "model"), ("disagg", "model"),
+            ("disagg", "int8"))
+    executables = {}
+    # warm every executable family the arms touch (cold + cached
+    # prefill flavors, both kv dtypes): the measured fleets must show
+    # all-zero compiles, and no arm may ride a compile another paid
+    for kv_dtype in ("model", "int8"):
+        for warm_prefix in (False, True):
+            warm = InferenceEngine(cfg, params, slots=slots,
+                                   page_size=page, telemetry=False,
+                                   max_queue=0, prefix=warm_prefix,
+                                   kv_dtype=kv_dtype,
+                                   executable_cache=executables)
+            _run_open_loop(warm, prompts, max_new, gap_s=0.0)
+            del warm
+
+    for mode, kv_dtype in arms:
+        arm = _bench_disagg_arm(cfg, params, mode, replicas_n,
+                                prefill_n, slots, page, kv_dtype,
+                                executables, payloads, gap_s)
+        ttfts, inter = arm["ttfts"], arm["inter_token"]
+
+        def pct(xs, q):
+            if not xs:
+                return 0.0
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))], 4)
+
+        fleet = arm["fleet"]
+        analytic = fleet.get("handoff_pages_total", 0) \
+            * page_bytes[kv_dtype]
+        record = {
+            "metric": "gpt_infer_disagg_tokens_per_sec",
+            "value": round(arm["generated_tokens"] / arm["wall_s"], 1)
+            if arm["wall_s"] > 0 else 0.0,
+            "unit": "tokens/s",
+            "platform": platform,
+            "mode": mode,
+            "kv_dtype": kv_dtype,
+            "replicas": replicas_n,
+            "prefill_replicas": prefill_n if mode == "disagg" else 0,
+            "decode_replicas": (replicas_n - prefill_n
+                                if mode == "disagg" else 0),
+            "requests": requests,
+            "shared_prompt_tokens": shared_len,
+            "generated_tokens": arm["generated_tokens"],
+            "errors": arm["errors"],
+            "wall_s": round(arm["wall_s"], 3),
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "inter_token_p99_s": pct(inter, 0.99),
+            "handoffs": fleet.get("handoffs", 0),
+            "handoffs_skipped": fleet.get("handoffs_skipped", 0),
+            "handoff_bytes": fleet.get("handoff_bytes_total", 0),
+            # measured == analytic is the byte-math check: pages moved
+            # times the per-page K/V (+scale) footprint
+            "handoff_bytes_analytic": analytic,
+            "handoff_bytes_match":
+                fleet.get("handoff_bytes_total", 0) == analytic,
+            "handoff_page_bytes": page_bytes[kv_dtype],
+            "handoff_page_bytes_vs_model": round(
+                page_bytes[kv_dtype] / page_bytes["model"], 4),
+            "open_loop_gap_s": gap_s,
+            "compiles": arm["compiles"],
+            "leak_free": arm["leak_free"],
         }
         print(json.dumps(record))
 
@@ -1048,6 +1230,10 @@ def main():
         if "--gray" in sys.argv:
             # the demotion median wants an odd-one-out: 3+ replicas
             bench_infer_gray(n if n > 1 else 3)
+        elif "--disagg" in sys.argv or _fleet_disagg_env():
+            # the split needs >= 1 prefill + >= 2 decode to show the
+            # interference delta: 3+ replicas
+            bench_infer_disagg(n if n > 1 else 3)
         elif n > 1:
             bench_infer_fleet(n)
         else:

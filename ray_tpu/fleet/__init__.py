@@ -29,13 +29,26 @@ median, and over-deadline first-token waiters are **hedged** on a
 second replica (first responder wins, loser cancelled —
 ``RAY_TPU_FLEET_HEDGE_*``).
 
+r20 adds **disaggregated prefill/decode serving**
+(:mod:`~ray_tpu.fleet.disagg`): a :class:`~ray_tpu.fleet.disagg.
+DisaggRouter` fronting a prefill pool (streams end at the first
+token) and a decode pool that imports the handed-off KV pages —
+content-addressed, refcounted, moved through the object store
+(:class:`~ray_tpu.fleet.disagg.HandoffStore`), with digest-affinity
+routing making warm handoffs metadata-only — and per-pool
+:class:`~ray_tpu.fleet.disagg.PoolView` adapters so the same
+reconciler scales the prefill pool on queue depth/TTFT and the decode
+pool on slot occupancy.
+
 Recovery invariants are proven under deterministic ``RAY_TPU_FAULTS``
-plans (sites ``serve.replica`` / ``serve.route`` / ``serve.tick`` in
-:mod:`ray_tpu.util.chaos`).  Config via ``RAY_TPU_FLEET_*``
-(:func:`fleet_config`).
+plans (sites ``serve.replica`` / ``serve.route`` / ``serve.tick`` /
+``serve.handoff`` in :mod:`ray_tpu.util.chaos`).  Config via
+``RAY_TPU_FLEET_*`` (:func:`fleet_config`).
 """
 
 from ray_tpu.fleet.config import FleetConfig, fleet_config  # noqa: F401
+from ray_tpu.fleet.disagg import (DisaggRouter,  # noqa: F401
+                                  DisaggStream, HandoffStore, PoolView)
 from ray_tpu.fleet.reconciler import (DEGRADED, DRAINING,  # noqa: F401
                                       RESTARTING, RUNNING, STARTING,
                                       STOPPED, WEDGED, Instance,
@@ -49,6 +62,7 @@ __all__ = [
     "FleetConfig", "fleet_config",
     "EngineReplica", "FleetRouter", "FleetStream",
     "ReplicaUnavailableError",
+    "DisaggRouter", "DisaggStream", "HandoffStore", "PoolView",
     "Reconciler", "Instance",
     "STARTING", "RUNNING", "DRAINING", "STOPPED", "WEDGED",
     "RESTARTING", "DEGRADED",

@@ -67,6 +67,20 @@ class FleetConfig:
     - ``RAY_TPU_FLEET_HEDGE_MIN`` (default ``0.05``): hedge-deadline
       floor in seconds (and the whole deadline until enough TTFT
       samples exist) — a cold fleet must not hedge every request.
+    - ``RAY_TPU_FLEET_DISAGG`` (default ``0``): serve in disaggregated
+      prefill/decode mode — ``bench.py --infer`` (and drivers reading
+      this config) split the fleet into a prefill pool and a decode
+      pool behind the :class:`~ray_tpu.fleet.disagg.DisaggRouter`
+      instead of N co-located replicas.
+    - ``RAY_TPU_FLEET_PREFILL_REPLICAS`` (default ``1``): how many of
+      a disaggregated fleet's replicas form the prefill pool (the rest
+      decode) — prefill is compute-bound and batches well, so one
+      prefill replica typically feeds several decode replicas.
+    - ``RAY_TPU_FLEET_HANDOFF_INLINE`` (default ``0``): force KV
+      handoffs to bypass the object store and pass the payload
+      in-process (``1``); by default the payload rides ``ray_tpu.put``
+      whenever a session is up (the r14 ``WeightStore`` shape) and
+      falls back inline otherwise.
     """
     retries: int = 2
     affinity: bool = True
@@ -80,6 +94,9 @@ class FleetConfig:
     hedge: bool = True
     hedge_factor: float = 2.0
     hedge_min: float = 0.05
+    disagg: bool = False
+    prefill_replicas: int = 1
+    handoff_inline: bool = False
 
 
 _CONFIG: Optional[FleetConfig] = None
@@ -112,5 +129,10 @@ def fleet_config(refresh: bool = False) -> FleetConfig:
             hedge=env("RAY_TPU_FLEET_HEDGE", "1") != "0",
             hedge_factor=nonneg("RAY_TPU_FLEET_HEDGE_FACTOR", "2"),
             hedge_min=nonneg("RAY_TPU_FLEET_HEDGE_MIN", "0.05"),
+            disagg=env("RAY_TPU_FLEET_DISAGG", "0") != "0",
+            prefill_replicas=max(
+                nonneg("RAY_TPU_FLEET_PREFILL_REPLICAS", "1", int), 1),
+            handoff_inline=env("RAY_TPU_FLEET_HANDOFF_INLINE",
+                               "0") != "0",
         )
     return _CONFIG

@@ -105,11 +105,31 @@ class EngineReplica:
     # --------------------------------------------------------- admission
     def submit(self, prompt, *, max_new_tokens: int, sampling=None,
                eos_token=None, ttft_deadline_s=None,
-               deadline_s=None) -> int:
+               deadline_s=None, hold_pages: bool = False) -> int:
         """Admit one request; raises the typed re-route signals
         (``ReplicaDrainingError`` / ``QueueFullError``) the router
         retries on, or ``ValueError`` for a request this fleet's
-        geometry can never serve (the router fails the stream)."""
+        geometry can never serve (the router fails the stream).
+        ``hold_pages`` is the disagg prefill seam (see
+        :meth:`InferenceEngine.submit`)."""
+        self._check_admittable()
+        return self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                  sampling=sampling, eos_token=eos_token,
+                                  ttft_deadline_s=ttft_deadline_s,
+                                  deadline_s=deadline_s,
+                                  hold_pages=hold_pages)
+
+    def submit_import(self, handoff, *, max_new_tokens: int,
+                      sampling=None, eos_token=None,
+                      deadline_s=None) -> int:
+        """Admit a KV handoff (the disagg decode seam) under the same
+        alive/draining admission guards as :meth:`submit`."""
+        self._check_admittable()
+        return self.engine.import_submit(
+            handoff, max_new_tokens=max_new_tokens, sampling=sampling,
+            eos_token=eos_token, deadline_s=deadline_s)
+
+    def _check_admittable(self) -> None:
         if not self.alive:
             raise RuntimeError(f"replica {self.id} is dead — the "
                                "router must not route to it")
@@ -118,10 +138,6 @@ class EngineReplica:
             raise ReplicaDrainingError(
                 f"replica {self.id} is draining: admission stopped, "
                 "in-flight requests finishing — route elsewhere")
-        return self.engine.submit(prompt, max_new_tokens=max_new_tokens,
-                                  sampling=sampling, eos_token=eos_token,
-                                  ttft_deadline_s=ttft_deadline_s,
-                                  deadline_s=deadline_s)
 
     # -------------------------------------------------------------- tick
     def step(self) -> List[StepEvent]:

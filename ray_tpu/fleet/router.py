@@ -777,6 +777,9 @@ class FleetRouter:
     # ------------------------------------------------------ observability
     def _record_ttft(self, ttft_s: float) -> None:
         self._ttfts.append(ttft_s)
+        # the single-pool arm of the r20 TTFT-by-pool-mode split (the
+        # disagg router records mode="disagg")
+        self.telemetry.record_ttft(ttft_s, mode="colocated")
 
     def recent_ttfts(self) -> List[float]:
         """Recent first-token latencies (the reconciler's SLO signal

@@ -15,7 +15,8 @@ from ray_tpu.inference.config import (InferConfig,  # noqa: F401
                                       infer_config, default_buckets)
 from ray_tpu.inference.engine import (InferenceEngine,  # noqa: F401
                                       StepEvent)
-from ray_tpu.inference.kv_cache import (KVCache,  # noqa: F401
+from ray_tpu.inference.kv_cache import (HandoffContentMissing,  # noqa: F401
+                                        KVCache, KVHandoff,
                                         PageAllocator, PrefixIndex)
 from ray_tpu.inference.sampling import SamplingParams  # noqa: F401
 from ray_tpu.inference.scheduler import (DeadlineExceededError,  # noqa: F401
@@ -25,7 +26,7 @@ from ray_tpu.inference.scheduler import (DeadlineExceededError,  # noqa: F401
 __all__ = [
     "InferConfig", "infer_config", "default_buckets",
     "InferenceEngine", "StepEvent", "KVCache", "PageAllocator",
-    "PrefixIndex",
+    "PrefixIndex", "KVHandoff", "HandoffContentMissing",
     "SamplingParams", "QueueFullError", "DeadlineExceededError",
     "Request", "SlotScheduler",
 ]

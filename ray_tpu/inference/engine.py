@@ -29,6 +29,16 @@ into an explicit compile cache with hit/miss counters — an unexpected
 shape *raises* instead of silently recompiling, and the zero-recompile
 acceptance test asserts on the counters.
 
+**Disaggregated serving seams (r20).**  A prefill-pool engine runs
+*first-token-stop* submissions — ``submit(max_new_tokens=1,
+hold_pages=True)`` — whose pages survive retirement for
+:meth:`export_request` (the KV handoff payload); a decode-pool engine
+takes the payload through :meth:`import_submit`, which admits like any
+request but installs the pages (resident ones as prefix hits, the
+rest written host-side between ticks) and seeds the slot at the
+absolute context offset, so the ordinary fixed-slot decode step
+continues the sequence — neither seam adds an executable.
+
 The steps themselves derive from the training model: ``embed`` +
 ``layer_apply`` with a KV-cache hook threaded through (post-RoPE keys
 written to the paged cache, decode attention over the gathered pages
@@ -232,6 +242,12 @@ class InferenceEngine:
         self.hit_counts: Dict[str, int] = {
             "prefill": 0, "prefill_cached": 0, "decode": 0}
         self._requests: Dict[int, Request] = {}
+        # retired-but-held requests (r20 disagg export seam): pages
+        # stay refcounted until export_request/release_held — the leak
+        # audit counts them, so an orphaned export is visible
+        self._held: Dict[int, Request] = {}
+        self.exports = 0
+        self.imports = 0
         self._next_rid = 0
         self._cancelled: set = set()
         self._lock = threading.Lock()   # submit() vs step() admissions
@@ -266,7 +282,13 @@ class InferenceEngine:
                sampling: Optional[SamplingParams] = None,
                eos_token: Optional[int] = None,
                ttft_deadline_s: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               hold_pages: bool = False) -> int:
+        """Enqueue one request.  ``hold_pages`` is the disaggregation
+        seam (first-token-stop mode is just ``max_new_tokens=1`` with
+        it set): when the request retires, its page references survive
+        for :meth:`export_request` instead of releasing — the prefill
+        side of a prefill/decode split."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -291,7 +313,8 @@ class InferenceEngine:
                                            else ttft_deadline_s
                                            or None),
                           deadline_s=(self.deadline if deadline_s
-                                      is None else deadline_s or None))
+                                      is None else deadline_s or None),
+                          hold_pages=bool(hold_pages))
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
             depth = len(self.scheduler.waiting)
@@ -320,14 +343,122 @@ class InferenceEngine:
         replacing a dead rollout engine — nothing may be left holding
         slots/pages/refcounts.  Safe only when no concurrent
         :meth:`step` is running (the callers' situation by
-        construction: the stepping thread is gone).  Returns how many
+        construction: the stepping thread is gone).  Held exports are
+        released too — a reaped corpse must audit clean even when it
+        died between first token and handoff.  Returns how many
         requests were retired."""
         with self._lock:
             rids = list(self._requests)
         for rid in rids:
             self.cancel(rid)
         self._process_cancels()
-        return len(rids)
+        held = list(self._held)
+        for rid in held:
+            self.release_held(rid)
+        return len(rids) + len(held)
+
+    # --------------------------------------------- disagg handoff (r20)
+    def export_request(self, rid: int) -> "kvc.KVHandoff":
+        """Export a retired-but-held request's cached K/V as a
+        :class:`~ray_tpu.inference.kv_cache.KVHandoff` and release its
+        pages — the prefill side of the prefill/decode split.  The
+        payload covers every cached context token (``prompt +
+        generated[:-1]``; with first-token-stop submissions that is
+        exactly the prompt) plus the next input token the decode side
+        seeds its slot with.  Registered full pages park idle in the
+        prefix pool on release, so a later handoff of the same prefix
+        still prefills nothing here."""
+        req = self._held.pop(rid)
+        context = list(req.prompt) + list(req.generated[:-1])
+        n_pages = kvc.pages_needed(len(context), self.page_size)
+        arrays = kvc.export_pages(self.cache, req.pages[:n_pages])
+        handoff = kvc.KVHandoff(
+            context=context, page_size=self.page_size,
+            kv_dtype=self.kv_dtype, dtype=str(self.cache.k.dtype),
+            chain_hashes=kvc.PrefixIndex.chain_hashes(context,
+                                                      self.page_size),
+            next_token=int(req.generated[-1]),
+            next_logprob=float(req.logprobs[-1]), **arrays)
+        self.scheduler.allocator.release(req.pages)
+        req.pages = None
+        self.exports += 1
+        return handoff
+
+    def release_held(self, rid: int) -> bool:
+        """Release a held export without reading it (the failure path:
+        the handoff faulted, the stream finished at its first token, or
+        the replica is being reaped).  True if ``rid`` was held."""
+        req = self._held.pop(rid, None)
+        if req is None:
+            return False
+        self.scheduler.allocator.release(req.pages)
+        req.pages = None
+        return True
+
+    def import_submit(self, handoff: "kvc.KVHandoff", *,
+                      max_new_tokens: int,
+                      sampling: Optional[SamplingParams] = None,
+                      eos_token: Optional[int] = None,
+                      deadline_s: Optional[float] = None) -> int:
+        """Enqueue a KV handoff on the decode side of the split.
+
+        The request admits through the ordinary scheduler (slot +
+        pages reserved up front; under pressure it waits — queued
+        imports ARE the slot-occupancy backlog the decode pool scales
+        on), but instead of a prefill the admission installs the
+        payload: hit pages (already resident by chain hash) are
+        acquired with zero writes, missing pages get the handoff's
+        contents, the slot seeds at the absolute context offset, and
+        the next decode tick continues the sequence through the one
+        compiled decode executable — nothing new ever compiles here.
+        ``max_new_tokens`` counts the tokens still to generate (the
+        prefill side's first token is already delivered and seeds the
+        sampling counts, so sampled continuations stay
+        trajectory-exact, not just greedy ones)."""
+        if handoff.page_size != self.page_size:
+            raise ValueError(
+                f"handoff page_size {handoff.page_size} != engine "
+                f"page_size {self.page_size} — one fleet geometry")
+        if handoff.kv_dtype != self.kv_dtype \
+                or (handoff.k is not None
+                    and str(handoff.k.dtype) != str(self.cache.k.dtype)):
+            raise ValueError(
+                f"handoff kv_dtype {handoff.kv_dtype!r} "
+                f"(storage {handoff.dtype}) != engine "
+                f"{self.kv_dtype!r} ({self.cache.k.dtype}) — the "
+                "contents would be reinterpreted, not converted")
+        if max_new_tokens < 1:
+            raise ValueError("a handoff needs >= 1 token left to "
+                             "decode — a finished stream has nothing "
+                             "to hand off")
+        context = [int(t) for t in handoff.context]
+        if len(context) + 1 + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"context ({len(context)}) + remaining tokens "
+                f"({1 + max_new_tokens}) exceeds max_seq "
+                f"{self.cfg.max_seq}")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            # prompt = context, generated seeded at install: the
+            # +1 on max_new counts the prefill-side token as this
+            # request's first, keeping retire/eos/sampling-count
+            # arithmetic identical to a co-located run
+            req = Request(rid=rid, prompt=context,
+                          max_new_tokens=max_new_tokens + 1,
+                          sampling=sampling or SamplingParams(),
+                          eos_token=eos_token,
+                          ttft_deadline_s=None,
+                          deadline_s=(self.deadline if deadline_s
+                                      is None else deadline_s or None),
+                          chain_hashes=list(handoff.chain_hashes),
+                          import_payload=handoff)
+            self.scheduler.submit(req)    # validates; may raise
+            self._requests[rid] = req
+            depth = len(self.scheduler.waiting)
+        if self.telemetry.enabled:
+            self.telemetry.record_queue_depth(depth)
+        return rid
 
     def _process_cancels(self) -> None:
         with self._lock:
@@ -466,6 +597,11 @@ class InferenceEngine:
             "prefix": self.scheduler.prefix_stats(),
             "deadline_exceeded": self.deadline_exceeded,
             "ticks": self.ticks,
+            # disagg handoff accounting (r20): exports/imports served,
+            # and how many retired requests still hold pages for export
+            "exports": self.exports,
+            "imports": self.imports,
+            "held": len(self._held),
         }
 
     # ------------------------------------------------------ engine tick
@@ -481,7 +617,10 @@ class InferenceEngine:
                 req = self.scheduler.try_admit()
             if req is None:
                 break
-            self._prefill(req, events)
+            if req.import_payload is not None:
+                self._install_import(req, events)
+            else:
+                self._prefill(req, events)
         if self.scheduler.active:
             self._decode(events)
         self.ticks += 1
@@ -589,6 +728,47 @@ class InferenceEngine:
                                        prefix_hit=cached > 0)
         self._deliver(req, int(tok), float(logp), events)
 
+    def _install_import(self, req: Request, events) -> None:
+        """Seed an admitted import's slot from its handoff payload —
+        the decode side of the split, with ZERO compiled steps: hit
+        pages are already resident, missing pages are written host-side
+        between ticks, and the next batched decode picks the slot up
+        like any mid-sequence request (input token = the prefill
+        side's sampled token, position = the absolute context
+        offset)."""
+        handoff = req.import_payload
+        sched = self.scheduler
+        slot = req.slot
+        n_ctx = len(req.prompt)
+        n_pages = kvc.pages_needed(n_ctx, self.page_size)
+        present = handoff.page_list
+        needed = [i for i in range(req.n_hit_pages, n_pages)]
+        missing = [i for i in needed if i not in present]
+        if missing:
+            # a stripped (warm/partial) handoff whose resident pages
+            # were evicted between the router's digest check and this
+            # admission: release everything and surface the typed
+            # re-prefill signal — never decode over garbage pages
+            sched.retire(slot)
+            req.error = kvc.HandoffContentMissing(req.rid, len(missing))
+            self._requests.pop(req.rid, None)
+            events.append(StepEvent(req.rid, -1, True, 0.0,
+                                    error=req.error))
+            return
+        if needed:
+            kvc.import_pages(self.cache,
+                             [req.pages[i] for i in needed], handoff,
+                             [present.index(i) for i in needed])
+        # contents are in cache: the imported full pages are immutable
+        # from here on and registrable for later handoffs/prompts
+        sched.register_prefix(req)
+        sched.lengths[slot] = n_ctx
+        req.generated = [int(handoff.next_token)]
+        req.logprobs = [float(handoff.next_logprob)]
+        req.cached_tokens = n_ctx
+        req.import_payload = None      # drop the content reference
+        self.imports += 1
+
     # ----------------------------------------------------------- decode
     def _decode(self, events) -> None:
         from ray_tpu.util import chaos, tracing
@@ -638,7 +818,13 @@ class InferenceEngine:
         done = (len(req.generated) >= req.max_new_tokens
                 or (req.eos_token is not None and tok == req.eos_token))
         if done:
-            self.scheduler.retire(req.slot)
+            if req.hold_pages:
+                # disagg export seam: the slot frees but the pages stay
+                # refcounted for export_request/release_held
+                self.scheduler.retire_hold(req.slot)
+                self._held[req.rid] = req
+            else:
+                self.scheduler.retire(req.slot)
             if self.telemetry.enabled:
                 self.telemetry.record_request_done()
             if not self.debug_logits:

@@ -34,6 +34,17 @@ table metadata: the compiled steps never see it, and ``int8`` caches
 share bit-identically because cache writes use deterministic
 rounding.
 
+**Disaggregated handoff (r20).**  Because pages are content-addressed
+and refcounted, moving a request from a prefill replica to a decode
+replica is a transfer of page *ownership*, not a copy protocol:
+:func:`export_pages` reads a retired-but-held request's page contents
+host-side into a :class:`KVHandoff` (context tokens + chained hashes +
+raw K/V; int8 codes and scales ride the same arrays, halving the
+bytes vs bf16), and :func:`import_pages` writes only the pages the
+importing engine does *not* already hold by chain hash into its own
+allocator's fresh pages — a warm importer installs the whole context
+as prefix hits and the handoff moves no contents at all.
+
 ``kv_dtype="int8"`` stores the K/V arrays block-scale-quantized
 (``ray_tpu.quant``): codes in int8, one f32 scale per (page, position,
 head) lane vector riding in per-page scale arrays
@@ -50,6 +61,7 @@ asserted, not assumed.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -58,6 +70,163 @@ import numpy as np
 import jax.numpy as jnp
 
 GARBAGE_PAGE = 0
+
+
+class HandoffContentMissing(RuntimeError):
+    """Typed import failure: a metadata-only (warm) KV handoff reached
+    admission but the resident pages it counted on were no longer in
+    the prefix index (evicted between the router's digest check and
+    the import's admission walk).  Everything the admission touched is
+    released before this surfaces — the disagg router treats it as a
+    re-prefill-from-prompt signal, never a user-facing error."""
+
+    def __init__(self, rid: int, missing_pages: int):
+        super().__init__(
+            f"request {rid}: metadata-only KV handoff is missing "
+            f"{missing_pages} page(s) no longer resident — re-prefill "
+            "from the prompt")
+        self.rid = rid
+        self.missing_pages = missing_pages
+
+    def __reduce__(self):
+        # rebuild from constructor args (the event's error channel can
+        # cross the object store on serve streams)
+        return (HandoffContentMissing, (self.rid, self.missing_pages))
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One request's KV-page ownership transfer (disaggregated
+    prefill -> decode, r20).
+
+    The payload a prefill replica exports after emitting the first
+    sampled token: the cached context's token ids, the chained content
+    hashes of its full pages (the importer's skip-transfer key — a
+    decode replica already holding a page by hash installs it with a
+    refcount bump and never touches the contents), and the raw per-page
+    K/V contents host-side — int8 codes + scales ride the same arrays
+    when the fleet runs a quantized cache, which is what halves the
+    handoff bytes on the wire.  ``k``/``v`` are ``None`` for a
+    *metadata-only* (warm) handoff: the router verified every context
+    page resident on the importer by digest, so no contents move at
+    all.
+
+    Shapes: ``k``/``v`` are ``[n_layers, n_pages, page_size, kv_heads,
+    head_dim]`` in the cache's storage dtype; ``k_scale``/``v_scale``
+    (int8 caches only) are ``[n_layers, n_pages, page_size, kv_heads]``
+    f32.  Page order matches :func:`pages_needed` over ``context``:
+    full pages first, then the partial tail (whose positions past
+    ``len(context) % page_size`` are garbage the decode attention
+    masks, exactly as on the exporter).
+    """
+
+    context: List[int]              # token ids whose K/V are cached
+    page_size: int
+    kv_dtype: str                   # "model" | "int8"
+    dtype: str                      # storage dtype name (drift check)
+    chain_hashes: List[bytes]       # one per FULL context page
+    next_token: int                 # first sampled token (emitted)
+    next_logprob: float
+    k: Optional[np.ndarray] = None
+    v: Optional[np.ndarray] = None
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
+    # which ABSOLUTE page indices the content arrays carry (None =
+    # all of 0..n_pages): a stripped handoff ships only the pages its
+    # target does not already hold by chain hash
+    present: Optional[List[int]] = None
+
+    @property
+    def n_pages(self) -> int:
+        return pages_needed(len(self.context), self.page_size)
+
+    @property
+    def n_full_pages(self) -> int:
+        return len(self.context) // self.page_size
+
+    @property
+    def page_list(self) -> List[int]:
+        """Absolute indices of the pages whose contents ride along."""
+        if self.k is None:
+            return []
+        if self.present is None:
+            return list(range(self.n_pages))
+        return list(self.present)
+
+    @property
+    def nbytes(self) -> int:
+        """Content bytes on the wire (0 for a metadata-only handoff)."""
+        return sum(a.nbytes for a in (self.k, self.v, self.k_scale,
+                                      self.v_scale) if a is not None)
+
+    def strip_contents(self) -> "KVHandoff":
+        """The metadata-only view (the warm-handoff wire form)."""
+        return dataclasses.replace(self, k=None, v=None, k_scale=None,
+                                   v_scale=None, present=[])
+
+    def strip_to(self, pages: Sequence[int]) -> "KVHandoff":
+        """The wire form carrying only ``pages`` (absolute indices) —
+        the partial-residency handoff: pages the target already holds
+        by chain hash are dropped from the payload instead of being
+        serialized, shipped, and discarded."""
+        pages = list(pages)
+        if not pages:
+            return self.strip_contents()
+        have = self.page_list
+        sel = [have.index(i) for i in pages]    # raises on a bad strip
+        rep = {"present": pages}
+        for name in ("k", "v", "k_scale", "v_scale"):
+            a = getattr(self, name)
+            rep[name] = a[:, sel] if a is not None else None
+        return dataclasses.replace(self, **rep)
+
+
+def handoff_page_bytes(*, n_layers: int, page_size: int, n_heads: int,
+                       head_dim: int, itemsize: int,
+                       quantized: bool) -> int:
+    """Analytic content bytes one handoff page carries — K and V across
+    all layers (+ their f32 scale lanes when quantized).  The figure
+    ``bench.py --infer --disagg`` checks the measured
+    ``serve_handoff_bytes_total`` against: int8 caches move
+    ``head_dim + 4`` bytes per cached vector vs ``head_dim * itemsize``
+    for the model dtype — ~half of bf16, the disagg wire saving."""
+    per_vector = head_dim * itemsize + (4 if quantized else 0)
+    return 2 * n_layers * page_size * n_heads * per_vector
+
+
+def export_pages(cache: "KVCache", pages: Sequence[int]
+                 ) -> Dict[str, np.ndarray]:
+    """Read ``pages``' K/V contents out of the device cache, host-side:
+    ``{"k", "v"[, "k_scale", "v_scale"]}`` stacked ``[L, n_pages, ...]``
+    in page order.  One gather per array (a DMA on a real device; the
+    in-place object-store put is the on-chip follow-up)."""
+    idx = np.asarray(list(pages), np.int32)
+    out = {"k": np.asarray(cache.k[:, idx]),
+           "v": np.asarray(cache.v[:, idx])}
+    if cache.quantized:
+        out["k_scale"] = np.asarray(cache.k_scale[:, idx])
+        out["v_scale"] = np.asarray(cache.v_scale[:, idx])
+    return out
+
+
+def import_pages(cache: "KVCache", pages: Sequence[int],
+                 handoff: "KVHandoff", sel: Sequence[int]) -> None:
+    """Write the handoff's pages ``sel`` into ``cache`` at page indices
+    ``pages`` (aligned sequences).  Runs between engine ticks on the
+    host — a functional ``.at[].set`` that the next compiled step's
+    donated state picks up; pages the importer already holds by content
+    hash are simply absent from ``sel`` (the skip-transfer path)."""
+    if not len(pages):
+        return
+    idx = np.asarray(list(pages), np.int32)
+    sel = np.asarray(list(sel), np.int64)
+    cache.k = cache.k.at[:, idx].set(handoff.k[:, sel])
+    cache.v = cache.v.at[:, idx].set(handoff.v[:, sel])
+    if cache.quantized:
+        cache.k_scale = cache.k_scale.at[:, idx].set(
+            handoff.k_scale[:, sel])
+        cache.v_scale = cache.v_scale.at[:, idx].set(
+            handoff.v_scale[:, sel])
 
 
 class PrefixIndex:

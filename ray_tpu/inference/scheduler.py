@@ -120,6 +120,14 @@ class Request:
     chain_hashes: Optional[List[bytes]] = None
     n_hit_pages: int = 0
     cached_tokens: int = 0
+    # disaggregated serving (r20): ``hold_pages`` keeps the request's
+    # page references refcounted past retirement (the prefill-side
+    # export seam — released by export_request/release_held); a
+    # non-None ``import_payload`` (a kv_cache.KVHandoff) marks a
+    # decode-side import, admitted like any request but installed from
+    # the payload instead of prefilled
+    hold_pages: bool = False
+    import_payload: Optional[Any] = None
 
 
 class SlotScheduler:
@@ -186,14 +194,20 @@ class SlotScheduler:
         logits seed the first sampled token, so at least one suffix
         token must always prefill."""
         if self.prefix_index is None:
-            req.chain_hashes = []
+            req.chain_hashes = req.chain_hashes or []
             return []
         if req.chain_hashes is None:
             req.chain_hashes = PrefixIndex.chain_hashes(
                 req.prompt, self.page_size)
         hits: List[int] = []
-        eligible = PrefixIndex.hit_eligible(len(req.prompt),
-                                            self.page_size)
+        # an imported request (r20 disagg) never prefills: EVERY full
+        # context page is hit-eligible, including the one holding the
+        # final context token — its logits were already consumed on the
+        # prefill side, so nothing here needs to re-run
+        eligible = (len(req.chain_hashes)
+                    if req.import_payload is not None
+                    else PrefixIndex.hit_eligible(len(req.prompt),
+                                                  self.page_size))
         for h_i in req.chain_hashes[:eligible]:
             page = self.prefix_index.lookup(h_i)
             if page is None:
@@ -274,6 +288,23 @@ class SlotScheduler:
         req = self.active.pop(slot)
         self.allocator.release(req.pages)
         req.pages = None
+        req.slot = None
+        req.done = True
+        self.page_table[slot, :] = GARBAGE_PAGE
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+        return req
+
+    def retire_hold(self, slot: int) -> Request:
+        """Retire like :meth:`retire` but KEEP the request's page
+        references (``req.pages`` stays set, refcounts unmoved) — the
+        disaggregation export seam: the slot frees for the next
+        admission while the cached K/V survives for
+        ``export_request``.  The engine owns the held request from
+        here; the leak audit stays red until the pages are released
+        (export or the failure path), which is exactly how orphaned
+        exports are caught."""
+        req = self.active.pop(slot)
         req.slot = None
         req.done = True
         self.page_table[slot, :] = GARBAGE_PAGE

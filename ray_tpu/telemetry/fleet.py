@@ -6,11 +6,16 @@ draining one, a full queue), every replica restart, per-replica queue
 depth, and the prefix-affinity routing hit rate.  r19 adds the
 gray-failure series: every hedge split by outcome (``issued`` /
 ``won`` / ``wasted``), every latency demotion, and the per-replica
-EWMA latency score.  Sinks mirror r09: Prometheus through the control
-plane when a session is up (``serve_router_retries_total`` /
-``serve_replica_restarts_total`` / ``serve_hedges_total`` /
-``serve_replica_demotions_total`` counters,
-``serve_replica_queue_depth`` / ``serve_replica_latency_score`` /
+EWMA latency score.  r20 adds the disaggregation series: every KV
+handoff (bytes moved, wall seconds, pages, warm skips), per-pool
+queue-depth gauges, and TTFT split by pool mode (``disagg`` vs
+``colocated`` — the A/B the split exists for).  Sinks mirror r09:
+Prometheus through the control plane when a session is up
+(``serve_router_retries_total`` / ``serve_replica_restarts_total`` /
+``serve_hedges_total`` / ``serve_replica_demotions_total`` /
+``serve_handoff_bytes_total`` counters, ``serve_handoff_seconds`` /
+``serve_ttft_seconds`` histograms, ``serve_replica_queue_depth`` /
+``serve_replica_latency_score`` / ``serve_pool_queue_depth`` /
 ``serve_fleet_affinity_hit_rate`` gauges), and :meth:`summary` as the
 ``fleet`` block of ``bench.py --infer --replicas N`` JSON.
 
@@ -19,10 +24,16 @@ plane when a session is up (``serve_router_retries_total`` /
 
 from __future__ import annotations
 
+import statistics
 import time
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 from ray_tpu.telemetry.config import telemetry_config
+
+_HANDOFF_BOUNDARIES = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                       0.01, 0.025, 0.05, 0.1, 0.25, 1.0]
+_TTFT_BOUNDARIES = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0]
 
 
 class FleetTelemetry:
@@ -47,10 +58,20 @@ class FleetTelemetry:
         self.hedges: Dict[str, int] = {}
         self.replica_demotions = 0
         self.latency_scores: Dict[str, float] = {}
+        # r20 disaggregation series: handoff accounting + per-pool
+        # depth gauges + TTFT populations split by pool mode
+        self.handoffs = 0
+        self.handoffs_skipped = 0
+        self.handoff_bytes = 0
+        self.handoff_pages = 0
+        self.handoff_s: List[float] = []
+        self.pool_depths: Dict[str, int] = {}
+        self.ttfts_by_mode: Dict[str, List[float]] = {}
         self._metrics = None
         self._metrics_dead = False
         self._depth_last: Dict[str, float] = {}
         self._latency_last: Dict[str, float] = {}
+        self._pool_last: Dict[str, float] = {}
         self._rate_last = 0.0
 
     # ---------------------------------------------------------- records
@@ -109,6 +130,54 @@ class FleetTelemetry:
         self._latency_last[replica_id] = now
         self._emit_latency(replica_id, score)
 
+    _MAX_RECORDS = 10_000
+
+    def record_handoff(self, *, n_bytes: int, seconds: float,
+                       pages: int, skipped: bool = False) -> None:
+        """One prefill→decode KV handoff (r20): content bytes moved
+        through the object store (0 for a warm, metadata-only handoff
+        — counted in ``handoffs_skipped``), wall seconds export→import,
+        and the page count behind the byte math."""
+        if not self.enabled:
+            return
+        self.handoffs += 1
+        if skipped:
+            self.handoffs_skipped += 1
+        self.handoff_bytes += int(n_bytes)
+        self.handoff_pages += int(pages)
+        if len(self.handoff_s) < self._MAX_RECORDS:
+            self.handoff_s.append(float(seconds))
+        self._emit_handoff(n_bytes, seconds)
+
+    def record_pool_depth(self, pool: str, depth: int) -> None:
+        """Aggregate queue depth of one pool (``prefill`` /
+        ``decode``) — the disagg scale signals: prefill backlog is
+        admission pressure, decode backlog is slot occupancy
+        (throttled per pool; the router records every poll)."""
+        if not self.enabled:
+            return
+        self.pool_depths[pool] = int(depth)
+        if self._metrics_dead:
+            return
+        now = time.monotonic()
+        if now - self._pool_last.get(pool, 0.0) < self._EMIT_INTERVAL_S:
+            return
+        self._pool_last[pool] = now
+        self._emit_pool_depth(pool, depth)
+
+    def record_ttft(self, seconds: float, *, mode: str) -> None:
+        """Per-request time-to-first-token, split by pool mode
+        (``disagg`` when a dedicated prefill pool served it,
+        ``colocated`` for the single-pool fleet) — the comparison the
+        split exists for: prefill interference shows up exactly here
+        and in the decode inter-token tail."""
+        if not self.enabled:
+            return
+        bucket = self.ttfts_by_mode.setdefault(mode, [])
+        if len(bucket) < self._MAX_RECORDS:
+            bucket.append(float(seconds))
+        self._emit_ttft(seconds, mode)
+
     def record_affinity(self, *, hit: bool) -> None:
         """One routing decision with affinity enabled: ``hit`` when a
         prefix-digest match picked the replica (the fleet-wide cache
@@ -153,6 +222,19 @@ class FleetTelemetry:
         """The ``fleet`` block for multi-replica bench JSON."""
         if not self.enabled:
             return {"enabled": False}
+
+        def pct(xs, q):
+            return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+        ttft_by_mode = {}
+        for mode, xs in self.ttfts_by_mode.items():
+            srt = sorted(xs)
+            ttft_by_mode[mode] = {
+                "count": len(srt),
+                "mean_s": statistics.fmean(srt) if srt else 0.0,
+                "p50_s": pct(srt, 0.50) if srt else 0.0,
+                "p99_s": pct(srt, 0.99) if srt else 0.0,
+            }
         return {
             "enabled": True, "label": self.label,
             "router_retries": dict(self.retries),
@@ -165,6 +247,17 @@ class FleetTelemetry:
             "hedges": dict(self.hedges),
             "replica_demotions": self.replica_demotions,
             "replica_latency_score": dict(self.latency_scores),
+            # r20 disaggregation block
+            "handoffs": self.handoffs,
+            "handoffs_skipped": self.handoffs_skipped,
+            "handoff_bytes_total": self.handoff_bytes,
+            "handoff_pages_total": self.handoff_pages,
+            "handoff_s_mean": (statistics.fmean(self.handoff_s)
+                               if self.handoff_s else 0.0),
+            "handoff_s_max": (max(self.handoff_s)
+                              if self.handoff_s else 0.0),
+            "pool_queue_depth": dict(self.pool_depths),
+            "ttft_s_by_mode": ttft_by_mode,
         }
 
     # ------------------------------------------------------- prometheus
@@ -173,7 +266,7 @@ class FleetTelemetry:
         if not is_initialized():
             return None
         if self._metrics is None:
-            from ray_tpu.util.metrics import Counter, Gauge
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
             self._metrics = {
                 "retries": Counter(
                     "serve_router_retries_total",
@@ -208,6 +301,28 @@ class FleetTelemetry:
                     "EWMA engine-tick wall seconds for one replica "
                     "(the gray-failure health score)",
                     tag_keys=("label", "replica")),
+                "handoff_bytes": Counter(
+                    "serve_handoff_bytes_total",
+                    "KV-page content bytes moved prefill->decode "
+                    "through the object store (warm handoffs move 0)",
+                    tag_keys=("label",)),
+                "handoff_s": Histogram(
+                    "serve_handoff_seconds",
+                    "wall seconds per KV handoff, export through "
+                    "decode-side admission",
+                    boundaries=_HANDOFF_BOUNDARIES,
+                    tag_keys=("label",)),
+                "pool_depth": Gauge(
+                    "serve_pool_queue_depth",
+                    "aggregate waiting + active requests in one "
+                    "disagg pool (prefill / decode)",
+                    tag_keys=("label", "pool")),
+                "ttft": Histogram(
+                    "serve_ttft_seconds",
+                    "per-request time-to-first-token, split by pool "
+                    "mode (disagg / colocated)",
+                    boundaries=_TTFT_BOUNDARIES,
+                    tag_keys=("label", "mode")),
             }
         return self._metrics
 
@@ -241,6 +356,41 @@ class FleetTelemetry:
                 metrics["latency"].set(
                     float(score),
                     tags={"label": self.label, "replica": replica_id})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_handoff(self, n_bytes: int, seconds: float):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["handoff_bytes"].inc(
+                    float(n_bytes), tags={"label": self.label})
+                metrics["handoff_s"].observe(
+                    float(seconds), tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_pool_depth(self, pool: str, depth: int):
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["pool_depth"].set(
+                    float(depth),
+                    tags={"label": self.label, "pool": pool})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_ttft(self, seconds: float, mode: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["ttft"].observe(
+                    float(seconds),
+                    tags={"label": self.label, "mode": mode})
         except Exception:  # noqa: BLE001 — never tax the router
             self._metrics_dead = True
 

@@ -65,7 +65,16 @@ itself).  Current sites:
 - ``mesh.step`` — per-step train-loop latency: a ``:delay=`` window
   stretches step wall time (a straggling host gates the synchronous
   step), which the straggler supervisor must detect and convert into
-  a degraded-mesh shrink instead of stalling the run forever.
+  a degraded-mesh shrink instead of stalling the run forever;
+- ``serve.handoff`` — the r20 disaggregated prefill→decode KV-page
+  handoff: fires on BOTH legs of every transfer (once on the export
+  leg, before the pages leave the prefill replica's allocator, and
+  once on the import leg, before the decode side admits), so hits
+  count two per handoff and a plan can fault either side — or
+  ``:delay=`` the transfer itself.  Any fault degrades to the
+  re-prefill-from-prompt failover with the held pages and the
+  in-flight store object released (the disagg leak audit covers
+  both).
 
 Spec grammar: comma-separated entries::
 

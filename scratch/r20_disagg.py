@@ -1,0 +1,57 @@
+"""Round-20 on-chip driver: disaggregated prefill/decode serving.
+
+Usage: python scratch/r20_disagg.py <variant>
+
+Variants:
+  disagg — `bench.py --infer --replicas 3 --disagg`: the split-pool
+           A/B on real hardware — N co-located replicas vs 1 prefill +
+           N-1 decode at equal chip count, plus the int8-KV arm.
+           Reports p50/p99 TTFT, decode inter-token p99, aggregate
+           tok/s, and handoff bytes vs the analytic page math (int8
+           arm ~ (head_dim+4)/(2*head_dim) of the bf16 arm's bytes).
+           The chip question host-sim cannot answer: on one CPU the
+           sequential drive loop serializes both pools, so the
+           co-located arm's prefill-vs-decode interference — the whole
+           reason to disaggregate (arXiv:2011.03641) — never shows in
+           the tails.  On chips, each replica owns a device: the
+           co-located arm's decode p99 inter-token should inherit the
+           prefill bucket wall (tens of ms spikes) while the disagg
+           arm's decode pool ticks free of it, and the handoff cost
+           (one object-store round trip per request, halved by int8)
+           is the price to beat.
+
+Carried arms (no chip session yet; every r06-r19 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+gray / straggle plus all r6-r18 arms — delegated verbatim to
+scratch/r19_gray.py.
+"""
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "disagg"
+
+_R19_ARMS = ("gray", "straggle",
+             "elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R19_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r19_gray.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+assert VARIANT == "disagg", f"unknown variant {VARIANT!r}"
+
+ROOT = os.path.dirname(HERE)
+sys.exit(subprocess.run(
+    [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+     "--replicas", "3", "--disagg"] + sys.argv[2:]).returncode)

@@ -363,6 +363,15 @@ def test_fleet_telemetry_summary():
     tel.record_latency_score("r1", 0.31)
     with pytest.raises(ValueError, match="issued"):
         tel.record_hedge("lost")
+    # r20 disaggregation series: handoff bytes/seconds/pages (+ warm
+    # skips), per-pool depth gauges, TTFT split by pool mode
+    tel.record_handoff(n_bytes=4096, seconds=0.002, pages=2)
+    tel.record_handoff(n_bytes=0, seconds=0.001, pages=0, skipped=True)
+    tel.record_pool_depth("prefill", 3)
+    tel.record_pool_depth("decode", 1)
+    tel.record_ttft(0.02, mode="disagg")
+    tel.record_ttft(0.04, mode="disagg")
+    tel.record_ttft(0.05, mode="colocated")
     out = tel.summary()
     assert out["enabled"] and out["label"] == "fleet"
     assert out["router_retries"] == {"dead": 2, "draining": 1,
@@ -375,6 +384,18 @@ def test_fleet_telemetry_summary():
     assert out["hedges"] == {"issued": 2, "won": 1, "wasted": 1}
     assert out["replica_demotions"] == 1
     assert out["replica_latency_score"] == {"r0": 0.002, "r1": 0.31}
+    assert out["handoffs"] == 2 and out["handoffs_skipped"] == 1
+    assert out["handoff_bytes_total"] == 4096
+    assert out["handoff_pages_total"] == 2
+    assert out["handoff_s_mean"] == pytest.approx(0.0015)
+    assert out["handoff_s_max"] == pytest.approx(0.002)
+    assert out["pool_queue_depth"] == {"prefill": 3, "decode": 1}
+    assert out["ttft_s_by_mode"]["disagg"]["count"] == 2
+    assert out["ttft_s_by_mode"]["disagg"]["mean_s"] == \
+        pytest.approx(0.03)
+    assert out["ttft_s_by_mode"]["disagg"]["p99_s"] == \
+        pytest.approx(0.04)
+    assert out["ttft_s_by_mode"]["colocated"]["count"] == 1
     # a stopped replica's gauge state drops out of the snapshot
     tel.forget_replica("r1")
     assert tel.summary()["replica_queue_depth"] == {"r0": 3}
@@ -386,6 +407,9 @@ def test_fleet_telemetry_summary():
     off.record_hedge("issued")
     off.record_demotion("r0")
     off.record_latency_score("r0", 1.0)
+    off.record_handoff(n_bytes=1, seconds=0.1, pages=1)
+    off.record_pool_depth("prefill", 1)
+    off.record_ttft(0.1, mode="disagg")
     assert off.summary() == {"enabled": False}
 
 
@@ -533,6 +557,10 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     fleet.record_hedge("won")
     fleet.record_demotion("r0")
     fleet.record_latency_score("r0", 0.25)
+    fleet.record_handoff(n_bytes=2048, seconds=0.003, pages=2)
+    fleet.record_pool_depth("prefill", 2)
+    fleet.record_pool_depth("decode", 0)
+    fleet.record_ttft(0.02, mode="disagg")
 
     text = requests.get(f"http://127.0.0.1:{port}/metrics",
                         timeout=10).text
@@ -569,3 +597,11 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "serve_replica_demotions_total" in text
     assert "serve_replica_latency_score" in text
     assert "train_straggler_events_total" in text
+    # r20 disaggregation series: handoff bytes counter + seconds
+    # histogram, per-pool depth gauges, TTFT-by-pool-mode histogram
+    assert "serve_handoff_bytes_total" in text
+    assert "user_histogram_serve_handoff_seconds_bucket" in text
+    assert "serve_pool_queue_depth" in text
+    assert 'pool="prefill"' in text and 'pool="decode"' in text
+    assert "user_histogram_serve_ttft_seconds_bucket" in text
+    assert 'mode="disagg"' in text
