@@ -884,6 +884,153 @@ def bench_infer():
     print(json.dumps(result))
 
 
+def bench_infer_spec():
+    """Speculative-decoding headline: self-drafting draft-and-verify.
+
+    ``python bench.py --infer --spec``.  Runs the latency-bound
+    sequential-decode regime (one request in flight — the decode-tier
+    shape the disagg split carves out, where every emitted token costs
+    a full dispatch) over two traffic mixes: ``templated`` (shared
+    system prefix plus a per-request motif repeated verbatim — the
+    structured traffic self-drafting targets) and ``random`` (i.i.d.
+    prompt tokens — the adversarial mix where drafts mostly miss and
+    speculation must not lose much).  Arms: speculation off and
+    ``k`` in {2, 4, 8}, greedy sampling throughout.  Prints ONE JSON
+    line — per-arm decode tokens/s and speedup vs the off arm, accept
+    rate and per-verify accepted-token histogram, p99 inter-token gap
+    (accepted bursts land together, so the spec arms' gap distribution
+    collapses toward zero between dispatch walls), bit-exact output
+    parity vs the off arm (the exactness claim, in the artifact), the
+    compile counters (measured engines ride a warmed executable cache:
+    zero compiles, verify buckets included) and the leak audit (pages,
+    slots and drafter states all released after every arm).  On CPU
+    the model shrinks to a smoke configuration whose greedy
+    trajectories collapse into repetition loops — the drafter's
+    high-accept regime; real structured traffic reaches it through
+    template/quote copying instead.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.inference import InferenceEngine, SamplingParams
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    if quick:
+        cfg = GPTConfig(vocab_size=256, d_model=64, n_layers=2,
+                        n_heads=4, max_seq=512, dtype=jnp.float32)
+        requests, max_new = 4, 384
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        requests, max_new = 4, 512
+    slots, page = 2, 16
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(1)
+    shared = rng.randint(0, cfg.vocab_size, 48).tolist()
+    mixes = {
+        # shared system prefix + a per-request 6-token motif repeated
+        # 4x: the trailing-n-gram index locks onto the motif period
+        # immediately, and the tiny greedy model's own repetition
+        # loops extend the high-accept stretch through the generation
+        "templated": [shared + rng.randint(0, cfg.vocab_size, 6)
+                      .tolist() * 4 for _ in range(requests)],
+        "random": [rng.randint(0, cfg.vocab_size, 72).tolist()
+                   for _ in range(requests)],
+    }
+
+    def pct(xs, q):
+        return round(sorted(xs)[int(q * (len(xs) - 1))], 6) if xs \
+            else None
+
+    def run_arm(prompts, k, executables, measure):
+        sp = SamplingParams(spec=k > 0, spec_k=k if k else None)
+        eng = InferenceEngine(cfg, params, slots=slots,
+                              page_size=page, telemetry=measure,
+                              max_queue=0, executable_cache=executables)
+        free0 = eng.stats()["free_pages"]
+        outs, gaps = [], []
+        t0 = _time.perf_counter()
+        for p in prompts:
+            eng.submit(p, max_new_tokens=max_new, sampling=sp)
+            toks, first = [], True
+            last = _time.perf_counter()
+            while eng.has_work():
+                for ev in eng.step():
+                    now = _time.perf_counter()
+                    if first:
+                        first = False       # prefill TTFT, not a gap
+                    else:
+                        gaps.append(now - last)
+                    last = now
+                    toks.append(ev[1])
+            outs.append(toks)
+        dt = _time.perf_counter() - t0
+        st = eng.stats()
+        tel = eng.telemetry.summary() if measure else {}
+        leak_free = (st["free_pages"] == free0
+                     and st["free_slots"] == slots
+                     and st["spec"]["drafts"] == 0)
+        return {"outs": outs, "wall_s": dt, "gaps": gaps, "stats": st,
+                "telemetry": tel, "leak_free": leak_free}
+
+    # one warmup engine per arm shape is wasteful — a single shared
+    # executable cache covers every arm (prefill bucket, cached-
+    # context prefill for the shared-prefix hit — hence two warmup
+    # prompts — decode, and one verify executable per power-of-two k
+    # bucket), so the first pass compiles and every measured engine
+    # below shows zero
+    executables = {}
+    for k in (0, 2, 4, 8):
+        run_arm(mixes["templated"][:2], k, executables, measure=False)
+
+    arms = {}
+    for mix, prompts in mixes.items():
+        base = None
+        for k in (0, 2, 4, 8):
+            a = run_arm(prompts, k, executables, measure=True)
+            tps = a["telemetry"].get("decode_tokens_per_sec", 0.0)
+            if k == 0:
+                base = {"tps": tps, "outs": a["outs"]}
+            spec = a["stats"]["spec"]
+            arms[f"{mix}_k{k}"] = {
+                "decode_tokens_per_sec": round(tps, 1),
+                "speedup_vs_off": round(tps / base["tps"], 3)
+                if base["tps"] else None,
+                "accept_rate": round(spec["accept_rate"], 4),
+                "accepted_hist": spec["k_hist"],
+                "inter_token_p50_s": pct(a["gaps"], 0.50),
+                "inter_token_p99_s": pct(a["gaps"], 0.99),
+                "greedy_parity": a["outs"] == base["outs"],
+                "compiles": a["stats"]["compiles"],
+                "leak_free": a["leak_free"],
+                "wall_s": round(a["wall_s"], 3),
+            }
+
+    result = {
+        "metric": "gpt2_infer_spec_decode_speedup",
+        # headline: the templated mix at the default draft budget
+        "value": arms["templated_k4"]["speedup_vs_off"],
+        "unit": "decode tok/s at spec_k=4 vs non-speculative "
+                "(templated mix, sequential requests)",
+        "platform": platform,
+        "model_params": None if quick else 124_000_000,
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "slots": slots,
+        "page_size": page,
+        "arms": arms,
+    }
+    print(json.dumps(result))
+
+
 def bench_rl():
     """RL-loop headline: open-loop actor/learner co-run.
 
@@ -1227,7 +1374,9 @@ def main():
         return
     if "--infer" in sys.argv:
         n = _replicas_arg()
-        if "--gray" in sys.argv:
+        if "--spec" in sys.argv:
+            bench_infer_spec()
+        elif "--gray" in sys.argv:
             # the demotion median wants an odd-one-out: 3+ replicas
             bench_infer_gray(n if n > 1 else 3)
         elif "--disagg" in sys.argv or _fleet_disagg_env():

@@ -22,11 +22,12 @@ from ray_tpu.inference.sampling import SamplingParams  # noqa: F401
 from ray_tpu.inference.scheduler import (DeadlineExceededError,  # noqa: F401
                                          QueueFullError,
                                          Request, SlotScheduler)
+from ray_tpu.inference.spec import DraftState  # noqa: F401
 
 __all__ = [
     "InferConfig", "infer_config", "default_buckets",
     "InferenceEngine", "StepEvent", "KVCache", "PageAllocator",
     "PrefixIndex", "KVHandoff", "HandoffContentMissing",
     "SamplingParams", "QueueFullError", "DeadlineExceededError",
-    "Request", "SlotScheduler",
+    "Request", "SlotScheduler", "DraftState",
 ]

@@ -85,6 +85,17 @@ class InferConfig:
       tokens waiting but has not been pumped for the budget —
       releasing its slot/pages/prefix refcounts instead of decoding
       to ``max_new_tokens`` for a reader that is gone.
+    - ``RAY_TPU_INFER_SPEC`` (default ``0`` = off): speculative
+      decoding default — the zero-parameter self-drafter proposes up
+      to ``spec_k`` continuation tokens per slot from the request's
+      own context and one batched verify forward (the cached-context
+      prefill executable, per k-bucket) scores them all; exact
+      acceptance sampling keeps outputs distribution-identical to
+      plain decode (greedy bit-exact, sampled trajectory-exact).
+      Per-request ``SamplingParams.spec`` overrides win.
+    - ``RAY_TPU_INFER_SPEC_K`` (default ``4``): default draft length
+      cap per verify step when speculation is on.  Per-request
+      ``SamplingParams.spec_k`` overrides win.
     """
     slots: int = 8
     page_size: int = 128
@@ -98,6 +109,8 @@ class InferConfig:
     deadline: float = 0.0
     watchdog: float = 0.0
     stream_idle: float = 0.0
+    spec: bool = False
+    spec_k: int = 4
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -143,6 +156,11 @@ def infer_config(refresh: bool = False) -> InferConfig:
                                 "watchdog off")
         stream_idle = nonneg_float("RAY_TPU_INFER_STREAM_IDLE",
                                    "idle-stream reaper off")
+        spec_k = int(env("RAY_TPU_INFER_SPEC_K", "4"))
+        if spec_k < 1:
+            print(f"RAY_TPU_INFER_SPEC_K={spec_k} < 1; using 4",
+                  file=sys.stderr)
+            spec_k = 4
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
@@ -156,6 +174,8 @@ def infer_config(refresh: bool = False) -> InferConfig:
             deadline=deadline,
             watchdog=watchdog,
             stream_idle=stream_idle,
+            spec=env("RAY_TPU_INFER_SPEC", "0") != "0",
+            spec_k=spec_k,
         )
     return _CONFIG
 

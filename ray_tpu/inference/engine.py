@@ -39,6 +39,26 @@ rest written host-side between ticks) and seeds the slot at the
 absolute context offset, so the ordinary fixed-slot decode step
 continues the sequence — neither seam adds an executable.
 
+**Speculative decoding (r21).**  With ``RAY_TPU_INFER_SPEC`` (or a
+per-request ``SamplingParams.spec``) on, each tick plans up to
+``spec_k`` self-drafted tokens per slot (``spec.DraftState`` — n-gram
+copy over the request's own context, zero parameters) and scores them
+all in ONE batched verify forward: the cached-context prefill
+executable run in all-rows mode over the suffix ``[last_token,
+d1..dk]`` at the slot's current length, compiled once per power-of-two
+k-bucket (``verify`` compile counters).  Each verify row is sampled
+under the SAME ``fold_in(seed, n_generated)`` key plain decode would
+use, so accepting a draft iff the sampled token equals it reproduces
+the plain trajectory exactly (greedy bit-exact, sampled
+trajectory-exact) — speculation is a pure throughput transform.  A
+rejected tail rolls back by simply not advancing the slot's length:
+the stale K/V beyond it is length-masked and overwritten by the next
+writes, and the write window is slot-private by r12's
+never-write-shared invariant (asserted before every dispatch).
+Speculating and plain slots co-batch in one tick: the plain decode
+step runs with speculating slots' page-table rows masked to the
+garbage page, then each speculating slot verifies.
+
 The steps themselves derive from the training model: ``embed`` +
 ``layer_apply`` with a KV-cache hook threaded through (post-RoPE keys
 written to the paged cache, decode attention over the gathered pages
@@ -55,9 +75,10 @@ ROADMAP item.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -66,10 +87,11 @@ from jax import lax
 
 from ray_tpu.inference import kv_cache as kvc
 from ray_tpu.inference.config import default_buckets, infer_config
-from ray_tpu.inference.sampling import (SamplingParams,
+from ray_tpu.inference.sampling import (SamplingParams, accept_drafts,
                                         sample_tokens_logprobs)
 from ray_tpu.inference.scheduler import (DeadlineExceededError,
                                          Request, SlotScheduler)
+from ray_tpu.inference.spec import DraftState
 from ray_tpu.models import gpt as gpt_mod
 from ray_tpu.ops.attention import _NEG_INF
 
@@ -175,6 +197,8 @@ class InferenceEngine:
                  max_queue: Optional[int] = None,
                  ttft_deadline: Optional[float] = None,
                  deadline: Optional[float] = None,
+                 spec: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
                  executable_cache: Optional[Dict[Any, Any]] = None):
@@ -198,6 +222,13 @@ class InferenceEngine:
                               is None else float(ttft_deadline)) or None
         self.deadline = (icfg.deadline if deadline is None
                          else float(deadline)) or None
+        # speculative-decoding defaults; per-request SamplingParams
+        # overrides win (resolved once at submit onto Request.spec_k)
+        self.spec = icfg.spec if spec is None else bool(spec)
+        self.spec_k = icfg.spec_k if spec_k is None else int(spec_k)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k} "
+                             "(check RAY_TPU_INFER_SPEC_K)")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"unknown kv_dtype {self.kv_dtype!r} "
                              "(check RAY_TPU_KV_DTYPE)")
@@ -238,10 +269,22 @@ class InferenceEngine:
                           max_pages_per_slot, self.decode_impl,
                           self.kv_dtype)
         self.compile_counts: Dict[str, int] = {
-            "prefill": 0, "prefill_cached": 0, "decode": 0}
+            "prefill": 0, "prefill_cached": 0, "decode": 0,
+            "verify": 0}
         self.hit_counts: Dict[str, int] = {
-            "prefill": 0, "prefill_cached": 0, "decode": 0}
+            "prefill": 0, "prefill_cached": 0, "decode": 0,
+            "verify": 0}
         self._requests: Dict[int, Request] = {}
+        # speculative-decoding state: per-request drafter indexes
+        # (popped at retirement — any terminal path — and bulk-cleared
+        # by drain_requests so the reaped-corpse audit stays clean)
+        # plus cumulative accept accounting for stats()/telemetry
+        self._drafts: Dict[int, DraftState] = {}
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        # accepted-per-verify histogram: m -> number of verify steps
+        # that accepted exactly m drafts
+        self.spec_k_hist: Dict[int, int] = {}
         # retired-but-held requests (r20 disagg export seam): pages
         # stay refcounted until export_request/release_held — the leak
         # audit counts them, so an orphaned export is visible
@@ -278,6 +321,20 @@ class InferenceEngine:
                 max_pages_per_slot))
 
     # --------------------------------------------------------- requests
+    def _resolve_spec_k(self, sampling: SamplingParams) -> int:
+        """The request's speculative draft budget (0 = plain decode):
+        per-request ``SamplingParams.spec``/``spec_k`` override the
+        engine defaults, resolved ONCE here so the hot planning loop
+        reads a plain int off the request."""
+        on = self.spec if sampling.spec is None else bool(sampling.spec)
+        if not on:
+            return 0
+        k = (self.spec_k if sampling.spec_k is None
+             else int(sampling.spec_k))
+        if k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        return k
+
     def submit(self, prompt, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None,
                eos_token: Optional[int] = None,
@@ -314,7 +371,9 @@ class InferenceEngine:
                                            or None),
                           deadline_s=(self.deadline if deadline_s
                                       is None else deadline_s or None),
-                          hold_pages=bool(hold_pages))
+                          hold_pages=bool(hold_pages),
+                          spec_k=self._resolve_spec_k(
+                              sampling or SamplingParams()))
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
             depth = len(self.scheduler.waiting)
@@ -355,6 +414,10 @@ class InferenceEngine:
         held = list(self._held)
         for rid in held:
             self.release_held(rid)
+        # any in-flight drafter state goes with the requests — a
+        # reaped replica must not leak per-request indexes either
+        # (stats()["spec"]["drafts"] is the audit's counter)
+        self._drafts.clear()
         return len(rids) + len(held)
 
     # --------------------------------------------- disagg handoff (r20)
@@ -452,7 +515,9 @@ class InferenceEngine:
                           deadline_s=(self.deadline if deadline_s
                                       is None else deadline_s or None),
                           chain_hashes=list(handoff.chain_hashes),
-                          import_payload=handoff)
+                          import_payload=handoff,
+                          spec_k=self._resolve_spec_k(
+                              sampling or SamplingParams()))
             self.scheduler.submit(req)    # validates; may raise
             self._requests[rid] = req
             depth = len(self.scheduler.waiting)
@@ -470,6 +535,7 @@ class InferenceEngine:
                 if req.rid in cancelled:
                     sched.retire(slot)
                     self._requests.pop(req.rid, None)
+                    self._drafts.pop(req.rid, None)
             for req in [r for r in sched.waiting
                         if r.rid in cancelled]:
                 sched.waiting.remove(req)
@@ -515,6 +581,7 @@ class InferenceEngine:
                     sched.retire(slot)
                     req.error = err
                     self._requests.pop(req.rid, None)
+                    self._drafts.pop(req.rid, None)
                     expired.append(req)
         for req in expired:
             self.deadline_exceeded += 1
@@ -602,6 +669,17 @@ class InferenceEngine:
             "exports": self.exports,
             "imports": self.imports,
             "held": len(self._held),
+            # speculative decoding (r21): cumulative draft accounting
+            # plus live drafter-state count (the reaped-corpse audit —
+            # a drained engine must read drafts == 0)
+            "spec": {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+                "k_hist": dict(sorted(self.spec_k_hist.items())),
+                "drafts": len(self._drafts),
+            },
         }
 
     # ------------------------------------------------------ engine tick
@@ -622,7 +700,15 @@ class InferenceEngine:
             else:
                 self._prefill(req, events)
         if self.scheduler.active:
-            self._decode(events)
+            # speculating slots leave the plain decode batch for this
+            # tick (their verify forward IS their decode) and plain
+            # slots co-batch as always; an all-speculating tick skips
+            # the decode dispatch entirely
+            plan = self._plan_speculation()
+            if len(plan) < len(self.scheduler.active):
+                self._decode(events, skip=set(plan))
+            for slot, drafts in plan.items():
+                self._verify(slot, drafts, events)
         self.ticks += 1
         self.last_tick_ts = time.monotonic()
         return events
@@ -770,7 +856,7 @@ class InferenceEngine:
         self.imports += 1
 
     # ----------------------------------------------------------- decode
-    def _decode(self, events) -> None:
+    def _decode(self, events, skip: Optional[Set[int]] = None) -> None:
         from ray_tpu.util import chaos, tracing
 
         # fault site BEFORE any cache/scheduler mutation and before the
@@ -778,23 +864,35 @@ class InferenceEngine:
         # leaves the engine state consistent (slots/pages still held,
         # cache arrays live), so supervisors can cancel/drain cleanly
         chaos.maybe_fail("infer.decode")
+        skip = skip or set()
         sched = self.scheduler
         tokens = np.zeros((self.slots,), np.int32)
         reqs: List[Optional[Request]] = [None] * self.slots
         for slot, req in sched.active.items():
+            if slot in skip:
+                continue
             tokens[slot] = req.generated[-1]
             reqs[slot] = req
         active = [r for r in reqs if r is not None]
+        page_table = sched.page_table
+        if skip:
+            # speculating slots ride this dispatch as dead rows (the
+            # decode step's shape is fixed): their page rows mask to
+            # the garbage page so the batched K/V write cannot touch
+            # the positions their verify forward is about to fill, and
+            # their sampled outputs are never delivered
+            page_table = page_table.copy()
+            page_table[list(skip), :] = kvc.GARBAGE_PAGE
         t0 = time.monotonic()
         with tracing.span("infer/decode", active=len(active)):
             fn = self._get_compiled(
                 ("decode",), self._build_decode,
                 (self.params, *self.cache.state, tokens,
-                 sched.lengths, sched.page_table),
+                 sched.lengths, page_table),
                 kind="decode")
             logits, *state = fn(
                 self.params, *self.cache.state, tokens,
-                sched.lengths, sched.page_table)
+                sched.lengths, page_table)
             self.cache.state = tuple(state)
             sampled, logps = self._sample_slots(logits, reqs)
         wall = time.monotonic() - t0
@@ -803,6 +901,8 @@ class InferenceEngine:
         if self.debug_logits:
             host_logits = np.asarray(logits)
         for slot in list(sched.active):
+            if slot in skip:
+                continue
             req = sched.active[slot]
             sched.lengths[slot] += 1     # the input token is now cached
             if self.debug_logits:
@@ -810,6 +910,123 @@ class InferenceEngine:
                     host_logits[slot])
             self._deliver(req, int(sampled[slot]),
                           float(logps[slot]), events)
+
+    # ---------------------------------------------- speculation (r21)
+    def _plan_speculation(self) -> Dict[int, List[int]]:
+        """slot -> drafted tokens for this tick (empty dict = plain
+        decode for everyone).  A slot speculates when its request
+        opted in (``spec_k > 0``), has more than one token left to
+        generate, and its drafter finds a context match; the draft
+        budget is clipped to the remaining token budget so the verify
+        write window provably stays inside the pages reserved at
+        admission (highest written position = ``len(prompt) +
+        max_new_tokens - 1``, the last reserved token)."""
+        plan: Dict[int, List[int]] = {}
+        for slot, req in self.scheduler.active.items():
+            if req.spec_k <= 0:
+                continue
+            remaining = req.max_new_tokens - len(req.generated)
+            k = min(req.spec_k, remaining)
+            if k < 1:
+                continue
+            ds = self._drafts.get(req.rid)
+            if ds is None:
+                ds = DraftState(req.prompt)
+                self._drafts[req.rid] = ds
+            ds.sync(req.prompt, req.generated)
+            drafts = ds.propose(k)
+            if drafts:
+                plan[slot] = drafts
+        return plan
+
+    @staticmethod
+    def _verify_bucket(n_drafts: int) -> int:
+        """Power-of-two draft-capacity bucket: one verify executable
+        per bucket serves every draft length up to it (suffix_len is a
+        traced scalar), so mixed-k traffic compiles O(log max_k)
+        executables, then zero."""
+        kb = 1
+        while kb < n_drafts:
+            kb *= 2
+        return kb
+
+    def _verify(self, slot: int, drafts: List[int], events) -> None:
+        """Score ``[last_token, d1..dk]`` in ONE cached-context
+        forward (all-rows mode), sample every row under the request's
+        own ``fold_in`` key chain, and emit the accepted prefix plus
+        one more real token (``sampling.accept_drafts``).  The slot's
+        length advances only over emitted tokens — the rejected tail's
+        K/V stays behind the length mask and is overwritten by the
+        next writes, which IS the rollback (the write window is
+        slot-private; asserted below)."""
+        from ray_tpu.util import tracing
+        sched = self.scheduler
+        req = sched.active[slot]
+        L = int(sched.lengths[slot])
+        n_drafts = len(drafts)
+        kb = self._verify_bucket(n_drafts)
+        # never-write-shared: the verify writes positions L..L+k of
+        # this slot — all strictly past every shared/registered page
+        # by construction (full prompt/context pages end before the
+        # first decode position), so rollback can never corrupt a
+        # page another request reads
+        kvc.assert_tail_private(
+            sched.allocator, sched.prefix_index, req.pages,
+            L, L + n_drafts, self.page_size)
+        tokens = np.zeros((1, kb + 1), np.int32)
+        tokens[0, 0] = req.generated[-1]
+        tokens[0, 1:1 + n_drafts] = drafts
+        t0 = time.monotonic()
+        with tracing.span("infer/verify", rid=req.rid, k=n_drafts):
+            args = (self.params, *self.cache.state, tokens,
+                    np.int32(L), np.int32(n_drafts + 1),
+                    sched.page_table[slot])
+            fn = self._get_compiled(
+                ("verify", kb),
+                functools.partial(self._build_prefill_cached,
+                                  all_rows=True),
+                args, kind="verify")
+            logits, *state = fn(*args)
+            self.cache.state = tuple(state)
+            # every row samples under the key plain decode would use
+            # at that position: row i's token lands when generated has
+            # len(generated) + i tokens, so counts advance from there
+            c = len(req.generated)
+            n_rows = kb + 1
+            seeds = np.full((n_rows,), req.sampling.seed, np.int32)
+            counts = c + np.arange(n_rows, dtype=np.int32)
+            temps = np.full((n_rows,), req.sampling.temperature,
+                            np.float32)
+            top_ks = np.full((n_rows,), req.sampling.top_k, np.int32)
+            top_ps = np.full((n_rows,), req.sampling.top_p, np.float32)
+            toks, logps = sample_tokens_logprobs(
+                logits[0], seeds, counts, temps, top_ks, top_ps)
+            toks, logps = np.asarray(toks), np.asarray(logps)
+        wall = time.monotonic() - t0
+        m, emitted = accept_drafts(toks[:n_drafts + 1], drafts)
+        self.spec_proposed += n_drafts
+        self.spec_accepted += m
+        self.spec_k_hist[m] = self.spec_k_hist.get(m, 0) + 1
+        if self.debug_logits:
+            host_logits = np.asarray(logits[0])
+        delivered = 0
+        for i, tok in enumerate(emitted):
+            # the input token of row i (last_token or draft i) is now
+            # cached at position L + i; advancing BEFORE delivery
+            # keeps the decode-step length semantics, and a retire
+            # inside the block (EOS / max_new) resets the slot anyway
+            sched.lengths[slot] = L + i + 1
+            if self.debug_logits:
+                self.logits_trace.setdefault(req.rid, []).append(
+                    host_logits[i])
+            self._deliver(req, int(tok), float(logps[i]), events)
+            delivered += 1
+            if req.done:
+                break
+        if self.telemetry.enabled:
+            self.telemetry.record_verify(
+                wall, proposed=n_drafts, accepted=m,
+                emitted=delivered)
 
     def _deliver(self, req: Request, tok: int, logp: float,
                  events) -> None:
@@ -827,6 +1044,7 @@ class InferenceEngine:
                 self.scheduler.retire(req.slot)
             if self.telemetry.enabled:
                 self.telemetry.record_request_done()
+            self._drafts.pop(req.rid, None)
             if not self.debug_logits:
                 # a serve replica lives for the deployment's lifetime:
                 # finished requests must not accumulate (debug engines
@@ -987,7 +1205,7 @@ class InferenceEngine:
         from ray_tpu.parallel.ring_attention import local_attention
         return local_attention(q, k, v, causal=True)
 
-    def _build_prefill_cached(self):
+    def _build_prefill_cached(self, all_rows: bool = False):
         """Suffix-only prefill over a prefix-cached context.
 
         The prompt's first ``cached_len`` tokens are already in the
@@ -1003,6 +1221,15 @@ class InferenceEngine:
         ``cached_len``/``suffix_len`` are traced scalars, so one
         executable per *suffix bucket* serves every cached length —
         the zero-steady-state-recompile counters still hold.
+
+        ``all_rows=True`` is the speculative **verify** flavor (r21):
+        the suffix is ``[last_token, d1..dk]`` and the caller needs
+        the logits at EVERY suffix position (row i scores the token
+        after draft i), so the head runs over the whole suffix and
+        the executable returns ``[1, S_bucket, V]`` instead of the
+        last valid row.  Same attention, same cache writes — the
+        verify step is literally the cached-context prefill run one
+        slot at a time.
         """
         cfg = self.cfg
         page_size = self.page_size
@@ -1067,6 +1294,10 @@ class InferenceEngine:
             x, cache_state = self._layer_scan(params, x,
                                               tuple(cache_state),
                                               positions, attn_hook)
+            if all_rows:
+                logits = jnp.einsum("bsd,dv->bsv", x,
+                                    gpt_mod.lm_head(params, cfg))
+                return (logits.astype(jnp.float32),) + cache_state
             h = jnp.take(x[0], suffix_len - 1, axis=0)[None, None]
             logits = jnp.einsum("bsd,dv->bsv", h,
                                 gpt_mod.lm_head(params, cfg))

@@ -589,3 +589,30 @@ def gather_pages(pages, page_table):
 
 def pages_needed(tokens: int, page_size: int) -> int:
     return -(-tokens // page_size)
+
+
+def assert_tail_private(allocator: PageAllocator,
+                        index: Optional[PrefixIndex],
+                        pages: List[int], first_pos: int,
+                        last_pos: int, page_size: int) -> None:
+    """Assert the never-write-shared invariant over a slot's write
+    window before a speculative verify dispatches: every page that
+    positions ``first_pos..last_pos`` land in must be exclusively
+    owned (refcount 1) and unregistered — so a rejected draft tail is
+    rolled back by simply not advancing the slot's length, and can
+    never have clobbered K/V another request shares.
+
+    Provably true by construction (prefix hits and registered pages
+    only ever cover FULL prompt/context pages, all strictly below the
+    first decode position), so a failure here is a scheduler bug, not
+    a traffic pattern — hence an assertion, not an error path."""
+    for idx in range(first_pos // page_size,
+                     last_pos // page_size + 1):
+        page = pages[idx]
+        assert allocator.refcount(page) == 1, (
+            f"speculative write window touches shared page {page} "
+            f"(refcount {allocator.refcount(page)}) — "
+            "never-write-shared violated")
+        assert index is None or not index.has(page), (
+            f"speculative write window touches prefix-registered "
+            f"page {page} — never-write-shared violated")

@@ -128,6 +128,12 @@ class Request:
     # the payload instead of prefilled
     hold_pages: bool = False
     import_payload: Optional[Any] = None
+    # speculative decoding (r21): the resolved draft budget for this
+    # request — 0 = plain decode; > 0 = up to this many self-drafted
+    # tokens verified per engine tick.  Resolved at submit time from
+    # ``SamplingParams.spec``/``spec_k`` overriding the engine
+    # defaults, so the scheduler and engine never re-consult config.
+    spec_k: int = 0
 
 
 class SlotScheduler:

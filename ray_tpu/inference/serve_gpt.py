@@ -70,13 +70,21 @@ def parse_request(request: Dict[str, Any]) -> Dict[str, Any]:
     and the fleet router both route requests through it, so a field
     added to the payload can never silently exist in one path and not
     the other."""
+    spec = request.get("speculation")
+    spec_k = request.get("speculation_k")
     return {
         "max_new_tokens": int(request.get("max_new_tokens", 16)),
         "sampling": SamplingParams(
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
             top_p=float(request.get("top_p", 1.0)),
-            seed=int(request.get("seed", 0))),
+            seed=int(request.get("seed", 0)),
+            # speculation knobs: absent = engine defaults
+            # (RAY_TPU_INFER_SPEC{,_K}); explicit values pin this
+            # request on or off — a pure throughput knob, outputs are
+            # distribution-exact either way
+            spec=None if spec is None else bool(spec),
+            spec_k=None if spec_k is None else int(spec_k)),
         "want_logprobs": bool(request.get("logprobs", False)),
         "eos_token": request.get("eos_token"),
         "ttft_deadline_s": request.get("ttft_deadline_s"),
@@ -112,7 +120,8 @@ class GPTDeployment:
     Request payload (one dict): ``{"tokens": [...], "max_new_tokens":
     int, "temperature": float, "top_k": int, "top_p": float, "seed":
     int, "eos_token": int | None, "logprobs": bool,
-    "ttft_deadline_s": float | None, "deadline_s": float | None}`` —
+    "ttft_deadline_s": float | None, "deadline_s": float | None,
+    "speculation": bool | None, "speculation_k": int | None}`` —
     yields generated token ids; with ``"logprobs": True`` each item is
     ``{"token": int, "logprob": float}`` instead (the sampled token's
     model logprob — ``log_softmax`` of the raw logits, parity-tested

@@ -1,0 +1,97 @@
+"""Self-drafting for speculative decoding: n-gram copy over the
+request's own context.
+
+The drafter is zero-parameter and zero-device-compute: it proposes
+continuation tokens by looking the sequence's trailing n-gram up in an
+incremental index of the request's OWN tokens (prompt + everything
+generated so far) and copying what followed the previous occurrence.
+Structured serving traffic — templated prompts, code, JSON, retrieval
+contexts quoted back — repeats itself constantly, and a tiny greedy
+model loops outright, so prompt-copy drafts hit far above chance
+exactly where decode throughput matters.  On a miss the drafter
+proposes nothing and the slot falls back to plain decode for the tick;
+the engine's verify step makes any proposal *safe* (exact acceptance
+sampling — see ``sampling.accept_drafts``), so the drafter needs to be
+good, never correct.
+
+Period extension: a trailing n-gram matching at position ``p`` implies
+the sequence is locally periodic with period ``d = T - (p + n)``
+(position ``q`` repeats ``q - d``), so proposals continue the copy
+*through* the end of the real tokens by wrapping modulo ``d`` —
+``draft[i] = tokens[p + n + (i % d)]``.  That one rule covers both the
+long-range template copy (``d`` large: a verbatim continuation run)
+and the tight repetition loop (``d`` small: the loop unrolled to the
+full draft budget), without ever proposing from thin air.
+
+Cost: O(max_n) dict updates per generated token and O(max_n) lookups
+per proposal — host-side noise next to a decode dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+class DraftState:
+    """Per-request incremental n-gram index + proposer.
+
+    ``index[n]`` maps each n-gram to the start of its latest occurrence
+    that *has a continuation*: appending the token at position ``t``
+    registers the n-gram ending just before it (``tokens[t-n:t]`` ->
+    ``t - n``), so a lookup of the current trailing n-gram can only
+    find strictly earlier occurrences — never itself — and the copied
+    continuation always exists.  Longest-match-first (``max_n`` down
+    to 1) keeps proposals anchored on as much context as available.
+    """
+
+    def __init__(self, context: List[int], max_n: int = 3):
+        if max_n < 1:
+            raise ValueError(f"max_n must be >= 1, got {max_n}")
+        self.max_n = max_n
+        self.tokens: List[int] = []
+        self.index: List[Dict[Tuple[int, ...], int]] = [
+            {} for _ in range(max_n + 1)]
+        self.extend(context)
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def extend(self, new_tokens) -> None:
+        """Append tokens, registering the n-grams each one continues."""
+        toks = self.tokens
+        for tok in new_tokens:
+            t = len(toks)
+            for n in range(1, min(self.max_n, t) + 1):
+                self.index[n][tuple(toks[t - n:t])] = t - n
+            toks.append(int(tok))
+
+    def sync(self, prompt: List[int], generated: List[int]) -> None:
+        """Catch the index up to ``prompt + generated`` (the engine
+        calls this each planning pass; both lists are append-only, so
+        only the unseen generated tail indexes)."""
+        have = len(self.tokens) - len(prompt)
+        self.extend(generated[have:])
+
+    def propose(self, k: int) -> List[int]:
+        """Up to ``k`` drafted continuation tokens ([] = no match —
+        the engine runs plain decode for the tick).
+
+        The budget scales with match strength: a ``max_n``-gram match
+        spends the full ``k``, and each step down halves it (floor 1).
+        Measured on greedy tiny-GPT traffic, a 3-gram match's drafts
+        accept ~4x as often as a 1-gram's — spending the whole budget
+        on a weak match mostly buys rejected rows, while a 1-token
+        draft on a weak match still beats plain decode whenever it
+        lands and costs one extra verify row when it doesn't."""
+        toks = self.tokens
+        T = len(toks)
+        for n in range(self.max_n, 0, -1):
+            if T < n + 1:       # need the suffix AND an earlier copy
+                continue
+            p = self.index[n].get(tuple(toks[T - n:]))
+            if p is None:
+                continue
+            d = T - (p + n)     # local period implied by the match
+            budget = max(1, k >> (self.max_n - n))
+            return [toks[p + n + (i % d)] for i in range(budget)]
+        return []

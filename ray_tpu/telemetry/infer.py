@@ -33,6 +33,10 @@ _TTFT_BOUNDARIES = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.5, 5.0, 10.0, 30.0]
 _STEP_BOUNDARIES = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                     0.01, 0.025, 0.05, 0.1, 0.25, 1.0]
+# accepted drafts per verify step (integer counts; .5 edges put each
+# count in its own bucket up to 8, then coarse tails)
+_SPEC_BOUNDARIES = [0.5, 1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5, 8.5,
+                    12.5, 16.5]
 
 
 class InferTelemetry:
@@ -61,6 +65,12 @@ class InferTelemetry:
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
         self.deadline_exceeded: Dict[str, int] = {}
+        # speculative decoding (r21): cumulative proposed/accepted
+        # draft counts and verify-step count — the accept rate is the
+        # one number that decides whether speculation pays
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_verify_steps = 0
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
@@ -89,6 +99,26 @@ class InferTelemetry:
         self.decodes.append({"wall_s": wall_s, "active": active})
         del self.decodes[:-self._MAX_RECORDS]
         self._emit_decode(wall_s, active)
+
+    def record_verify(self, wall_s: float, *, proposed: int,
+                      accepted: int, emitted: int) -> None:
+        """One speculative verify step: ``proposed`` drafts scored,
+        ``accepted`` of them kept, ``emitted`` real tokens delivered
+        (accepted + the correction/bonus row, clipped by EOS/max_new).
+        The step folds into the decode series — its wall time and
+        emitted tokens are decode throughput, just > 1 token per
+        dispatch — so ``decode_tokens_per_sec`` stays the honest
+        engine-wide figure with speculation on."""
+        if not self.enabled:
+            return
+        self.decode_count += 1
+        self.decode_tokens += emitted
+        self.spec_verify_steps += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.decodes.append({"wall_s": wall_s, "active": emitted})
+        del self.decodes[:-self._MAX_RECORDS]
+        self._emit_verify(wall_s, proposed, accepted, emitted)
 
     def record_ttft(self, ttft_s: float, *,
                     prefix_hit: bool = False) -> None:
@@ -170,6 +200,15 @@ class InferTelemetry:
         out["prompt_tokens"] = self.prompt_tokens
         out["prefill_tokens_skipped"] = self.prefix_hit_tokens
         out["deadline_exceeded"] = dict(self.deadline_exceeded)
+        if self.spec_verify_steps:
+            out["spec"] = {
+                "verify_steps": self.spec_verify_steps,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": (self.spec_accepted
+                                / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+            }
         if self.prompt_tokens:
             out["prefix_hit_rate"] = (self.prefix_hit_tokens
                                       / self.prompt_tokens)
@@ -231,6 +270,22 @@ class InferTelemetry:
                     "infer_deadline_exceeded_total",
                     "requests retired past their TTFT/total deadline",
                     tag_keys=("label", "kind")),
+                "spec_proposed": Counter(
+                    "infer_spec_proposed_total",
+                    "speculative draft tokens proposed",
+                    tag_keys=tags),
+                "spec_accepted": Counter(
+                    "infer_spec_accepted_total",
+                    "speculative draft tokens accepted",
+                    tag_keys=tags),
+                "spec_rate": Gauge(
+                    "infer_spec_accept_rate",
+                    "cumulative speculative accept rate",
+                    tag_keys=tags),
+                "spec_hist": Histogram(
+                    "infer_spec_accepted_tokens",
+                    "drafts accepted per verify step",
+                    boundaries=_SPEC_BOUNDARIES, tag_keys=tags),
             }
         return self._metrics
 
@@ -266,6 +321,35 @@ class InferTelemetry:
             if metrics is not None:
                 metrics["deadline"].inc(
                     1.0, tags={"label": self.label, "kind": kind})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_verify(self, wall_s: float, proposed: int,
+                     accepted: int, emitted: int):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is None:
+                return
+            tags = {"label": self.label}
+            # counters are exact (never throttled — rates must add up);
+            # the gauge/histograms ride the decode emitter's throttle
+            metrics["spec_proposed"].inc(float(proposed), tags=tags)
+            metrics["spec_accepted"].inc(float(accepted), tags=tags)
+            now = time.monotonic()
+            if (self.spec_verify_steps > 1
+                    and now - self._metrics_last
+                    < self._EMIT_INTERVAL_S):
+                return
+            self._metrics_last = now
+            metrics["spec_hist"].observe(float(accepted), tags=tags)
+            if self.spec_proposed:
+                metrics["spec_rate"].set(
+                    self.spec_accepted / self.spec_proposed, tags=tags)
+            metrics["step"].observe(wall_s, tags=tags)
+            if wall_s > 0:
+                metrics["tok"].set(emitted / wall_s, tags=tags)
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

@@ -1,0 +1,57 @@
+"""Round-21 on-chip driver: speculative decoding in the engine.
+
+Usage: python scratch/r21_spec.py <variant>
+
+Variants:
+  spec — `bench.py --infer --spec`: the self-drafting draft-and-verify
+         A/B on real hardware — speculation off vs k in {2, 4, 8} over
+         the templated and random traffic mixes, sequential requests
+         (the latency-bound decode-tier regime).  Reports per-arm
+         decode tok/s and speedup vs off, accept rate + accepted-token
+         histogram, inter-token p50/p99, bit-exact greedy parity, the
+         compile counters (verify buckets must show zero steady-state
+         compiles) and the leak audit.  The chip question host-sim
+         cannot answer: on CPU the verify forward costs about one
+         decode wall regardless of k, so the measured speedup IS the
+         tokens-per-dispatch ratio; on chips the [1, k+1] verify row
+         block rides the same MXU pass as the single decode row only
+         while the matmuls stay memory-bound — the arm sweep shows
+         where the verify wall starts growing with k and whether the
+         accept-rate break-even (docs/PERF.md r21) moves.
+
+Carried arms (no chip session yet; every r06-r20 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+disagg plus all r6-r19 arms — delegated verbatim to
+scratch/r20_disagg.py.
+"""
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "spec"
+
+_R20_ARMS = ("disagg",
+             "gray", "straggle",
+             "elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+if VARIANT in _R20_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r20_disagg.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+assert VARIANT == "spec", f"unknown variant {VARIANT!r}"
+
+ROOT = os.path.dirname(HERE)
+sys.exit(subprocess.run(
+    [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+     "--spec"] + sys.argv[2:]).returncode)

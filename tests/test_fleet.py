@@ -300,7 +300,8 @@ def test_fleet_failover_mid_stream_chaos(tiny_f32):
     # zero steady-state recompiles: replacements compiled NOTHING
     for r in router.replicas():
         assert r.engine.stats()["compiles"] == {
-            "prefill": 0, "prefill_cached": 0, "decode": 0}
+            "prefill": 0, "prefill_cached": 0, "decode": 0,
+            "verify": 0}
     # fleet-wide leak audit (dead replicas were reaped at failover)
     assert router.leak_free()
     for r in reps:
@@ -897,7 +898,8 @@ def test_gray_failure_acceptance(tiny_f32):
     for router, reps in ((router_on, reps_on), (router_off, reps_off)):
         for r in router.replicas():
             assert r.engine.stats()["compiles"] == {
-                "prefill": 0, "prefill_cached": 0, "decode": 0}
+                "prefill": 0, "prefill_cached": 0, "decode": 0,
+                "verify": 0}
         assert router.leak_free()
         assert all(r.leak_free() for r in reps)
     router_on.close()
@@ -998,7 +1000,7 @@ def test_idle_stream_reaper_frees_dropped_generator(tiny_f32):
                                              StreamIdleError)
     dep = GPTDeployment.func_or_class(
         model="tiny", model_config={"dtype": jnp.float32},
-        engine_config=dict(_ENGINE_KW), stream_idle_s=0.05)
+        engine_config=dict(_ENGINE_KW), stream_idle_s=0.03)
 
     async def main():
         agen = dep({"tokens": [1, 2, 3], "max_new_tokens": 50})

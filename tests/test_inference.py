@@ -188,7 +188,7 @@ def test_int8_kv_cache_parity_and_bytes(tiny_f32):
     assert outs[q8][1] == outs[base][1]
     assert q8.stats()["compiles"] == {"prefill": 1,
                                       "prefill_cached": 0,
-                                      "decode": 1}
+                                      "decode": 1, "verify": 0}
 
     # ragged co-batching stays invisible under quantization too
     p2 = _prompt(14, cfg.vocab_size, seed=12)
@@ -394,7 +394,7 @@ def test_prefix_mixed_traffic_zero_recompiles(tiny_f32):
             out[r].append(tok)
     st = engine.stats()
     assert st["compiles"] == {"prefill": 1, "prefill_cached": 1,
-                              "decode": 1}
+                              "decode": 1, "verify": 0}
     assert st["prefix"]["requests_hit"] == 2
     assert st["prefix"]["hit_tokens"] == 2 * 32
     assert out[rids[1]] == solo
@@ -555,7 +555,7 @@ def test_zero_steady_state_recompiles(tiny_f32):
         engine.step()
     stats = engine.stats()
     assert stats["compiles"] == {"prefill": 1, "prefill_cached": 0,
-                                 "decode": 1}
+                                 "decode": 1, "verify": 0}
     assert stats["hits"]["prefill"] == 3
     assert stats["hits"]["decode"] > 0
 

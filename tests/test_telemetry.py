@@ -430,6 +430,29 @@ def test_infer_telemetry_deadline_counter():
     assert off.summary() == {"enabled": False}
 
 
+def test_infer_telemetry_spec_summary():
+    """r21: verify steps fold into the decode series (wall + emitted
+    tokens ARE decode throughput, just > 1 token per dispatch) and the
+    draft accounting surfaces as the ``spec`` summary block — absent
+    entirely when speculation never ran."""
+    from ray_tpu.telemetry import InferTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = InferTelemetry(config=TelemetryConfig(enabled=True))
+    assert "spec" not in tel.summary()
+    tel.record_decode(0.01, active=1)
+    tel.record_verify(0.01, proposed=4, accepted=4, emitted=5)
+    tel.record_verify(0.01, proposed=4, accepted=0, emitted=1)
+    out = tel.summary()
+    assert out["spec"] == {"verify_steps": 2, "proposed": 8,
+                           "accepted": 4, "accept_rate": 0.5}
+    assert out["decode_steps"] == 3          # verifies count as steps
+    assert out["decode_tokens"] == 1 + 5 + 1
+    off = InferTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_verify(0.01, proposed=4, accepted=2, emitted=3)
+    assert off.summary() == {"enabled": False}
+
+
 @pytest.mark.slow
 def test_telemetry_overhead_under_one_percent():
     """Acceptance budget: telemetry-on steady-state step time exceeds
@@ -543,7 +566,9 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     elastic.record_transition("shrink", 0.05, n_devices=4)
     elastic.record_straggler()
     RLTelemetry(config=on).record_actor_restart()
-    InferTelemetry(config=on).record_deadline_exceeded(kind="ttft")
+    infer = InferTelemetry(config=on)
+    infer.record_deadline_exceeded(kind="ttft")
+    infer.record_verify(0.002, proposed=4, accepted=3, emitted=4)
     data = DataTelemetry(config=on)
     data.record_batch(128, 0.2, queue_depth=2)
     data.record_stall(0.003)
@@ -605,3 +630,9 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert 'pool="prefill"' in text and 'pool="decode"' in text
     assert "user_histogram_serve_ttft_seconds_bucket" in text
     assert 'mode="disagg"' in text
+    # r21 speculative-decoding series: exact proposal/accept counters,
+    # the cumulative accept-rate gauge, accepted-per-verify histogram
+    assert "infer_spec_proposed_total" in text
+    assert "infer_spec_accepted_total" in text
+    assert "infer_spec_accept_rate" in text
+    assert "user_histogram_infer_spec_accepted_tokens_bucket" in text
