@@ -137,7 +137,8 @@ def _bench_mesh_body(axes):
     devices = jax.devices()
     mesh = make_mesh(devices=devices, **axes)
     host_sim = (devices[0].platform == "cpu")
-    data_par = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    data_par = (mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1)
+                * mesh.shape.get("fsdp", 1))
     if host_sim:
         cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=4,
                         n_heads=4, max_seq=128, dtype=jnp.float32)
@@ -151,15 +152,18 @@ def _bench_mesh_body(axes):
         jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
     # three rows per mesh: the two schedules plus the int8-wire overlap
     # arm, so MULTICHIP_r*.json carries gspmd-vs-overlap-vs-quantized
-    # with per-collective wire dtypes side by side
+    # with per-collective wire dtypes side by side; a dcn mesh adds the
+    # dcn-only-quant arm (the recommended multi-pod wire)
     from ray_tpu.ops.substrate import run_ladder
-    for want, want_quant in (("gspmd", "none"), ("overlap", "none"),
-                             ("overlap", "int8")):
+    arms = [("gspmd", "none"), ("overlap", "none"), ("overlap", "int8")]
+    if mesh.shape.get("dcn", 1) > 1:
+        arms.append(("overlap", "dcn"))
+    for want, want_quant in arms:
         fallback = None
         fns = training.build_gpt_train(cfg, mesh, comm_mode=want,
                                        comm_quant=want_quant)
         mode = fns["comm_mode"]
-        if want_quant == "int8" and mode != "overlap":
+        if want_quant != "none" and mode != "overlap":
             continue     # overlap fell back: no distinct quantized arm
 
         def attempt(f):
@@ -194,6 +198,9 @@ def _bench_mesh_body(axes):
             for _ in range(3):
                 state, metrics = fns["step_fn"](state, batch_data)
         tok_s = steps * batch * seq / dt
+        cb = ovl.collective_bytes_per_step(
+            cfg, mesh, batch=batch, seq=seq, comm_mode=mode,
+            quant=fns.get("comm_quant", "none"))
         record = {
             "metric": "gpt2_train_tokens_per_sec_multichip",
             "value": round(tok_s, 1),
@@ -206,11 +213,19 @@ def _bench_mesh_body(axes):
             "requested_comm_mode": want,
             "requested_comm_quant": want_quant,
             "comm_quant": fns.get("comm_quant", "none"),
-            "collective_bytes_per_step": ovl.collective_bytes_per_step(
-                cfg, mesh, batch=batch, seq=seq, comm_mode=mode,
-                quant=fns.get("comm_quant", "none")),
+            "collective_bytes_per_step": cb,
+            # flattened per-tier rows: bytes and the analytic seconds
+            # at the TIER_BANDWIDTH_GBPS price — the ~30x ICI-vs-DCN
+            # gap is what makes the hierarchy's DCN reduction matter
+            "collective_bytes_ici": cb["ici"]["total"],
+            "collective_bytes_dcn": cb["dcn"]["total"],
+            "collective_seconds_ici": cb["ici"]["seconds"],
+            "collective_seconds_dcn": cb["dcn"]["seconds"],
             "final_loss": round(float(metrics["loss"]), 4),
         }
+        if "reduction_vs_flat" in cb["dcn"]:
+            record["dcn_reduction_vs_flat"] = \
+                cb["dcn"]["reduction_vs_flat"]
         if "telemetry" in fns:
             record["telemetry"] = fns["telemetry"].summary()
         if fallback:

@@ -2,9 +2,11 @@
 
 One function turns (config, mesh) into a fully-sharded jitted train step:
 params/optimizer sharded by the logical-axis rules, batch sharded over
-(dp, fsdp) × sp, gradients reduced by XLA from the shardings alone — the
-TPU-native equivalent of the reference's DDP/FSDP wrapper selection
-(``train/torch/train_loop_utils.py`` prepare_model).
+(dcn, dp, fsdp) × sp, gradients reduced by XLA from the shardings alone —
+the TPU-native equivalent of the reference's DDP/FSDP wrapper selection
+(``train/torch/train_loop_utils.py`` prepare_model).  On a multi-pod
+``dcn`` mesh the params stay pod-replicated (pure DP across pods), so
+only the post-reduction gradient shard crosses the slow tier.
 """
 
 from __future__ import annotations
@@ -185,11 +187,15 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     all-gather-matmul TP) and falls back to "gspmd" loudly when the
     (cfg, mesh) is outside its dp/fsdp/tp dense coverage; the chosen
     mode is returned as ``fns["comm_mode"]``.  ``comm_quant`` pins the
-    overlap schedule's collective wire dtype ("none" / "int8"; default:
-    ``comm_config().quant`` from ``RAY_TPU_COMM_QUANT``) — "int8" moves
-    the FSDP weight all-gathers and grad reduce-scatters as
+    overlap schedule's collective wire dtype ("none" / "int8" / "dcn";
+    default: ``comm_config().quant`` from ``RAY_TPU_COMM_QUANT``) —
+    "int8" moves the FSDP weight all-gathers and grad reduce-scatters
+    (and, on a multi-pod mesh, the cross-pod grad all-reduce) as
     block-scaled int8 (``ray_tpu.quant``, stochastic-rounding ring RS);
-    it is dropped loudly when the effective comm_mode is "gspmd"
+    "dcn" quantizes ONLY the cross-pod leg — the recommended multi-pod
+    setting: DCN is where bandwidth is scarce, the ICI legs stay exact,
+    and it is a plain-wire no-op on a single-pod mesh.  Either is
+    dropped loudly when the effective comm_mode is "gspmd"
     (GSPMD owns its collectives), and the effective value is returned
     as ``fns["comm_quant"]``.  ``fuse_norm`` pins the fused norm
     epilogues ("on"/"off" via bool; default:
@@ -252,9 +258,9 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                 comm_mode = "gspmd"
     if comm_quant is None:
         comm_quant = ovl.comm_config().quant
-    if comm_quant not in ("none", "int8"):
+    if comm_quant not in ("none", "int8", "dcn"):
         raise ValueError(f"unknown comm_quant {comm_quant!r}; "
-                         "expected 'none' or 'int8'")
+                         "expected 'none', 'int8' or 'dcn'")
     if comm_quant != "none" and comm_mode != "overlap":
         import sys
         print(f"comm_quant={comm_quant} needs the overlap schedule "
@@ -603,45 +609,143 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     }
 
 
+def default_pp_schedule() -> str:
+    """``RAY_TPU_PP_SCHEDULE`` (default ``gpipe``): the pipeline
+    microbatch schedule ``build_gpt_train_pp`` uses when ``schedule``
+    is not pinned — ``gpipe`` (all-forward-then-backward, in-flight =
+    M) or ``1f1b`` (one-forward-one-backward, in-flight bounded at
+    ``2*stages - 1``)."""
+    import sys
+    raw = os.environ.get("RAY_TPU_PP_SCHEDULE", "gpipe").strip().lower()
+    if raw not in ("gpipe", "1f1b"):
+        print(f"RAY_TPU_PP_SCHEDULE={raw!r} unknown (want 'gpipe' or "
+              "'1f1b'); using gpipe", file=sys.stderr)
+        return "gpipe"
+    return raw
+
+
+def default_pp_microbatches() -> Optional[int]:
+    """``RAY_TPU_PP_MICROBATCH`` (default unset): microbatch count for
+    ``build_gpt_train_pp`` when ``num_microbatches`` is not pinned;
+    unset falls back to ``2 * stages``."""
+    import sys
+    raw = os.environ.get("RAY_TPU_PP_MICROBATCH", "").strip()
+    if not raw:
+        return None
+    try:
+        m = int(raw)
+    except ValueError:
+        print(f"RAY_TPU_PP_MICROBATCH={raw!r} is not an integer; "
+              "ignoring", file=sys.stderr)
+        return None
+    if m < 1:
+        print(f"RAY_TPU_PP_MICROBATCH={m} must be >= 1; ignoring",
+              file=sys.stderr)
+        return None
+    return m
+
+
+def _pp_batch_sharding(mesh, exclude: Optional[str]):
+    """Batch sharding for the pipeline trainers: the usual data axes
+    minus the stage axis (a dcn-staged pipeline must not ALSO shard the
+    batch over dcn — each microbatch visits every stage whole)."""
+    axes = tuple(a for a in ("dcn", "dp", "fsdp")
+                 if a != exclude and mesh.shape.get(a, 1) > 1)
+    data = None if not axes else (axes[0] if len(axes) == 1 else axes)
+    seq_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
+    return NamedSharding(mesh, P(data, seq_axis))
+
+
 def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                        num_microbatches: Optional[int] = None,
+                       schedule: Optional[str] = None,
                        optimizer=None,
                        telemetry: Optional[bool] = None
                        ) -> Dict[str, Callable]:
-    """Pipeline-parallel GPT training over a mesh with a ``pp`` axis.
+    """Pipeline-parallel GPT training over a ``pp`` (or ``dcn``) axis.
 
-    The layer stack ``[L, ...]`` is reshaped to ``[pp, L/pp, ...]`` and
-    sharded stage-wise; the forward runs a GPipe schedule
-    (``parallel/pipeline.py``) with each stage scanning its local layers.
-    Embedding/loss run outside the pipeline (replicated over pp, sharded
-    over dp/tp as usual); dp/fsdp/tp compose inside each stage via the
-    partial-manual shard_map.  TPU-native counterpart of the reference's
-    DeepSpeed-delegated pipeline parallelism (SURVEY §2.4).
+    The layer stack ``[L, ...]`` is reshaped to ``[stages, L/stages,
+    ...]`` and sharded stage-wise; two schedules
+    (``parallel/pipeline.py``):
+
+    * ``gpipe`` (default; ``pp`` axis only): forward sweep through
+      :func:`pipeline_apply`, autodiff's mirrored backward.  Embedding/
+      loss run outside the pipeline (replicated over pp, sharded over
+      dp/tp as usual); dp/fsdp/tp compose inside each stage via the
+      partial-manual shard_map.
+    * ``1f1b``: hand-scheduled one-forward-one-backward
+      (:func:`pipeline_1f1b_value_and_grad`), in-flight activations
+      bounded at ``2*stages - 1`` regardless of the microbatch count.
+      Stages ride the ``pp`` axis when it is >1, else the ``dcn`` axis
+      — one stage per pod, so the only cross-pod traffic is one
+      microbatch activation boundary per tick instead of a full grad
+      all-reduce.  Embedding and loss head are *inside* the (uniform)
+      stage program, masked to the first/last stage.
+
+    ``schedule`` defaults to env ``RAY_TPU_PP_SCHEDULE`` (gpipe);
+    ``num_microbatches`` to env ``RAY_TPU_PP_MICROBATCH``, else
+    ``2 * stages``.  The returned dict reports ``schedule``,
+    ``stage_axis``, ``bubble_fraction`` and ``in_flight_microbatches``
+    (analytic, :func:`pipeline_schedule_stats`).  TPU-native
+    counterpart of the reference's DeepSpeed-delegated pipeline
+    parallelism (SURVEY §2.4).
     """
+    from jax import lax
+
     from ray_tpu.parallel import pipeline as pipe
     from ray_tpu.parallel.ring_attention import local_attention
 
-    pp = mesh.shape["pp"]
+    if schedule is None:
+        schedule = default_pp_schedule()
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         "expected 'gpipe' or '1f1b'")
+    if schedule == "gpipe":
+        if "pp" not in dict(mesh.shape):
+            raise ValueError("schedule='gpipe' needs a 'pp' mesh axis "
+                             "(1f1b can also stage over 'dcn')")
+        stage_axis = "pp"
+    elif mesh.shape.get("pp", 1) > 1 or "pp" in dict(mesh.shape):
+        stage_axis = "pp"
+    elif mesh.shape.get("dcn", 1) > 1:
+        stage_axis = "dcn"
+    else:
+        raise ValueError(
+            "schedule='1f1b' needs a 'pp' axis or a 'dcn' axis > 1 to "
+            f"stage over; mesh has {dict(mesh.shape)}")
+    pp = mesh.shape[stage_axis]
     if cfg.n_layers % pp:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"stages={pp} (axis {stage_axis!r})")
     if cfg.n_experts > 0:
         raise ValueError("MoE + pipeline parallelism not supported yet")
     Ls = cfg.n_layers // pp
-    M = num_microbatches or 2 * pp
+    M = num_microbatches or default_pp_microbatches() or 2 * pp
     tx = optimizer or default_optimizer()
+    stats = pipe.pipeline_schedule_stats(pp, M, schedule)
+
+    # one rule table for both schedules: "stage" follows the stage
+    # axis, and the batch never shards over it (identical to
+    # DEFAULT_RULES when staging over pp)
+    rules = tuple(
+        ("stage", stage_axis) if k == "stage" else
+        (("batch", tuple(a for a in ("dcn", "dp", "fsdp")
+                         if a != stage_axis)) if k == "batch"
+         else (k, v))
+        for k, v in shd.DEFAULT_RULES)
 
     logical = gpt_mod.param_logical_axes(cfg)
     is_axes = lambda x: (isinstance(x, tuple) and all(  # noqa: E731
         isinstance(a, (str, type(None))) for a in x))
     logical["layers"] = jax.tree.map(lambda axes: ("stage",) + axes,
                                      logical["layers"], is_leaf=is_axes)
-    param_sh = shd.tree_shardings(mesh, logical)
-    batch_sh = _batch_sharding(mesh)
+    param_sh = shd.tree_shardings(mesh, logical, rules)
+    batch_sh = _pp_batch_sharding(mesh, stage_axis)
     attn = functools.partial(local_attention, causal=True)
-    # stage params enter the shard_map split on dim 0 (pp) only; their
-    # within-stage tp/fsdp sharding flows through the auto axes.
-    stage_spec = jax.tree.map(lambda leaf: P("pp"), logical["layers"],
-                              is_leaf=is_axes)
+    # stage params enter the shard_map split on dim 0 (stage) only;
+    # their within-stage tp/fsdp sharding flows through the auto axes.
+    stage_spec = jax.tree.map(lambda leaf: P(stage_axis),
+                              logical["layers"], is_leaf=is_axes)
 
     def init(key) -> TrainState:
         params = gpt_mod.init_params(cfg, key)
@@ -650,9 +754,8 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
             params["layers"])
         return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
 
-    def loss(params, batch):
-        tokens, targets = batch["tokens"], batch["targets"]
-        B, S = tokens.shape
+    def _check_batch(batch):
+        B = batch["tokens"].shape[0]
         if B % M:
             raise ValueError(f"batch={B} not divisible by microbatches={M}")
         if "segment_ids" in batch:
@@ -662,31 +765,40 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
                 "sample-packed batches (segment_ids) are not supported "
                 "by the pipeline-parallel trainer yet — stream unpacked "
                 "(RAY_TPU_DATA_PACK=0) or use build_gpt_train")
+
+    def _stack_body(sp, a, positions):
+        """Scan this stage's local layers over the activation."""
+        def body(c, lp):
+            # fuse_norm pinned off: this body traces inside the
+            # pipeline shard_map with no mesh in scope, so the epilogue
+            # gate would see n_devices=1 and put a pallas_call (no SPMD
+            # rule) under the multi-chip pipeline at aligned shapes
+            y, _aux = gpt_mod.layer_apply(lp, c, cfg,
+                                          positions=positions,
+                                          attn_fn=attn,
+                                          fuse_norm=False)
+            return y, None
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        if cfg.unroll_layers:
+            for i in range(Ls):
+                a, _ = body(a, jax.tree.map(lambda t: t[i], sp))
+            return a
+        a, _ = jax.lax.scan(body, a, sp)
+        return a
+
+    # ------------------------------------------------------- gpipe ----
+    def loss(params, batch):
+        tokens, targets = batch["tokens"], batch["targets"]
+        B, S = tokens.shape
+        _check_batch(batch)
         positions = jnp.arange(S)
         x = gpt_mod.embed_tokens(params, tokens, cfg, mesh=mesh)
         d = x.shape[-1]
         xs = x.reshape(M, B // M, S, d)
 
         def stage_fn(sp, a):
-            def body(c, lp):
-                # fuse_norm pinned off: this body traces inside
-                # pipeline_apply's shard_map with no mesh in scope, so
-                # the epilogue gate would see n_devices=1 and put a
-                # pallas_call (no SPMD rule) under the multi-chip
-                # pipeline at lane-aligned shapes
-                y, _aux = gpt_mod.layer_apply(lp, c, cfg,
-                                              positions=positions,
-                                              attn_fn=attn,
-                                              fuse_norm=False)
-                return y, None
-            if cfg.remat:
-                body = jax.checkpoint(body)
-            if cfg.unroll_layers:
-                for i in range(Ls):
-                    a, _ = body(a, jax.tree.map(lambda t: t[i], sp))
-                return a
-            a, _ = jax.lax.scan(body, a, sp)
-            return a
+            return _stack_body(sp, a, positions)
 
         out = pipe.pipeline_apply(stage_fn, params["layers"], xs,
                                   mesh=mesh, num_microbatches=M,
@@ -698,13 +810,67 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         return gpt_mod.loss_from_hidden(params, h, targets, cfg,
                                         mesh=mesh)
 
+    # -------------------------------------------------------- 1f1b ----
+    # Uniform stage program: embed masked to the first stage, loss head
+    # computed everywhere but seeded (cot_weights) only on the last.
+    # The embed is inlined — gpt.embed_tokens' sharding constraints map
+    # "batch" to the data axes, which on a dcn-staged mesh would fight
+    # the stage partitioning from inside the shard_map.
+    def stage_fn_1f1b(sp, shared, a, mb):
+        s_idx = lax.axis_index(stage_axis)
+        tok, tgt = mb["tokens"], mb["targets"]
+        S = tok.shape[1]
+        emb = shared["embed"].astype(cfg.dtype)[tok]
+        if cfg.pos == "learned":
+            emb = emb + shared["pos_embed"].astype(cfg.dtype)[None, :S]
+        h = jnp.where(s_idx == 0, emb, a)
+        h = _stack_body(sp, h, jnp.arange(S))
+        hn = gpt_mod._norm(h, shared["ln_f"], cfg.norm,
+                           bias=shared.get("ln_f_b"),
+                           eps=gpt_mod.norm_eps(cfg))
+        # mesh=None: single-device formulation — the CE runs per stage
+        # inside the manual region
+        loss_u = gpt_mod.loss_from_hidden(shared, hn, tgt, cfg,
+                                          mesh=None)
+        return h, loss_u
+
+    def value_and_grad_1f1b(params, batch):
+        tokens, targets = batch["tokens"], batch["targets"]
+        B, S = tokens.shape
+        _check_batch(batch)
+        mbs = {"tokens": tokens.reshape(M, B // M, S),
+               "targets": targets.reshape(M, B // M, S)}
+        # per-microbatch valid-token weights: stage_fn returns each
+        # microbatch's own mean, so w_u = n_u / n_total makes the
+        # weighted sum the exact global masked mean
+        n_u = jnp.sum(mbs["targets"] >= 0, axis=(1, 2)
+                      ).astype(jnp.float32)
+        w = n_u / jnp.maximum(jnp.sum(n_u), 1.0)
+        act_example = jnp.zeros((B // M, S, cfg.d_model), cfg.dtype)
+        shared = {k: v for k, v in params.items() if k != "layers"}
+        loss_val, g_stage, g_shared = pipe.pipeline_1f1b_value_and_grad(
+            stage_fn_1f1b, params["layers"], shared, mbs, mesh=mesh,
+            axis=stage_axis, num_microbatches=M,
+            act_example=act_example, cot_weights=w,
+            stage_spec=stage_spec)
+        grads = dict(g_shared)
+        grads["layers"] = g_stage
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
+                             params)
+        return loss_val, grads
+
     st_sh = _state_shardings(init, param_sh, mesh)
     init_jit = jax.jit(init, out_shardings=st_sh)
+
+    def value_and_grad(params, batch):
+        if schedule == "1f1b":
+            return value_and_grad_1f1b(params, batch)
+        return jax.value_and_grad(loss)(params, batch)
 
     @functools.partial(jax.jit, in_shardings=(st_sh, batch_sh),
                        out_shardings=(st_sh, None), donate_argnums=(0,))
     def step(state: TrainState, batch):
-        loss_val, grads = jax.value_and_grad(loss)(state.params, batch)
+        loss_val, grads = value_and_grad(state.params, batch)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (TrainState(params, opt_state, state.step + 1),
@@ -713,6 +879,8 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
 
     @functools.partial(jax.jit, in_shardings=(st_sh.params, batch_sh))
     def loss_eval(params, batch):
+        if schedule == "1f1b":
+            return value_and_grad_1f1b(params, batch)[0]
         return loss(params, batch)
 
     fns = {
@@ -722,6 +890,10 @@ def build_gpt_train_pp(cfg: "gpt_mod.GPTConfig", mesh, *,
         "state_shardings": st_sh,
         "batch_sharding": batch_sh,
         "num_microbatches": M,
+        "schedule": schedule,
+        "stage_axis": stage_axis,
+        "bubble_fraction": stats["bubble_fraction"],
+        "in_flight_microbatches": stats["in_flight_microbatches"],
     }
     return _maybe_instrument(fns, cfg, mesh, label="train_pp",
                              telemetry=telemetry)

@@ -6,6 +6,9 @@ of process groups + communicator objects (reference
 parallelism is expressed as named axes of a ``jax.sharding.Mesh`` and XLA
 inserts the collectives.  Axis convention (see scaling-book recipe):
 
+    dcn   the inter-pod tier (data-center network): pure data
+          parallelism across pods — params replicated per pod, grads
+          all-reduced over the slow links
     dp    data parallelism (gradient psum)
     fsdp  parameter/optimizer sharding (ZeRO-3-style)
     tp    tensor parallelism (megatron-style sharded matmuls)
@@ -15,7 +18,9 @@ inserts the collectives.  Axis convention (see scaling-book recipe):
 
 ICI topology note: axes earlier in the tuple change slowest; put the axis
 with the heaviest collective traffic (tp) innermost so it rides the
-densest ICI links.
+densest ICI links.  ``dcn`` is outermost by construction — it is the
+slowest tier, and the hierarchical collectives in ``parallel/overlap.py``
+depend on every ICI axis being contiguous *inside* one dcn slice.
 """
 
 from __future__ import annotations
@@ -24,7 +29,24 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "sp", "ep", "tp")
+
+# Axes that live on the intra-pod ICI fabric; "dcn" is the only
+# cross-pod axis.  ``mesh_tiers`` buckets a live mesh by this split.
+ICI_AXES = tuple(a for a in AXIS_ORDER if a != "dcn")
+
+
+class MeshAxisError(ValueError):
+    """A mesh-axis string was malformed; ``axis`` names the offender.
+
+    Raised by :func:`parse_mesh_axes` (and ``MeshSpec.create``) with the
+    offending axis attached so CLI surfaces (``bench.py --mesh``,
+    scratch drivers) can point at the exact token instead of the whole
+    argument."""
+
+    def __init__(self, msg: str, *, axis: Optional[str] = None):
+        super().__init__(msg)
+        self.axis = axis
 
 
 @dataclass(frozen=True)
@@ -37,8 +59,10 @@ class MeshSpec:
     def create(cls, **sizes: int) -> "MeshSpec":
         unknown = set(sizes) - set(AXIS_ORDER)
         if unknown:
-            raise ValueError(f"unknown mesh axes: {sorted(unknown)}; "
-                             f"valid: {AXIS_ORDER}")
+            bad = sorted(unknown)[0]
+            raise MeshAxisError(
+                f"unknown mesh axis {bad!r}; valid: {AXIS_ORDER}",
+                axis=bad)
         axes = tuple((a, int(sizes[a])) for a in AXIS_ORDER if a in sizes)
         return cls(axes)
 
@@ -97,21 +121,70 @@ class MeshSpec:
                 f"mesh size {fixed} exceeds device count {num_devices}")
         return self  # smaller meshes use the first `fixed` devices
 
+    # ----------------------------------------------------------- tier split
+    def tier_split(self) -> Tuple[int, int]:
+        """``(dcn_size, pod_size)`` — the cross-pod tier and the per-pod
+        ICI product.  A flat (single-pod) spec is ``(1, size)``.  This
+        is what the checkpoint sidecar round-trips so an r18 cross-mesh
+        restore can tell ``dcn=2,fsdp=4`` from flat ``fsdp=8`` even at
+        equal device count."""
+        d = dict(self.axes)
+        dcn = int(d.get("dcn", 1))
+        return dcn, self.size // max(dcn, 1)
+
+
+def mesh_tiers(mesh) -> Dict[str, Tuple[str, ...]]:
+    """Bucket a live mesh's >1-sized axes by fabric tier:
+    ``{"ici": (...), "dcn": (...)}``.  The hierarchical collectives and
+    the per-tier byte accounting share this split so they cannot
+    disagree about which wire a collective rides."""
+    shape = dict(mesh.shape)
+    return {
+        "ici": tuple(a for a in ICI_AXES if shape.get(a, 1) > 1),
+        "dcn": tuple(a for a in ("dcn",) if shape.get(a, 1) > 1),
+    }
+
 
 def parse_mesh_axes(arg: str) -> Dict[str, int]:
-    """``"fsdp=4,tp=2"`` -> ``{"fsdp": 4, "tp": 2}`` (CLI mesh syntax
-    shared by ``bench.py --mesh`` and the scratch drivers).  Axis names
-    are validated against :data:`AXIS_ORDER`; one axis may be ``-1``."""
+    """``"dcn=2,fsdp=4"`` -> ``{"dcn": 2, "fsdp": 4}`` (CLI mesh syntax
+    shared by ``bench.py --mesh`` and the scratch drivers).
+
+    Rejections all raise :class:`MeshAxisError` naming the offending
+    axis: unknown names, duplicates, non-positive sizes (``-1`` is the
+    one allowed wildcard), and ``dcn`` anywhere but first — the slow
+    tier must be the outermost (slowest-varying) axis or the per-pod
+    device blocks ``make_mesh`` carves would interleave pods."""
     sizes: Dict[str, int] = {}
+    order: List[str] = []
     for part in arg.split(","):
         part = part.strip()
         if not part:
             continue
         if "=" not in part:
-            raise ValueError(
-                f"bad mesh axis {part!r} (want e.g. 'fsdp=4,tp=2')")
+            raise MeshAxisError(
+                f"bad mesh axis {part!r} (want e.g. 'dcn=2,fsdp=4')",
+                axis=part)
         name, _, value = part.partition("=")
-        sizes[name.strip()] = int(value)
+        name = name.strip()
+        try:
+            size = int(value)
+        except ValueError:
+            raise MeshAxisError(
+                f"mesh axis {name!r} has non-integer size {value!r}",
+                axis=name) from None
+        if name in sizes:
+            raise MeshAxisError(
+                f"duplicate mesh axis {name!r}", axis=name)
+        if size == 0 or size < -1:
+            raise MeshAxisError(
+                f"mesh axis {name!r} has non-positive size {size} "
+                "(only -1 is allowed as a wildcard)", axis=name)
+        sizes[name] = size
+        order.append(name)
+    if "dcn" in order and order.index("dcn") != 0:
+        raise MeshAxisError(
+            "mesh axis 'dcn' must be outermost (first): the cross-pod "
+            f"tier is the slowest axis, got order {order}", axis="dcn")
     MeshSpec.create(**sizes)   # validates axis names
     return sizes
 
@@ -205,7 +278,7 @@ def validate_divisibility(mesh, *, batch: Optional[int] = None,
                 f"({present}; product {div})")
     if batch is None:
         return
-    axes = ("dp", "fsdp")
+    axes = ("dcn", "dp", "fsdp")
     div = math.prod(mesh.shape.get(a, 1) for a in axes)
     if batch % (div * accum_steps) == 0:
         return

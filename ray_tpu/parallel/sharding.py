@@ -23,7 +23,7 @@ Rules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
 # follows megatron sharding; activations shard batch over (dp, fsdp) and
 # sequence over sp.
 DEFAULT_RULES: Rules = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", ("dcn", "dp", "fsdp")),
     ("seq", "sp"),
     ("kv_seq", None),
     ("embed", None),
@@ -131,11 +131,14 @@ def shard_params(params, mesh, logical_tree, rules: Optional[Rules] = None):
 
 
 def data_axes(mesh):
-    """The mesh axes a batch dimension shards over: (dp, fsdp) present in
-    the mesh with size > 1, collapsed to a single name when alone, or
-    ``None``.  Shared by batch shardings and shard_map in_specs so the
-    two conventions cannot diverge."""
-    axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    """The mesh axes a batch dimension shards over: (dcn, dp, fsdp)
+    present in the mesh with size > 1, collapsed to a single name when
+    alone, or ``None``.  ``dcn`` leads: across pods the model is pure
+    data parallelism, so the batch splits over the slow tier first.
+    Shared by batch shardings and shard_map in_specs so the two
+    conventions cannot diverge."""
+    axes = tuple(a for a in ("dcn", "dp", "fsdp")
+                 if mesh.shape.get(a, 1) > 1)
     if not axes:
         return None
     return axes[0] if len(axes) == 1 else axes

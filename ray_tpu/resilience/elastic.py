@@ -389,7 +389,11 @@ def run_elastic_train_loop(cfg, *, steps: int,
         losses.append(float(metrics["loss"]))   # blocks: the wall is real
         step_wall = time.monotonic() - t_step
         cursor += 1
-        if watch.observe(step_wall):
+        # per-tier baseline: a DCN-crossing step is legitimately
+        # slower than an ICI-only one, so each tier judges its own
+        step_tier = ("dcn" if topo["mesh"].shape.get("dcn", 1) > 1
+                     else "ici")
+        if watch.observe(step_wall, tier=step_tier):
             straggler_events.append(cursor - 1)
             tel.record_straggler()
             target = (_shrink_target(topo["n"], min_devices)

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import collections
 import statistics
-from typing import Deque, List
+from typing import Deque, Dict, List
 
 from ray_tpu.resilience.config import resilience_config
 
@@ -54,6 +54,17 @@ class StragglerSupervisor:
     topology change — step walls legitimately shift with the mesh size
     and accumulation factor, and a stale baseline would misread the
     new normal as a straggle.
+
+    Baselines are kept **per tier** (the ``tier`` kwarg on
+    :meth:`observe`/:meth:`baseline_s`): a DCN-crossing step on a
+    multi-pod mesh is legitimately slower than an ICI-only one, so
+    flagging it against an ICI baseline would convert every cross-pod
+    step into a phantom straggle.  The elastic loop passes
+    ``tier="dcn"`` when the live mesh has a ``dcn`` axis > 1 and
+    ``tier="ici"`` otherwise; callers that never mix tiers can ignore
+    the kwarg (everything lands in one ``"default"`` bucket).  Slow
+    streaks are per-tier too — alternating tiers must not interleave
+    into one phantom streak.
     """
 
     def __init__(self, *, factor: float = None, dwell: int = None,
@@ -72,8 +83,9 @@ class StragglerSupervisor:
                 f"straggler window ({window}) must hold at least "
                 f"min_samples ({min_samples}) steps")
         self.min_samples = int(min_samples)
-        self._walls: Deque[float] = collections.deque(maxlen=window)
-        self._streak = 0
+        self._window = int(window)
+        self._walls: Dict[str, Deque[float]] = {}
+        self._streaks: Dict[str, int] = {}
         self.events = 0
         self.slow_steps = 0
         self.event_log: List[dict] = []
@@ -82,44 +94,55 @@ class StragglerSupervisor:
     def enabled(self) -> bool:
         return self.factor > 0
 
-    def baseline_s(self) -> float:
-        """The rolling-median step wall (0.0 until enough samples)."""
-        if len(self._walls) < self.min_samples:
-            return 0.0
-        return statistics.median(self._walls)
+    def _tier_walls(self, tier: str) -> Deque[float]:
+        if tier not in self._walls:
+            self._walls[tier] = collections.deque(maxlen=self._window)
+        return self._walls[tier]
 
-    def observe(self, wall_s: float) -> bool:
+    def baseline_s(self, tier: str = "default") -> float:
+        """The tier's rolling-median step wall (0.0 until enough
+        samples)."""
+        walls = self._walls.get(tier)
+        if walls is None or len(walls) < self.min_samples:
+            return 0.0
+        return statistics.median(walls)
+
+    def observe(self, wall_s: float, tier: str = "default") -> bool:
         """Feed one step's wall seconds; True when this step completes
-        a sustained straggle (``dwell`` consecutive slow steps) — the
-        caller should shrink the mesh and :meth:`reset`."""
+        a sustained straggle (``dwell`` consecutive slow steps against
+        the SAME tier's baseline) — the caller should shrink the mesh
+        and :meth:`reset`."""
         if not self.enabled:
             return False
         wall_s = float(wall_s)
-        base = self.baseline_s()
+        walls = self._tier_walls(tier)
+        base = self.baseline_s(tier)
         if base <= 0.0:
             # baseline still forming: accept unconditionally — the
             # cold-compile step lands here as one median-robust
             # outlier, never as a straggle verdict
-            self._walls.append(wall_s)
+            walls.append(wall_s)
             return False
         if wall_s <= self.factor * base:
-            self._walls.append(wall_s)
-            self._streak = 0
+            walls.append(wall_s)
+            self._streaks[tier] = 0
             return False
         # slow: count the streak, keep the sample OUT of the baseline
         self.slow_steps += 1
-        self._streak += 1
-        if self._streak < self.dwell:
+        streak = self._streaks.get(tier, 0) + 1
+        self._streaks[tier] = streak
+        if streak < self.dwell:
             return False
         self.events += 1
         self.event_log.append({"wall_s": round(wall_s, 6),
                                "baseline_s": round(base, 6),
-                               "streak": self._streak})
-        self._streak = 0
+                               "streak": streak,
+                               "tier": tier})
+        self._streaks[tier] = 0
         return True
 
     def reset(self) -> None:
-        """Forget the baseline and streak (topology changed: the new
-        mesh has a new normal)."""
+        """Forget every tier's baseline and streak (topology changed:
+        the new mesh has a new normal)."""
         self._walls.clear()
-        self._streak = 0
+        self._streaks.clear()

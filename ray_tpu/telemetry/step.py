@@ -367,7 +367,19 @@ class StepTelemetry:
                         tok_s / self.n_devices(), fpt,
                         self.chip_peak())
         out["hbm"] = self.memory
-        out["collective_bytes_per_step"] = self.collective_bytes()
+        cb = self.collective_bytes()
+        out["collective_bytes_per_step"] = cb
+        if cb is not None:
+            # flattened per-tier rows so perf JSON / dashboards can
+            # plot the tier split without digging into the nested dict
+            for tier in ("ici", "dcn"):
+                t = cb.get(tier) or {}
+                out[f"collective_bytes_{tier}"] = t.get("total", 0)
+                out[f"collective_seconds_{tier}"] = t.get("seconds",
+                                                          0.0)
+            red = (cb.get("dcn") or {}).get("reduction_vs_flat")
+            if red is not None:
+                out["dcn_reduction_vs_flat"] = red
         if self.comm_mode is not None:
             out["comm_mode"] = self.comm_mode
         if self.comm_quant is not None:

@@ -1,0 +1,124 @@
+"""Round-22 on-chip driver: the DCN tier — hierarchical collectives
+and 1F1B over the slow axis.
+
+Usage: python scratch/r22_dcn.py <variant>
+
+Variants:
+  dcn — hierarchy-vs-flat A/B: `bench.py --mesh dcn=2,fsdp=N` (four
+        arms: gspmd / overlap / overlap+int8 / overlap+dcn-quant)
+        against the flat `fsdp=2N` mesh at the same device count.
+        Host-sim validates the numerics and the per-tier byte
+        accounting (dcn reduction_vs_flat ~ pod size, measured 6.93x
+        on the toy shape); the chip/multi-pod question is whether the
+        measured step wall tracks the analytic per-tier seconds — on a
+        real DCN link the flat schedule's full weight-gather stream
+        should be ~pod-size slower than the hierarchy's one shard
+        all-reduce, and `RAY_TPU_COMM_QUANT=dcn` should buy a further
+        ~3.9x on the slow leg without touching ICI grads.
+  pp  — 1F1B bubble sweep: build_gpt_train_pp over a pp=2 mesh,
+        schedule in {gpipe, 1f1b} x microbatches in {2, 4, 8}, step
+        walls vs the analytic bubble fraction
+        (`pipeline_schedule_stats`).  Host-sim shows schedule parity;
+        the chip question is whether measured step time follows
+        (M + 2pp - 2) / M as the bubble amortizes, and where the
+        bounded in-flight (2pp-1 vs M) moves peak HBM.
+
+Carried arms (no chip session yet; every r06-r21 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+spec plus all r6-r20 arms — delegated verbatim to scratch/r21_spec.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "dcn"
+
+_R21_ARMS = ("spec",
+             "disagg",
+             "gray", "straggle",
+             "elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if VARIANT in _R21_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r21_spec.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+if VARIANT == "dcn":
+    # nested mesh first (its record rows carry the per-tier bytes and
+    # reduction_vs_flat), then the flat mesh at the same device count
+    # as the wall-clock comparator
+    import jax  # sizes the meshes to the visible devices
+
+    n = len(jax.devices())
+    if n < 4 or n % 2:
+        print(f"need an even device count >= 4 for dcn=2, have {n}",
+              file=sys.stderr)
+        sys.exit(1)
+    for mesh in (f"dcn=2,fsdp={n // 2}", f"fsdp={n}"):
+        rc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--mesh", mesh]).returncode
+        if rc:
+            sys.exit(rc)
+    sys.exit(0)
+
+assert VARIANT == "pp", f"unknown variant {VARIANT!r}"
+
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import training  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig  # noqa: E402
+from ray_tpu.parallel.mesh import make_mesh  # noqa: E402
+from ray_tpu.parallel.pipeline import pipeline_schedule_stats  # noqa: E402
+
+on_tpu = jax.devices()[0].platform == "tpu"
+if on_tpu:
+    cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=512,
+                         dtype=jnp.bfloat16, remat=True)
+    batch, seq, steps = 16, 512, 20
+else:
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+                    max_seq=128, dtype=jnp.float32, remat=True)
+    batch, seq, steps = 8, 128, 5
+
+mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+bd = training.synthetic_lm_batch(jax.random.PRNGKey(1), batch, seq,
+                                 cfg.vocab_size)
+for schedule in ("gpipe", "1f1b"):
+    for M in (2, 4, 8):
+        fns = training.build_gpt_train_pp(cfg, mesh, schedule=schedule,
+                                          num_microbatches=M,
+                                          telemetry=False)
+        state = fns["init_fn"](jax.random.PRNGKey(0))
+        state, m = fns["step_fn"](state, bd)   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = fns["step_fn"](state, bd)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        stats = pipeline_schedule_stats(2, M, schedule)
+        print(json.dumps({
+            "arm": f"pp-{schedule}-m{M}", "schedule": schedule,
+            "microbatches": M, "step_ms": round(dt * 1e3, 2),
+            "tokens_per_sec": round(batch * seq / dt),
+            "bubble_fraction": round(stats["bubble_fraction"], 4),
+            "in_flight_microbatches": stats["in_flight_microbatches"],
+            "loss": round(float(m["loss"]), 4),
+        }), flush=True)

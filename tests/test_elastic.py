@@ -297,6 +297,67 @@ def test_cross_mesh_state_movement(tiny_cfg, sgd, tmp_path):
             assert _tree_max_delta(back, want) == 0.0
 
 
+@pytest.mark.slow  # two trainer builds (nested 8-dev + flat 4-dev)
+def test_nested_mesh_cross_restore(tiny_cfg, sgd, tmp_path):
+    """r22: save on the nested dcn=2,fsdp=4 mesh -> restore onto flat
+    fsdp=4 (and back) through reshard_state.  The MeshSpec sidecar
+    carries the tier split, so the restore knows dcn=2,fsdp=4 is NOT
+    flat fsdp=8 even at equal device count, and the step cursor rides
+    along exactly."""
+    import jax
+
+    from ray_tpu.models import training
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.resilience import TrainCheckpointer, reshard_state
+    from ray_tpu.resilience.checkpoint import _host_tree
+    devices = jax.devices()
+    nested = make_mesh(dcn=2, fsdp=4, devices=devices)
+    assert MeshSpec.from_mesh(nested).tier_split() == (2, 4)
+    fns_n = training.build_gpt_train(tiny_cfg, nested, optimizer=sgd,
+                                     telemetry=False)
+    state = fns_n["init_fn"](jax.random.PRNGKey(0))
+    batch = training.synthetic_lm_batch(jax.random.PRNGKey(1), 16, 16,
+                                        tiny_cfg.vocab_size)
+    for _ in range(3):
+        state, _ = fns_n["step_fn"](state, batch)
+    want = _host_tree(state)
+
+    example = {"state": state, "extras": {}}
+    with TrainCheckpointer(str(tmp_path), every=1, keep=2,
+                           mesh=nested, accum_steps=1) as ck:
+        ck.save(state, step=3)
+        ck.flush()
+        flat = make_mesh(fsdp=4, devices=devices[:4])
+        fns_f = training.build_gpt_train(tiny_cfg, flat, optimizer=sgd,
+                                         telemetry=False)
+        restored = ck.restore_latest(example=example, mesh=flat,
+                                     reshard=True)
+        # the sidecar records the nested topology, tier split intact
+        assert restored["mesh"].to_dict() == {"dcn": 2, "fsdp": 4}
+        assert restored["mesh"].tier_split() == (2, 4)
+        moved = reshard_state(restored["state"],
+                              fns_f["state_shardings"])
+        assert int(moved.step) == 3          # cursor-exact
+        for leaf, sh in zip(
+                jax.tree.leaves(moved),
+                jax.tree.leaves(fns_f["state_shardings"],
+                                is_leaf=lambda x: hasattr(x, "spec"))):
+            assert leaf.sharding == sh, (leaf.shape, sh)
+        # the flat trainer keeps stepping from the restored cursor
+        state_f, _ = fns_f["step_fn"](moved, batch)
+        assert int(state_f.step) == 4
+        # and back onto the nested mesh, value-exact
+        restored_f = ck.restore_latest(example=example, mesh=flat,
+                                       reshard=True)
+        back = reshard_state(
+            reshard_state(restored_f["state"],
+                          fns_f["state_shardings"]),
+            fns_n["state_shardings"])
+        assert jax.tree.structure(back) == jax.tree.structure(state)
+        assert _tree_max_delta(back, want) == 0.0
+        assert int(back.step) == 3
+
+
 def test_reshard_indivisible_is_typed():
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -217,6 +217,49 @@ def test_straggler_supervisor_blip_vs_sustained():
         StragglerSupervisor(factor=2.0, window=2)
 
 
+def test_straggler_per_tier_baselines():
+    """r22: multi-pod meshes keep a baseline PER fabric tier — a
+    DCN-crossing step is legitimately slower than an ICI-only one, so
+    it must be judged against its own tier's median, and slow streaks
+    must not interleave across tiers into a phantom event."""
+    from ray_tpu.resilience import StragglerSupervisor
+    sup = StragglerSupervisor(factor=3.0, dwell=2, window=8)
+    # two tiers, 10x apart in normal step wall
+    for w in (0.01, 0.011, 0.01):
+        assert sup.observe(w, tier="ici") is False
+    for w in (0.1, 0.11, 0.1):
+        assert sup.observe(w, tier="dcn") is False
+    assert sup.baseline_s("ici") == pytest.approx(0.01)
+    assert sup.baseline_s("dcn") == pytest.approx(0.1)
+    # a 0.1s step is 10x the ICI baseline but NORMAL for the dcn tier:
+    # judged against its own baseline, it is accepted silently
+    assert sup.observe(0.1, tier="dcn") is False
+    assert sup.slow_steps == 0
+    # streaks are per-tier: slow-ici, slow-dcn, slow-ici must not fire
+    # a dwell=2 event (no tier saw two CONSECUTIVE slow steps...)
+    assert sup.observe(0.05, tier="ici") is False
+    assert sup.observe(0.5, tier="dcn") is False
+    assert sup.events == 0
+    # ...but the second consecutive slow step on one tier does fire,
+    # and the event names its tier
+    assert sup.observe(0.05, tier="ici") is True
+    assert sup.events == 1
+    assert sup.event_log[-1]["tier"] == "ici"
+    assert sup.event_log[-1]["baseline_s"] == pytest.approx(0.01)
+    # the dcn tier's streak is still one: its own second slow step
+    # completes its own event
+    assert sup.observe(0.5, tier="dcn") is True
+    assert sup.event_log[-1]["tier"] == "dcn"
+    # reset forgets every tier
+    sup.reset()
+    assert sup.baseline_s("ici") == 0.0
+    assert sup.baseline_s("dcn") == 0.0
+    # tier-less callers land in one "default" bucket (back-compat)
+    for w in (0.02, 0.02, 0.02):
+        sup.observe(w)
+    assert sup.baseline_s() == pytest.approx(0.02)
+
+
 def test_straggler_config_env_knobs(monkeypatch):
     from ray_tpu.resilience import StragglerSupervisor
     from ray_tpu.resilience.config import resilience_config
@@ -230,7 +273,7 @@ def test_straggler_config_env_knobs(monkeypatch):
     resilience_config(refresh=True)
     sup = StragglerSupervisor()
     assert (sup.factor, sup.dwell) == (2.5, 5)
-    assert sup._walls.maxlen == 32
+    assert sup._tier_walls("default").maxlen == 32
     # out-of-range knobs clamp loudly instead of crashing the loop
     monkeypatch.setenv("RAY_TPU_STRAGGLER_FACTOR", "-1")
     monkeypatch.setenv("RAY_TPU_STRAGGLER_DWELL", "0")
