@@ -899,6 +899,148 @@ def bench_infer():
     print(json.dumps(result))
 
 
+def bench_infer_tiers():
+    """Tiered-KV-cache A/B: ``python bench.py --infer --tiers``.
+
+    Three arms over the same trace — a shared system prefix warmed
+    once, eviction pressure that forces it out of HBM, then a
+    re-admission wave: ``flat`` (no spill tiers — every evicted page
+    is re-prefilled), ``tiered_int8`` (host-DRAM pool + object store,
+    int8 spill — the default wire format) and ``tiered_f32``
+    (``spill_dtype=model`` — exact but ~``itemsize x`` the bytes).
+    Prints ONE JSON line: per-arm per-tier hit counts and rates, the
+    re-admission wave's TTFT split by the tier that served it,
+    measured spill/fetch bytes+seconds against the analytic per-page
+    pricing (int8 moves ``head_dim + 4`` bytes per cached vector vs
+    ``head_dim * itemsize``), and the compile counters (tier installs
+    scatter between ticks — a tiered arm must compile NOTHING beyond
+    the flat arm's executables).  On CPU the model shrinks to a smoke
+    configuration (numbers exercise the engine, not the hardware).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.inference import InferenceEngine, KVPageStore
+    from ray_tpu.inference.kv_cache import handoff_page_bytes
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    platform = jax.devices()[0].platform
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    slots, page, max_new = 2, 16, 4
+    buckets = (16, 32, 64, 128)
+    num_pages, host_pages = 12, 4
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(23)
+    shared = list(rng.randint(0, cfg.vocab_size, size=40))  # 2 pages
+    warm_wave = [shared + list(rng.randint(0, cfg.vocab_size, size=3))
+                 for _ in range(2)]
+    pressure = [list(rng.randint(0, cfg.vocab_size, size=90))
+                for _ in range(3)]
+    readmit = [shared + list(rng.randint(0, cfg.vocab_size,
+                                         size=4 + i))
+               for i in range(4)]
+
+    def build(**tiers):
+        return InferenceEngine(
+            cfg, params, slots=slots, page_size=page, buckets=buckets,
+            num_pages=num_pages, telemetry=True, max_queue=0,
+            executable_cache=executables, **tiers)
+
+    executables = {}
+    warmup = build()
+    for p in warm_wave + pressure + readmit:
+        warmup.generate([p], max_new_tokens=max_new)
+    warmup_compiles = dict(warmup.compile_counts)
+    del warmup
+
+    arms = []
+    for name, tiers in (
+            ("flat", {}),
+            ("tiered_int8",
+             {"host_pages": host_pages, "spill_dtype": "int8",
+              "store": KVPageStore(use_object_store=False)}),
+            ("tiered_f32",
+             {"host_pages": host_pages, "spill_dtype": "model",
+              "store": KVPageStore(use_object_store=False)})):
+        engine = build(**tiers)
+        for p in warm_wave:
+            engine.generate([p], max_new_tokens=max_new)
+        for p in pressure:
+            engine.generate([p], max_new_tokens=max_new)
+        # re-admission: classify each request by the warmest tier
+        # that served its prefix, TTFT split accordingly
+        ttft_by = {"hbm": [], "dram": [], "store": [], "miss": []}
+        for p in readmit:
+            before = dict(engine.tier_hits) if engine.tiered else {
+                "hbm": engine.stats()["prefix"]["hit_pages"]}
+            t0 = time.monotonic()
+            engine.generate([p], max_new_tokens=max_new)
+            wall = time.monotonic() - t0
+            served = "miss"
+            if engine.tiered:
+                delta = {t: engine.tier_hits[t] - before.get(t, 0)
+                         for t in engine.tier_hits}
+            else:
+                delta = {"hbm": engine.stats()["prefix"]["hit_pages"]
+                         - before["hbm"]}
+            for t in ("hbm", "dram", "store"):
+                if delta.get(t):
+                    served = t          # deepest tier touched wins
+            ttft_by[served].append(wall)
+        st = engine.stats()
+        tiers_st = st["tiers"]
+        eligible = len(readmit) * (len(shared) // page)
+        hits = dict(tiers_st["hits"]) if tiers_st["enabled"] else {
+            "hbm": st["prefix"]["hit_pages"], "dram": 0, "store": 0}
+        arms.append({
+            "arm": name,
+            "tiered": tiers_st["enabled"],
+            "spill_dtype": tiers_st["spill_dtype"],
+            "tier_hits": hits,
+            "readmit_hit_rate": round(
+                min(sum(hits.values()), eligible) / eligible, 4),
+            "ttft_by_tier_ms": {
+                t: round(1e3 * sum(v) / len(v), 3)
+                for t, v in ttft_by.items() if v},
+            "spill_bytes": tiers_st["spill_bytes"],
+            "fetches": tiers_st["fetches"],
+            "fetch_seconds": round(tiers_st["fetch_seconds"], 6),
+            "evictions": st["prefix"]["evictions"],
+            "host": tiers_st["host"],
+            "store": tiers_st["store"],
+            # steady state: every arm rides the warmup's executables
+            "compiles": st["compiles"],
+        })
+        assert sum(st["compiles"].values()) == 0, (name,
+                                                   st["compiles"])
+        assert engine.leak_free(), name
+
+    head_dim = cfg.d_model // cfg.n_heads
+    kw = dict(n_layers=cfg.n_layers, page_size=page,
+              n_heads=cfg.n_heads, head_dim=head_dim)
+    result = {
+        "metric": "infer_tiered_kv_ab",
+        "platform": platform,
+        "page_size": page,
+        "num_pages": num_pages,
+        "host_pages": host_pages,
+        "shared_prompt_tokens": len(shared),
+        # analytic per-page spill pricing: what one demoted page costs
+        # on the host-DRAM/object-store legs per format
+        "page_bytes_analytic": {
+            "int8": handoff_page_bytes(itemsize=1, quantized=True,
+                                       **kw),
+            "f32": handoff_page_bytes(itemsize=4, quantized=False,
+                                      **kw),
+        },
+        "warmup_compiles": warmup_compiles,
+        "arms": arms,
+    }
+    print(json.dumps(result))
+
+
 def bench_infer_spec():
     """Speculative-decoding headline: self-drafting draft-and-verify.
 
@@ -1389,7 +1531,9 @@ def main():
         return
     if "--infer" in sys.argv:
         n = _replicas_arg()
-        if "--spec" in sys.argv:
+        if "--tiers" in sys.argv:
+            bench_infer_tiers()
+        elif "--spec" in sys.argv:
             bench_infer_spec()
         elif "--gray" in sys.argv:
             # the demotion median wants an odd-one-out: 3+ replicas

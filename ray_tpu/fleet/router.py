@@ -346,20 +346,37 @@ class FleetRouter:
         return PrefixIndex.chain_hashes(prompt,
                                         self.page_size)[:eligible]
 
+    # Tier-aware affinity weights (r23): an HBM-resident page is a
+    # pure refcount bump; a host-DRAM page pays one host->device page
+    # copy, so it is worth most-but-not-all of an HBM hit — a replica
+    # holding the whole prefix spilled still beats one holding a short
+    # resident stub.  The store tier is deliberately weightless: any
+    # replica fetches a store page at the same price, so store
+    # coverage cannot differentiate candidates (those requests fall
+    # through to the pow-2 load pick and warm whichever replica wins).
+    TIER_WEIGHT_HBM = 1.0
+    TIER_WEIGHT_DRAM = 0.8
+
     def _affinity_pick(self, prompt, cands) -> Optional[EngineReplica]:
+        """The tier-aware cost model over the r16 prefix-affinity
+        pick: candidates score by how much re-prefill their warm tiers
+        save (HBM hit > DRAM hit > nothing; ties break toward the
+        shallower queue), and the winner still yields to pow-2 when
+        its queue is past the affinity cap — a hot cache must not
+        become a hot spot."""
         hashes = self._chain_hashes(prompt)
         if not hashes:
             return None
-        best, best_hits = None, 0
+        best, best_score = None, 0.0
         for r in cands:
-            digest = r.prefix_digest()
-            hits = 0
-            for h in hashes:
-                if h not in digest:
-                    break
-                hits += 1
-            if hits > best_hits:
-                best, best_hits = r, hits
+            n_hbm, n_dram = r.tier_hits(hashes)
+            score = (n_hbm * self.TIER_WEIGHT_HBM
+                     + n_dram * self.TIER_WEIGHT_DRAM)
+            if score > best_score or (
+                    score == best_score and best is not None
+                    and score > 0.0
+                    and r.queue_depth() < best.queue_depth()):
+                best, best_score = r, score
         if best is not None \
                 and best.queue_depth() < self.cfg.affinity_cap:
             return best
@@ -810,4 +827,11 @@ class FleetRouter:
             "in_flight": len(self._by_rid),
             "affinity": self.affinity,
             "hedge_deadline_s": self.hedge_deadline_s(),
+            # r23: the fleet-shared KV page store, when any replica
+            # tiers into one (replicas share the instance, so the
+            # first is everyone's view)
+            "kv_store": next(
+                (r.engine.store.stats()
+                 for r in self._replicas.values()
+                 if r.engine.store is not None), None),
         }

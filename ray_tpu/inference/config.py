@@ -96,6 +96,25 @@ class InferConfig:
     - ``RAY_TPU_INFER_SPEC_K`` (default ``4``): default draft length
       cap per verify step when speculation is on.  Per-request
       ``SamplingParams.spec_k`` overrides win.
+    - ``RAY_TPU_KV_HOST_PAGES`` (default ``0`` = tiering off): capacity
+      in pages of the per-engine host-DRAM spill pool (tier 1).  With
+      it set, LRU evictions from HBM *demote* a prefix page's contents
+      host-side instead of forgetting them, and admission's prefix
+      walk extends through the pool — a later request promotes the
+      page back into fresh HBM between ticks at zero prefill compute.
+    - ``RAY_TPU_KV_STORE`` (default ``1``): participate in the
+      fleet-shared content-addressed page store (tier 2) when tiering
+      is on — host-pool overflow demotes on to the store, and
+      admission's walk extends through it, so every replica (including
+      restarts and scale-from-zero spawns) warms up from pages any
+      other replica prefilled.  ``0`` caps the hierarchy at host DRAM.
+    - ``RAY_TPU_KV_SPILL_DTYPE`` (default ``int8``): spill/wire format
+      for demoted pages — ``int8`` (per-vector block-scaled codes,
+      ``head_dim + 4`` bytes per cached vector: ~2x cheaper DRAM/store
+      residency and fetch bytes, the r11/r22 trick applied to the spill
+      tier) or ``model`` (raw storage-dtype bytes, exact).  int8
+      caches always spill their codes + scales verbatim (already the
+      cheapest exact form).
     """
     slots: int = 8
     page_size: int = 128
@@ -111,6 +130,9 @@ class InferConfig:
     stream_idle: float = 0.0
     spec: bool = False
     spec_k: int = 4
+    host_pages: int = 0
+    store: bool = True
+    spill_dtype: str = "int8"
 
 
 _CONFIG: Optional[InferConfig] = None
@@ -161,6 +183,16 @@ def infer_config(refresh: bool = False) -> InferConfig:
             print(f"RAY_TPU_INFER_SPEC_K={spec_k} < 1; using 4",
                   file=sys.stderr)
             spec_k = 4
+        host_pages = int(env("RAY_TPU_KV_HOST_PAGES", "0"))
+        if host_pages < 0:
+            print(f"RAY_TPU_KV_HOST_PAGES={host_pages} negative; "
+                  "using 0 (tiering off)", file=sys.stderr)
+            host_pages = 0
+        spill_dtype = env("RAY_TPU_KV_SPILL_DTYPE", "int8")
+        if spill_dtype not in ("int8", "model"):
+            print(f"RAY_TPU_KV_SPILL_DTYPE={spill_dtype!r} unknown; "
+                  "using 'int8'", file=sys.stderr)
+            spill_dtype = "int8"
         _CONFIG = InferConfig(
             slots=int(env("RAY_TPU_INFER_SLOTS", "8")),
             page_size=int(env("RAY_TPU_INFER_PAGE_SIZE", "128")),
@@ -176,6 +208,9 @@ def infer_config(refresh: bool = False) -> InferConfig:
             stream_idle=stream_idle,
             spec=env("RAY_TPU_INFER_SPEC", "0") != "0",
             spec_k=spec_k,
+            host_pages=host_pages,
+            store=env("RAY_TPU_KV_STORE", "1") != "0",
+            spill_dtype=spill_dtype,
         )
     return _CONFIG
 

@@ -199,6 +199,9 @@ class InferenceEngine:
                  deadline: Optional[float] = None,
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
+                 host_pages: Optional[int] = None,
+                 store: Union["kvc.KVPageStore", bool, None] = None,
+                 spill_dtype: Optional[str] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
                  executable_cache: Optional[Dict[Any, Any]] = None):
@@ -259,6 +262,48 @@ class InferenceEngine:
             page_size=self.page_size, n_heads=cfg.n_heads,
             head_dim=cfg.head_dim, dtype=cfg.dtype,
             kv_dtype=self.kv_dtype)
+        # tiered KV cache (r23): HBM (tier 0, the refcounted pages
+        # above) -> per-engine host-DRAM spill pool (tier 1) ->
+        # fleet-shared content-addressed page store (tier 2).  ``store``
+        # takes a shared KVPageStore (the fleet wiring), True for a
+        # private one, None to follow config (a private store when
+        # tiering is on and RAY_TPU_KV_STORE allows).  Tiering needs
+        # the prefix index — demoted entries are keyed by its chain
+        # hashes (+ param version, the set_params invalidation).
+        self.host_pages = (icfg.host_pages if host_pages is None
+                           else int(host_pages))
+        self.spill_dtype = spill_dtype or icfg.spill_dtype
+        if self.spill_dtype not in kvc.SPILL_DTYPES:
+            raise ValueError(
+                f"unknown spill_dtype {self.spill_dtype!r} "
+                "(check RAY_TPU_KV_SPILL_DTYPE)")
+        if self.host_pages < 0:
+            raise ValueError(f"host_pages must be >= 0, got "
+                             f"{self.host_pages} "
+                             "(check RAY_TPU_KV_HOST_PAGES)")
+        if isinstance(store, kvc.KVPageStore):
+            self.store: Optional[kvc.KVPageStore] = store
+        elif store is True or (store is None and icfg.store
+                               and self.host_pages > 0):
+            self.store = kvc.KVPageStore()
+        else:
+            self.store = None
+        self.tiered = self.prefix and (self.host_pages > 0
+                                       or self.store is not None)
+        if self.tiered:
+            self.host_pool: Optional[kvc.HostPagePool] = \
+                kvc.HostPagePool(self.host_pages, store=self.store)
+            self.scheduler.allocator.spill_hook = self._spill_page
+            self.scheduler.tier_lookup = self._tier_probe
+        else:
+            self.host_pool = None
+        # per-tier hit/traffic counters (stats()["tiers"] + telemetry)
+        self.tier_hits = {"hbm": 0, "dram": 0, "store": 0}
+        self.spill_bytes = 0
+        self.spill_faults = 0
+        self.fetches = 0
+        self.fetch_seconds = 0.0
+        self.fetch_faults = 0
         # compile cache: key -> AOT executable; an executable raises on
         # shape drift, so the counters below are honest.  Keys carry
         # the full (cfg, geometry) so a shared cache cannot alias
@@ -620,6 +665,11 @@ class InferenceEngine:
         ``version`` pins it — publications carry the learner's own
         counter so actor-side lag is measured in learner versions)."""
         self.scheduler.flush_prefix()
+        if self.host_pool is not None:
+            # spilled entries hold K/V computed under the old params;
+            # drop them rather than demote (the store invalidates by
+            # key — the bumped version simply never matches)
+            self.host_pool.clear()
         new = jax.device_put(params)
         jax.block_until_ready(new)
         old, self.params = self.params, new
@@ -680,6 +730,22 @@ class InferenceEngine:
                 "k_hist": dict(sorted(self.spec_k_hist.items())),
                 "drafts": len(self._drafts),
             },
+            # tiered KV cache (r23): per-tier prefix hits plus the
+            # demote/promote legs' byte/latency/fault accounting
+            "tiers": {
+                "enabled": self.tiered,
+                "hits": dict(self.tier_hits),
+                "spill_dtype": self.spill_dtype,
+                "spill_bytes": self.spill_bytes,
+                "spill_faults": self.spill_faults,
+                "fetches": self.fetches,
+                "fetch_seconds": self.fetch_seconds,
+                "fetch_faults": self.fetch_faults,
+                "host": (self.host_pool.stats()
+                         if self.host_pool is not None else None),
+                "store": (self.store.stats()
+                          if self.store is not None else None),
+            },
         }
 
     # ------------------------------------------------------ engine tick
@@ -698,6 +764,13 @@ class InferenceEngine:
             if req.import_payload is not None:
                 self._install_import(req, events)
             else:
+                if req.n_hit_pages:
+                    self.tier_hits["hbm"] += req.n_hit_pages
+                    if self.telemetry.enabled:
+                        self.telemetry.record_prefix_hits(
+                            req.n_hit_pages, tier="hbm")
+                if req.tier_plan:
+                    self._install_tier_hits(req)
                 self._prefill(req, events)
         if self.scheduler.active:
             # speculating slots leave the plain decode batch for this
@@ -711,6 +784,11 @@ class InferenceEngine:
                 self._verify(slot, drafts, events)
         self.ticks += 1
         self.last_tick_ts = time.monotonic()
+        if self.tiered and self.telemetry.enabled:
+            self.telemetry.record_tier_occupancy(
+                hbm=len(self.scheduler.prefix_index or ()),
+                dram=len(self.host_pool) if self.host_pool else 0,
+                store=len(self.store) if self.store else 0)
         return events
 
     def generate(self, prompts, max_new_tokens: int = 16,
@@ -797,7 +875,7 @@ class InferenceEngine:
             tok, logp = toks[0], logps[0]
         # the prompt's K/V are now fully in cache: its full pages are
         # immutable from here on and safe to hand to other requests
-        sched.register_prefix(req)
+        self._register_prefix(req)
         if self.debug_logits:
             self.logits_trace.setdefault(req.rid, []).append(
                 np.asarray(logits[0]))
@@ -847,13 +925,141 @@ class InferenceEngine:
                              [present.index(i) for i in needed])
         # contents are in cache: the imported full pages are immutable
         # from here on and registrable for later handoffs/prompts
-        sched.register_prefix(req)
+        self._register_prefix(req)
         sched.lengths[slot] = n_ctx
         req.generated = [int(handoff.next_token)]
         req.logprobs = [float(handoff.next_logprob)]
         req.cached_tokens = n_ctx
         req.import_payload = None      # drop the content reference
         self.imports += 1
+
+    # ------------------------------------------------ tiered cache (r23)
+    def _register_prefix(self, req: Request) -> None:
+        """Register the request's freshly-written full pages, then drop
+        any of those hashes from the host pool: a degraded fetch (fault
+        or stale plan) leaves the page to the prefill, and without the
+        discard the hash would sit in two local tiers at once — the
+        exact-partition invariant the leak audit asserts."""
+        self.scheduler.register_prefix(req)
+        if self.host_pool is not None and req.chain_hashes:
+            for h in req.chain_hashes[req.n_hit_pages:]:
+                self.host_pool.discard((h, self.param_version))
+
+    def _tier_probe(self, chain_hash: bytes) -> bool:
+        """Does a lower tier hold this hash under the live params?
+        The scheduler's ``tier_lookup`` — advisory only: the install
+        re-resolves each page and degrades any miss to prefill."""
+        key = (chain_hash, self.param_version)
+        if self.host_pool is not None and key in self.host_pool:
+            return True
+        return self.store is not None and key in self.store
+
+    def _spill_page(self, page: int, chain_hash: bytes) -> None:
+        """HBM -> host-DRAM demote leg (the allocator's ``spill_hook``,
+        fired when pressure evicts a registered idle page).  One
+        device->host gather, encoded in the spill dtype, keyed by
+        (chain hash, param version).  An injected ``kv.spill`` fault
+        degrades to the pre-r23 behavior — the page is simply
+        forgotten and a later request re-prefills it."""
+        from ray_tpu.util import chaos
+        try:
+            chaos.maybe_fail("kv.spill")
+        except chaos.InjectedFault:
+            self.spill_faults += 1
+            return
+        contents = kvc.export_pages(self.cache, [page])
+        entry = kvc.encode_spill_page(contents,
+                                      quantized=self.cache.quantized,
+                                      spill_dtype=self.spill_dtype)
+        nb = kvc.spill_entry_bytes(entry)
+        self.spill_bytes += nb
+        self.host_pool.put((chain_hash, self.param_version), entry)
+        if self.telemetry.enabled:
+            self.telemetry.record_kv_spill(nb)
+
+    def _install_tier_hits(self, req: Request) -> None:
+        """Promote the admission plan's lower-tier pages into the
+        request's freshly-allocated HBM pages, between ticks (the
+        ``import_pages`` pattern: functional ``.at[].set``, zero new
+        executables).  Pages install front-to-back and the first
+        failure — an injected ``kv.fetch`` fault, a plan gone stale
+        (demoted past reach or invalidated), a foreign-geometry store
+        entry — stops the walk: the remaining pages stay with the
+        suffix prefill, so any fault degrades to re-prefill-from-
+        prompt with exact continuations, never a hang.  Each installed
+        page registers immediately (resident for the next request) and
+        counts as a prefix hit via ``note_tier_hits``."""
+        from ray_tpu.util import chaos
+        sched = self.scheduler
+        installed = 0
+        for i in range(req.n_hit_pages,
+                       req.n_hit_pages + req.tier_plan):
+            key = (req.chain_hashes[i], self.param_version)
+            t0 = time.monotonic()
+            try:
+                chaos.maybe_fail("kv.fetch")
+            except chaos.InjectedFault:
+                self.fetch_faults += 1
+                break
+            tier = "dram"
+            entry = (self.host_pool.take(key)
+                     if self.host_pool is not None else None)
+            checked_out = False
+            if entry is None and self.store is not None:
+                entry = self.store.checkout(key)
+                checked_out = entry is not None
+                tier = "store"
+            if entry is None:
+                break           # advisory plan went stale: prefill
+            try:
+                if not kvc.spill_entry_matches(self.cache, entry):
+                    break       # foreign geometry reads as a miss
+                kvc.install_spill_page(self.cache, req.pages[i],
+                                       entry)
+            finally:
+                if checked_out:
+                    self.store.checkin(key)
+            if sched.prefix_index is not None:
+                sched.prefix_index.register(req.chain_hashes[i],
+                                            req.pages[i])
+            wall = time.monotonic() - t0
+            self.tier_hits[tier] += 1
+            self.fetches += 1
+            self.fetch_seconds += wall
+            if self.telemetry.enabled:
+                self.telemetry.record_kv_fetch(wall, tier=tier)
+                self.telemetry.record_prefix_hits(1, tier=tier)
+            installed += 1
+        req.tier_plan = 0
+        sched.note_tier_hits(req, installed)
+
+    def leak_free(self) -> bool:
+        """Tier-inventory audit: the usable HBM pages partition exactly
+        into free / idle / held, the host pool respects its capacity
+        and never holds a hash that is also resident (a demoted entry
+        is in exactly one local tier), and no store fetch is left in
+        flight.  The fleet replicas' audits call through here."""
+        alloc = self.scheduler.allocator
+        free = set(alloc._free)
+        idle = set(alloc._idle)
+        held = set(alloc._refcount)
+        usable = set(range(1, alloc.num_pages))
+        if (free | idle | held != usable or (free & idle)
+                or (free & held) or (idle & held)):
+            return False
+        if len(alloc._free) != len(alloc._free_set):
+            return False
+        if self.host_pool is not None:
+            if len(self.host_pool) > self.host_pool.capacity:
+                return False
+            if self.scheduler.prefix_index is not None:
+                resident = {(h, self.param_version) for h in
+                            self.scheduler.prefix_index.digest()}
+                if resident & set(self.host_pool._entries):
+                    return False
+        if self.store is not None and self.store.in_flight != 0:
+            return False
+        return True
 
     # ----------------------------------------------------------- decode
     def _decode(self, events, skip: Optional[Set[int]] = None) -> None:

@@ -56,6 +56,22 @@ inside its context strips.  At head_dim 64 that is 68 bytes per cached
 vector (64 codes + one f32 scale) vs 128 in bf16 — :meth:`KVCache.bytes`
 counts both arrays, so the ~2x capacity-per-HBM-byte claim is
 asserted, not assumed.
+
+**Tiered spill (r23).**  LRU eviction *demotes* instead of forgets:
+when :meth:`PageAllocator.alloc` runs the free list dry and reclaims
+an idle prefix page, the allocator's ``spill_hook`` first copies the
+page's contents host-side into a per-engine :class:`HostPagePool`
+(tier 1, pinned DRAM), and the pool's own LRU overflow demotes on to a
+fleet-shared content-addressed :class:`KVPageStore` (tier 2, the
+object store).  Entries are keyed ``(chain_hash, param_version)`` so a
+``set_params`` swap invalidates by key mismatch, never by a store
+sweep; the spill format defaults to int8 codes + per-vector scales
+(:func:`encode_spill_page`), halving resident and wire bytes exactly
+as the r20 handoff and r22 DCN paths do.  Promotion is the reverse
+walk: admission finds the hash in a lower tier, a fresh HBM page is
+allocated, and :func:`install_spill_page` scatters the contents back
+between ticks — the same functional ``.at[].set`` as
+:func:`import_pages`, zero new executables.
 """
 
 from __future__ import annotations
@@ -229,6 +245,281 @@ def import_pages(cache: "KVCache", pages: Sequence[int],
             handoff.v_scale[:, sel])
 
 
+SPILL_DTYPES = ("int8", "model")
+
+
+def _quantize_page(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-vector symmetric int8: ``scale = amax/127`` over the last
+    axis, codes rounded-to-nearest — the same block shape the int8
+    cache stores, so a spilled page prices identically to a resident
+    one (``head_dim + 4`` bytes per cached vector)."""
+    x = np.asarray(x, np.float32)
+    amax = np.abs(x).max(axis=-1)
+    scale = (amax / 127.0).astype(np.float32)
+    safe = np.where(scale == 0.0, 1.0, scale)
+    codes = np.rint(x / safe[..., None]).clip(-127, 127)
+    return codes.astype(np.int8), scale
+
+
+def encode_spill_page(contents: Dict[str, np.ndarray], *,
+                      quantized: bool,
+                      spill_dtype: str = "int8") -> Dict[str, object]:
+    """One page's host-side spill entry from an :func:`export_pages`
+    single-page gather.  int8 caches pass their codes + scales through
+    unchanged (already the cheapest exact form); model-dtype caches
+    quantize per vector when ``spill_dtype="int8"`` (the default — the
+    r11/r22 trick applied to the spill/wire tier) or keep raw bytes
+    under ``"model"``."""
+    k, v = contents["k"][:, 0], contents["v"][:, 0]
+    if quantized:
+        return {"fmt": "int8", "k": k, "v": v,
+                "k_scale": contents["k_scale"][:, 0],
+                "v_scale": contents["v_scale"][:, 0]}
+    if spill_dtype == "int8":
+        k8, ks = _quantize_page(k)
+        v8, vs = _quantize_page(v)
+        return {"fmt": "int8", "k": k8, "v": v8,
+                "k_scale": ks, "v_scale": vs}
+    return {"fmt": "model", "k": np.asarray(k), "v": np.asarray(v)}
+
+
+def spill_entry_bytes(entry: Dict[str, object]) -> int:
+    return sum(a.nbytes for a in entry.values()
+               if isinstance(a, np.ndarray))
+
+
+def spill_entry_matches(cache: "KVCache",
+                        entry: Dict[str, object]) -> bool:
+    """Geometry guard before an install: a fleet-shared store entry
+    written by a different-geometry engine must read as a miss, never
+    a shape error mid-admission."""
+    want = tuple(cache.k.shape[:1]) + tuple(cache.k.shape[2:])
+    return tuple(entry["k"].shape) == want
+
+
+def install_spill_page(cache: "KVCache", page: int,
+                       entry: Dict[str, object]) -> None:
+    """Scatter one spilled entry back into device ``page`` — the
+    promote leg.  Functional ``.at[:, page].set`` between ticks, like
+    :func:`import_pages`: the next compiled step's donated state picks
+    it up, so promotion needs zero new executables.  int8 entries feed
+    an int8 cache verbatim; a model-dtype cache dequantizes on the
+    host first (the int8-budget approximation the r11 parity tests
+    bound)."""
+    if cache.quantized:
+        if entry["fmt"] == "int8":
+            k, ks = entry["k"], entry["k_scale"]
+            v, vs = entry["v"], entry["v_scale"]
+        else:
+            k, ks = _quantize_page(entry["k"])
+            v, vs = _quantize_page(entry["v"])
+        cache.k = cache.k.at[:, page].set(k)
+        cache.v = cache.v.at[:, page].set(v)
+        cache.k_scale = cache.k_scale.at[:, page].set(ks)
+        cache.v_scale = cache.v_scale.at[:, page].set(vs)
+        return
+    if entry["fmt"] == "int8":
+        k = entry["k"].astype(np.float32) * entry["k_scale"][..., None]
+        v = entry["v"].astype(np.float32) * entry["v_scale"][..., None]
+    else:
+        k, v = entry["k"], entry["v"]
+    dt = cache.k.dtype
+    cache.k = cache.k.at[:, page].set(jnp.asarray(k, dt))
+    cache.v = cache.v.at[:, page].set(jnp.asarray(v, dt))
+
+
+class HostPagePool:
+    """Tier 1: the per-engine pinned host-DRAM spill pool.
+
+    An LRU ``(chain_hash, param_version) -> spill entry`` map with a
+    hard page capacity.  :meth:`put` is the HBM demote target;
+    overflow demotes the oldest entry on to the fleet-shared
+    :class:`KVPageStore` (tier 2) when one is attached — through the
+    ``kv.spill`` chaos site, so a faulted store leg degrades to
+    forgetting the page (a later request re-prefills; nothing hangs).
+    :meth:`take` pops — tiers stay exclusive per engine, which is what
+    lets the leak audit assert the free/idle/held/host partition
+    exactly.
+    """
+
+    def __init__(self, capacity_pages: int,
+                 store: Optional["KVPageStore"] = None):
+        if capacity_pages < 0:
+            raise ValueError("host pool capacity must be >= 0")
+        self.capacity = capacity_pages
+        self.store = store
+        self._entries: "collections.OrderedDict[Tuple[bytes, int], Dict]" \
+            = collections.OrderedDict()
+        self.spills = 0          # entries accepted (HBM -> DRAM)
+        self.demotions = 0       # entries pushed on to the store
+        self.dropped = 0         # overflow with no store / faulted leg
+        self.hits = 0
+        self.misses = 0
+        self.bytes_spilled = 0
+        self.bytes = 0           # current resident bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Tuple[bytes, int]) -> bool:
+        return key in self._entries
+
+    def put(self, key: Tuple[bytes, int],
+            entry: Dict[str, object]) -> None:
+        from ray_tpu.util import chaos
+        if self.capacity == 0:
+            self._demote(key, entry, chaos)
+            return
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        self._entries[key] = entry
+        nb = spill_entry_bytes(entry)
+        self.spills += 1
+        self.bytes_spilled += nb
+        self.bytes += nb
+        while len(self._entries) > self.capacity:
+            old_key, old = self._entries.popitem(last=False)
+            self.bytes -= spill_entry_bytes(old)
+            self._demote(old_key, old, chaos)
+
+    def _demote(self, key, entry, chaos) -> None:
+        """DRAM -> store leg (or a straight drop without a store)."""
+        if self.store is None:
+            self.dropped += 1
+            return
+        try:
+            chaos.maybe_fail("kv.spill")
+        except chaos.InjectedFault:
+            self.dropped += 1       # degrade: re-prefill later
+            return
+        self.store.put(key, entry)
+        self.demotions += 1
+
+    def take(self, key: Tuple[bytes, int]
+             ) -> Optional[Dict[str, object]]:
+        """Pop an entry for promotion (None on miss)."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self.bytes -= spill_entry_bytes(entry)
+        return entry
+
+    def discard(self, key: Tuple[bytes, int]) -> None:
+        """Silently drop an entry that just became HBM-resident again
+        (a degraded fetch fell back to prefill and re-registered the
+        hash): without this, the hash would sit in two local tiers at
+        once and break the exact-partition leak audit.  Not a miss —
+        no counter moves."""
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self.bytes -= spill_entry_bytes(entry)
+
+    def clear(self) -> int:
+        """Drop everything (weight swap: contents are stale)."""
+        n = len(self._entries)
+        self._entries.clear()
+        self.bytes = 0
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries),
+                "capacity": self.capacity, "bytes": self.bytes,
+                "spills": self.spills, "demotions": self.demotions,
+                "dropped": self.dropped, "hits": self.hits,
+                "misses": self.misses,
+                "bytes_spilled": self.bytes_spilled}
+
+
+class KVPageStore:
+    """Tier 2: the fleet-shared content-addressed page store.
+
+    ``(chain_hash, param_version) -> spill entry``, shared by every
+    replica that holds a reference — the fleet's hit rate compounds
+    with each replica added, and a restarted or scaled-from-zero
+    replica warms up from here on its first admissions.  Mirrors
+    :class:`~ray_tpu.fleet.disagg.HandoffStore`: payloads ride the
+    real object store when a session is up (in-process otherwise), a
+    put is idempotent by key (content-addressed: same key, same
+    bytes), and a :meth:`checkout`/:meth:`checkin` pair brackets every
+    fetch so the leak audit can assert no promotion is left in flight.
+    Unlike the host pool, :meth:`checkout` does *not* pop — the store
+    is shared, and the next replica's miss is this entry's hit.
+    ``set_params`` invalidation is by key: a bumped param version
+    simply never matches, no sweep required.
+    """
+
+    def __init__(self, use_object_store: Optional[bool] = None):
+        if use_object_store is None:
+            try:
+                from ray_tpu._private.worker import is_initialized
+                use_object_store = is_initialized()
+            except Exception:
+                use_object_store = False
+        self._use_ray = bool(use_object_store)
+        self._entries: Dict[Tuple[bytes, int], object] = {}
+        self._bytes: Dict[Tuple[bytes, int], int] = {}
+        self.puts = 0
+        self.dup_puts = 0
+        self.gets = 0
+        self.misses = 0
+        self.bytes_put = 0
+        self.in_flight = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Tuple[bytes, int]) -> bool:
+        return key in self._entries
+
+    @property
+    def bytes(self) -> int:
+        return sum(self._bytes.values())
+
+    def put(self, key: Tuple[bytes, int],
+            entry: Dict[str, object]) -> None:
+        if key in self._entries:        # content-addressed: a no-op
+            self.dup_puts += 1
+            return
+        obj: object = entry
+        if self._use_ray:
+            import ray_tpu
+            obj = ray_tpu.put(entry)
+        nb = spill_entry_bytes(entry)
+        self._entries[key] = obj
+        self._bytes[key] = nb
+        self.puts += 1
+        self.bytes_put += nb
+
+    def checkout(self, key: Tuple[bytes, int]
+                 ) -> Optional[Dict[str, object]]:
+        """Fetch an entry without removing it; pair with
+        :meth:`checkin` once the install (or its failure path) is
+        done."""
+        obj = self._entries.get(key)
+        if obj is None:
+            self.misses += 1
+            return None
+        self.gets += 1
+        self.in_flight += 1
+        if self._use_ray:
+            import ray_tpu
+            return ray_tpu.get(obj)
+        return obj
+
+    def checkin(self, key: Tuple[bytes, int]) -> None:
+        self.in_flight -= 1
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries), "bytes": self.bytes,
+                "puts": self.puts, "dup_puts": self.dup_puts,
+                "gets": self.gets, "misses": self.misses,
+                "bytes_put": self.bytes_put,
+                "in_flight": self.in_flight}
+
+
 class PrefixIndex:
     """Content-addressed index over *full, immutable* KV pages.
 
@@ -301,6 +592,11 @@ class PrefixIndex:
     def has(self, page: int) -> bool:
         return page in self._by_page
 
+    def hash_of(self, page: int) -> Optional[bytes]:
+        """The chain hash a resident page is registered under — what
+        the allocator's spill hook keys the demoted copy by."""
+        return self._by_page.get(page)
+
     def forget(self, page: int) -> None:
         h = self._by_page.pop(page, None)
         if h is not None:
@@ -362,6 +658,12 @@ class PageAllocator:
             collections.OrderedDict()
         self._index = index
         self.evictions = 0
+        # r23: called as spill_hook(page, chain_hash) just before a
+        # pressure eviction forgets a registered idle page — the
+        # engine installs a closure that demotes the page's contents
+        # to the host pool.  flush_idle() never spills: a bulk flush
+        # means the params changed and the contents are stale.
+        self.spill_hook = None
 
     @property
     def free_count(self) -> int:
@@ -408,6 +710,10 @@ class PageAllocator:
                 p, _ = self._idle.popitem(last=False)   # oldest idle
                 self.evictions += 1
                 if self._index is not None:
+                    if self.spill_hook is not None:
+                        h = self._index.hash_of(p)
+                        if h is not None:
+                            self.spill_hook(p, h)       # demote leg
                     self._index.forget(p)
             self._refcount[p] = 1
             pages.append(p)

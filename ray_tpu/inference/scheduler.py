@@ -14,6 +14,12 @@ functions).  Requests move ``waiting -> active(slot) -> finished``:
   hits are installed into the page-table row with refcount bumps and
   **zero prefill compute**; only the pages past the last hit are
   freshly allocated, and the engine prefills only the uncached suffix.
+  With tiering on (r23) the walk keeps going where the resident index
+  stops: the remaining hashes are looked up in the host-DRAM spill
+  pool and the fleet page store, and every consecutive lower-tier hit
+  is planned for promotion — the engine installs those pages into the
+  freshly-allocated storage between ticks and prefills only what no
+  tier holds.
 - **retire** (EOS / max-new-tokens): the request's page references are
   released — shared pages survive under their other owners' refcounts,
   registered refcount-0 pages park in the allocator's idle pool, the
@@ -128,6 +134,12 @@ class Request:
     # the payload instead of prefilled
     hold_pages: bool = False
     import_payload: Optional[Any] = None
+    # tiered cache (r23): how many eligible pages past the resident
+    # hits a lower tier (host pool / page store) held at admission —
+    # the engine promotes them into the fresh pages between ticks and
+    # converts each success into a hit via ``note_tier_hits``; any
+    # fetch failure just leaves the page to the suffix prefill
+    tier_plan: int = 0
     # speculative decoding (r21): the resolved draft budget for this
     # request — 0 = plain decode; > 0 = up to this many self-drafted
     # tokens verified per engine tick.  Resolved at submit time from
@@ -158,6 +170,10 @@ class SlotScheduler:
         self.prefix_hit_pages = 0
         self.prefix_hit_tokens = 0
         self.prefix_requests_hit = 0
+        # r23: engine-installed probe over the lower tiers —
+        # ``tier_lookup(chain_hash) -> bool`` (does the host pool or
+        # the fleet store hold this hash under the live params?)
+        self.tier_lookup = None
 
     # ------------------------------------------------------------ admit
     def submit(self, req: Request) -> None:
@@ -219,6 +235,19 @@ class SlotScheduler:
             if page is None:
                 break
             hits.append(page)
+        # r23: walk the remaining eligible hashes through the lower
+        # tiers (host pool, then the fleet store — the probe hides the
+        # order).  Recomputed on every attempt like the resident walk:
+        # demotions since the last attempt can move hits between
+        # tiers, and promotions can turn them resident.  The plan is
+        # advisory — the engine re-resolves each page at install time
+        # and degrades any miss or fault to plain prefill.
+        req.tier_plan = 0
+        if self.tier_lookup is not None and req.import_payload is None:
+            for h_i in req.chain_hashes[len(hits):eligible]:
+                if not self.tier_lookup(h_i):
+                    break
+                req.tier_plan += 1
         return hits
 
     def try_admit(self) -> Optional[Request]:
@@ -261,6 +290,23 @@ class SlotScheduler:
             self.prefix_hit_tokens += req.cached_tokens
             self.prefix_requests_hit += 1
         return req
+
+    def note_tier_hits(self, req: Request, n_pages: int) -> None:
+        """Account ``n_pages`` lower-tier promotions the engine just
+        installed for ``req`` (between admission and its prefill):
+        the request's cached window grows page-aligned, and the shared
+        prefix counters treat promoted pages exactly like resident
+        hits — they skipped the same prefill compute.  The request
+        joins ``requests_hit`` only if the resident walk found nothing
+        (it was already counted otherwise)."""
+        if n_pages <= 0:
+            return
+        if req.n_hit_pages == 0:
+            self.prefix_requests_hit += 1
+        req.n_hit_pages += n_pages
+        req.cached_tokens += n_pages * self.page_size
+        self.prefix_hit_pages += n_pages
+        self.prefix_hit_tokens += n_pages * self.page_size
 
     def register_prefix(self, req: Request) -> None:
         """Register the request's freshly-prefilled full prompt pages

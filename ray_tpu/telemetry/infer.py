@@ -71,11 +71,18 @@ class InferTelemetry:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_verify_steps = 0
+        # tiered KV cache (r23): prefix hits by serving tier, plus the
+        # demote (spill bytes) and promote (fetch latency) legs
+        self.tier_hits: Dict[str, int] = {}
+        self.kv_spill_bytes = 0
+        self.kv_fetches = 0
+        self.kv_fetch_seconds = 0.0
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
         self._metrics_last = 0.0
         self._queue_last = 0.0
+        self._tier_last = 0.0
 
     # ---------------------------------------------------------- records
     def record_prefill(self, wall_s: float, *, prompt_tokens: int,
@@ -172,6 +179,48 @@ class InferTelemetry:
             self.deadline_exceeded.get(kind, 0) + 1
         self._emit_deadline(kind)
 
+    def record_prefix_hits(self, n_pages: int, *, tier: str) -> None:
+        """``n_pages`` prefix pages served from ``tier`` (``hbm`` —
+        resident refcount bump; ``dram`` — promoted from the host
+        pool; ``store`` — fetched from the fleet-shared object store).
+        The per-tier split is the whole point of the r23 hierarchy:
+        a flat hit rate cannot say which tier is earning its bytes."""
+        if not self.enabled:
+            return
+        self.tier_hits[tier] = self.tier_hits.get(tier, 0) + n_pages
+        self._emit_prefix_hits(n_pages, tier)
+
+    def record_kv_spill(self, nbytes: int) -> None:
+        """One page demoted out of HBM (``nbytes`` in the spill
+        encoding — int8 codes + scales by default, ~half the model-
+        dtype figure)."""
+        if not self.enabled:
+            return
+        self.kv_spill_bytes += nbytes
+        self._emit_kv_spill(nbytes)
+
+    def record_kv_fetch(self, wall_s: float, *, tier: str) -> None:
+        """One page promoted back into HBM from a lower tier — the
+        latency the admission paid instead of prefill FLOPs."""
+        if not self.enabled:
+            return
+        self.kv_fetches += 1
+        self.kv_fetch_seconds += wall_s
+        self._emit_kv_fetch(wall_s, tier)
+
+    def record_tier_occupancy(self, *, hbm: int, dram: int,
+                              store: int) -> None:
+        """Per-tick tier occupancy gauges (pages resident per tier),
+        throttled like the decode emitter — the engine calls this every
+        tick."""
+        if not self.enabled or self._metrics_dead:
+            return
+        now = time.monotonic()
+        if now - self._tier_last < self._EMIT_INTERVAL_S:
+            return
+        self._tier_last = now
+        self._emit_tier_occupancy(hbm, dram, store)
+
     def record_cache_info(self, *, kv_dtype: str, cache_bytes: int,
                           kv_bytes_per_slot: int) -> None:
         """Static KV-cache geometry the engine reports once at
@@ -212,6 +261,13 @@ class InferTelemetry:
         if self.prompt_tokens:
             out["prefix_hit_rate"] = (self.prefix_hit_tokens
                                       / self.prompt_tokens)
+        if self.tier_hits or self.kv_fetches or self.kv_spill_bytes:
+            out["tiers"] = {
+                "hits": dict(self.tier_hits),
+                "spill_bytes": self.kv_spill_bytes,
+                "fetches": self.kv_fetches,
+                "fetch_seconds": self.kv_fetch_seconds,
+            }
         if self.ttfts:
             out["ttft_s"] = statistics.median(self.ttfts)
             out["ttft_mean_s"] = statistics.fmean(self.ttfts)
@@ -286,6 +342,23 @@ class InferTelemetry:
                     "infer_spec_accepted_tokens",
                     "drafts accepted per verify step",
                     boundaries=_SPEC_BOUNDARIES, tag_keys=tags),
+                "prefix_hits": Counter(
+                    "infer_prefix_hits_total",
+                    "prefix pages served, by tier",
+                    tag_keys=("label", "tier")),
+                "kv_spill": Counter(
+                    "infer_kv_spill_bytes_total",
+                    "KV page bytes demoted out of HBM",
+                    tag_keys=tags),
+                "kv_fetch": Histogram(
+                    "infer_kv_fetch_seconds",
+                    "KV page promote latency, by source tier",
+                    boundaries=_STEP_BOUNDARIES,
+                    tag_keys=("label", "tier")),
+                "tier_pages": Gauge(
+                    "infer_kv_tier_pages",
+                    "prefix pages resident, by tier",
+                    tag_keys=("label", "tier")),
             }
         return self._metrics
 
@@ -350,6 +423,52 @@ class InferTelemetry:
             metrics["step"].observe(wall_s, tags=tags)
             if wall_s > 0:
                 metrics["tok"].set(emitted / wall_s, tags=tags)
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_prefix_hits(self, n_pages: int, tier: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["prefix_hits"].inc(
+                    float(n_pages),
+                    tags={"label": self.label, "tier": tier})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_kv_spill(self, nbytes: int):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["kv_spill"].inc(float(nbytes),
+                                        tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_kv_fetch(self, wall_s: float, tier: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["kv_fetch"].observe(
+                    wall_s, tags={"label": self.label, "tier": tier})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_tier_occupancy(self, hbm: int, dram: int, store: int):
+        try:
+            metrics = self._metric_objects()
+            if metrics is None:
+                return
+            for tier, n in (("hbm", hbm), ("dram", dram),
+                            ("store", store)):
+                metrics["tier_pages"].set(
+                    n, tags={"label": self.label, "tier": tier})
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

@@ -1,0 +1,61 @@
+"""Round-23 on-chip driver: the tiered KV cache — prefix pages from
+HBM through host DRAM to the object store.
+
+Usage: python scratch/r23_tiers.py <variant>
+
+Variants:
+  tiers — flat-vs-tiered A/B: `bench.py --infer --tiers` (arms: flat /
+          tiered+int8-spill / tiered+model-dtype-spill over the same
+          warm -> evict -> re-admit trace).  Host-sim validates the
+          plumbing: the tiered arms re-admit the evicted shared
+          prefix as store fetches (tier_hits.store = 2 vs the flat
+          arm's re-prefill), int8 spill moves 9216 bytes/page vs f32's
+          32768 (the head_dim+4 vs head_dim*4 per-vector pricing), and
+          every arm shows zero steady-state compiles (tier installs
+          scatter between ticks).  The chip questions: where the
+          DRAM-hit TTFT lands between the HBM hit and the re-prefill
+          (host-sim can't price a real HBM<->host page copy), whether
+          the store-fetch TTFT still beats re-prefill once the prefix
+          is long enough (the crossover the cost model's weights
+          encode), and the fleet effect — N replicas sharing one
+          store should turn one replica's prefill into fleet-wide
+          warm admissions (run with RAY_TPU_KV_HOST_PAGES /
+          RAY_TPU_KV_STORE / RAY_TPU_KV_SPILL_DTYPE swept).
+
+Carried arms (no chip session yet; every r06-r22 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+dcn + pp plus all r6-r21 arms — delegated verbatim to
+scratch/r22_dcn.py.
+"""
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "tiers"
+
+_R22_ARMS = ("dcn", "pp",
+             "spec",
+             "disagg",
+             "gray", "straggle",
+             "elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if VARIANT in _R22_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r22_dcn.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+assert VARIANT == "tiers", f"unknown variant {VARIANT!r}"
+sys.exit(subprocess.run(
+    [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+     "--tiers"] + sys.argv[2:]).returncode)

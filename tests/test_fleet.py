@@ -127,6 +127,16 @@ class StubReplica:
     def prefix_digest(self):
         return self._digest
 
+    def tier_hits(self, chain_hashes):
+        # replica protocol (r23): consecutive leading pages in the
+        # digest count as HBM-resident; the stub has no DRAM pool
+        n_hbm = 0
+        for h in chain_hashes:
+            if h not in self._digest:
+                break
+            n_hbm += 1
+        return n_hbm, 0
+
     def drain(self):
         self.draining = True
 

@@ -261,15 +261,27 @@ def test_scheduler_refcount_fuzz():
     level (no compiled steps — register_prefix is called as the engine
     would, after 'prefill'): no page freed while referenced, refcounts
     exactly match the active references, every page always in exactly
-    one of {free, idle, allocated}, and nothing leaks at drain."""
+    one of {free, idle, allocated}, and nothing leaks at drain.
+
+    r23 rides the same 300 ops: evictions demote through a host pool
+    into a store (the engine's spill wiring, with a stub payload), and
+    the tier inventory must partition exactly every step — the pool
+    never holds a hash that is also resident, never exceeds capacity,
+    and no store fetch is left in flight."""
     import collections
 
-    from ray_tpu.inference import Request, SamplingParams, SlotScheduler
+    from ray_tpu.inference import (HostPagePool, KVPageStore, Request,
+                                   SamplingParams, SlotScheduler)
     rng = np.random.RandomState(42)
     ps = 8
     sched = SlotScheduler(slots=3, page_size=ps, num_pages=24,
                           max_pages_per_slot=8, prefix=True)
     alloc = sched.allocator
+    store = KVPageStore(use_object_store=False)
+    pool = HostPagePool(3, store=store)
+    stub = {"fmt": "model", "k": np.zeros(1, np.float32),
+            "v": np.zeros(1, np.float32)}
+    alloc.spill_hook = lambda page, h: pool.put((h, 0), dict(stub))
     # a small pool of shared prefixes drives real hit/shared-page load
     prefixes = [list(rng.randint(0, 97, 2 * ps)) for _ in range(3)]
     rid = 0
@@ -288,6 +300,8 @@ def test_scheduler_refcount_fuzz():
             req = sched.try_admit()
             if req is not None:
                 sched.register_prefix(req)     # "prefill finished"
+                for h in req.chain_hashes[req.n_hit_pages:]:
+                    pool.discard((h, 0))       # engine _register_prefix
         elif sched.active:
             slot = list(sched.active)[rng.randint(len(sched.active))]
             sched.retire(slot)
@@ -311,10 +325,18 @@ def test_scheduler_refcount_fuzz():
         # idle pages are exactly the registered refcount-0 pages
         for p in idle:
             assert sched.prefix_index.has(p)
+        # tier inventory (r23): the host pool respects capacity, holds
+        # no hash that is also HBM-resident (demoted = in exactly one
+        # local tier), and no store fetch dangles
+        assert len(pool) <= pool.capacity
+        resident = sched.prefix_index.digest()
+        assert not any(h in resident for h, _ in pool._entries)
+        assert store.in_flight == 0
     while sched.active:
         sched.retire(next(iter(sched.active)))
     assert not alloc._refcount
     assert alloc.free_count == 23              # nothing leaked
+    assert pool.spills > 0 and store.puts > 0  # the tiers saw traffic
 
 
 def test_prefix_hit_decode_parity(tiny_f32):

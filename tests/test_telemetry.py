@@ -453,6 +453,34 @@ def test_infer_telemetry_spec_summary():
     assert off.summary() == {"enabled": False}
 
 
+def test_infer_telemetry_tier_summary():
+    """r23: per-tier prefix hits plus the spill/fetch legs fold into a
+    ``tiers`` summary block — absent entirely when tiering never
+    moved a page."""
+    from ray_tpu.telemetry import InferTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = InferTelemetry(config=TelemetryConfig(enabled=True))
+    assert "tiers" not in tel.summary()
+    tel.record_prefix_hits(2, tier="hbm")
+    tel.record_prefix_hits(1, tier="dram")
+    tel.record_prefix_hits(3, tier="store")
+    tel.record_kv_spill(4096)
+    tel.record_kv_fetch(0.002, tier="dram")
+    tel.record_kv_fetch(0.004, tier="store")
+    tel.record_tier_occupancy(hbm=5, dram=2, store=7)
+    out = tel.summary()["tiers"]
+    assert out["hits"] == {"hbm": 2, "dram": 1, "store": 3}
+    assert out["spill_bytes"] == 4096
+    assert out["fetches"] == 2
+    assert abs(out["fetch_seconds"] - 0.006) < 1e-9
+    off = InferTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_prefix_hits(2, tier="hbm")
+    off.record_kv_spill(4096)
+    off.record_kv_fetch(0.002, tier="dram")
+    assert off.summary() == {"enabled": False}
+
+
 @pytest.mark.slow
 def test_telemetry_overhead_under_one_percent():
     """Acceptance budget: telemetry-on steady-state step time exceeds
@@ -569,6 +597,11 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     infer = InferTelemetry(config=on)
     infer.record_deadline_exceeded(kind="ttft")
     infer.record_verify(0.002, proposed=4, accepted=3, emitted=4)
+    infer.record_prefix_hits(2, tier="hbm")
+    infer.record_prefix_hits(1, tier="store")
+    infer.record_kv_spill(4096)
+    infer.record_kv_fetch(0.002, tier="dram")
+    infer.record_tier_occupancy(hbm=5, dram=2, store=7)
     data = DataTelemetry(config=on)
     data.record_batch(128, 0.2, queue_depth=2)
     data.record_stall(0.003)
@@ -636,3 +669,10 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "infer_spec_accepted_total" in text
     assert "infer_spec_accept_rate" in text
     assert "user_histogram_infer_spec_accepted_tokens_bucket" in text
+    # r23 tiered-KV series: per-tier prefix-hit counter, spill-bytes
+    # counter, fetch-latency histogram, tier-occupancy gauge
+    assert "infer_prefix_hits_total" in text
+    assert "infer_kv_spill_bytes_total" in text
+    assert "user_histogram_infer_kv_fetch_seconds_bucket" in text
+    assert "infer_kv_tier_pages" in text
+    assert 'tier="hbm"' in text and 'tier="dram"' in text

@@ -1,0 +1,451 @@
+"""Tiered KV cache (r23): HBM -> host-DRAM -> object store.
+
+Unit coverage for the spill codec / HostPagePool / KVPageStore, the
+engine-level demote-promote round trips (exact in the spill's native
+form), chaos on every spill/fetch leg degrading to re-prefill with
+exact greedy continuations, set_params invalidation across tiers, the
+tier-aware router pick — and THE acceptance run: a two-replica fleet
+where one replica's prefill, demoted through DRAM to the store under
+eviction pressure, warms the other replica's first request and a
+restarted replica, bit-exact, with zero steady-state compiles and the
+tier leak audit green.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_f32():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt import GPTConfig, init_params
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    from ray_tpu.util import chaos
+    chaos.clear_faults()
+    yield
+    chaos.clear_faults()
+
+
+# ride the compile caches the earlier files already paid for (the
+# tier-1 budget rule — see test_disagg.py's note; test_tiers collects
+# last alphabetically)
+import test_inference as _ti  # noqa: E402
+
+_EXEC_CACHE = _ti._EXEC_CACHE
+_EXEC_CACHE_INT8 = {}
+_ENGINE_KW = {"slots": 2, "page_size": 16, "buckets": (16, 32, 64),
+              "telemetry": False, "executable_cache": _EXEC_CACHE}
+
+
+def _make_engine(tiny, **over):
+    from ray_tpu.inference import InferenceEngine
+    cfg, params = tiny
+    kw = dict(_ENGINE_KW)
+    kw.update(over)
+    if kw.get("kv_dtype") == "int8" \
+            and kw["executable_cache"] is _EXEC_CACHE:
+        kw["executable_cache"] = _EXEC_CACHE_INT8
+    return InferenceEngine(cfg, params, **kw)
+
+
+def _prompt(n, vocab, seed=0):
+    return list(np.random.RandomState(seed).randint(0, vocab, size=n))
+
+
+def _pressure(engine, vocab, rounds=3, seed=100):
+    """Evict the engine's idle prefix pages by admitting long fresh
+    prompts until HBM pressure demotes them through the tiers."""
+    for i in range(rounds):
+        engine.generate([_prompt(60, vocab, seed=seed + i)],
+                        max_new_tokens=4)
+
+
+# ------------------------------------------------------------- unit: pool
+def test_host_page_pool_lru_overflow_and_discard():
+    from ray_tpu.inference import HostPagePool, KVPageStore
+    store = KVPageStore(use_object_store=False)
+    pool = HostPagePool(2, store=store)
+    e = lambda: {"fmt": "model", "k": np.zeros(4, np.float32),  # noqa: E731
+                 "v": np.zeros(4, np.float32)}
+    pool.put((b"a", 0), e())
+    pool.put((b"b", 0), e())
+    assert len(pool) == 2 and pool.bytes == 64
+    pool.put((b"a", 0), e())           # dup: move-to-end, no growth
+    assert pool.spills == 2
+    pool.put((b"c", 0), e())           # overflow demotes LRU (= b)
+    assert len(pool) == 2 and (b"b", 0) not in pool
+    assert (b"b", 0) in store and pool.demotions == 1
+    assert pool.take((b"a", 0)) is not None     # take pops
+    assert (b"a", 0) not in pool and pool.hits == 1
+    assert pool.take((b"zz", 0)) is None and pool.misses == 1
+    pool.discard((b"c", 0))            # silent: no miss counted
+    assert len(pool) == 0 and pool.bytes == 0 and pool.misses == 1
+    pool.put((b"d", 0), e())
+    assert pool.clear() == 1 and pool.bytes == 0
+    # capacity 0 passes straight to the store (store-only tiering)
+    p0 = HostPagePool(0, store=store)
+    p0.put((b"z", 0), e())
+    assert len(p0) == 0 and (b"z", 0) in store
+    # no store: overflow is a plain drop, never an error
+    lone = HostPagePool(1)
+    lone.put((b"a", 0), e())
+    lone.put((b"b", 0), e())
+    assert lone.dropped == 1 and len(lone) == 1
+    with pytest.raises(ValueError):
+        HostPagePool(-1)
+
+
+def test_kv_page_store_checkout_checkin():
+    from ray_tpu.inference import KVPageStore
+    store = KVPageStore(use_object_store=False)
+    e = {"fmt": "model", "k": np.zeros(4, np.float32),
+         "v": np.zeros(4, np.float32)}
+    store.put((b"a", 0), e)
+    store.put((b"a", 0), e)            # content-addressed: idempotent
+    assert store.puts == 1 and store.dup_puts == 1
+    assert len(store) == 1 and store.bytes == 32
+    got = store.checkout((b"a", 0))
+    assert got is not None and store.in_flight == 1
+    store.checkin((b"a", 0))
+    assert store.in_flight == 0
+    assert (b"a", 0) in store          # checkout does NOT pop (shared)
+    assert store.checkout((b"a", 1)) is None    # version mismatch
+    assert store.misses == 1
+
+
+# ------------------------------------------------------------ unit: codec
+def test_spill_codec_roundtrip_and_geometry():
+    import jax.numpy as jnp
+
+    from ray_tpu.inference import kv_cache as kvc
+    rng = np.random.default_rng(0)
+    cache = kvc.KVCache(n_layers=2, num_pages=4, page_size=8,
+                        n_heads=2, head_dim=8, dtype=jnp.float32)
+    cache.k = cache.k.at[:].set(
+        jnp.asarray(rng.normal(size=cache.k.shape), jnp.float32))
+    cache.v = cache.v.at[:].set(
+        jnp.asarray(rng.normal(size=cache.v.shape), jnp.float32))
+    orig_k = np.asarray(cache.k[:, 2])
+    contents = kvc.export_pages(cache, [2])
+    # "model" spill: exact round trip
+    exact = kvc.encode_spill_page(contents, quantized=False,
+                                  spill_dtype="model")
+    assert kvc.spill_entry_matches(cache, exact)
+    kvc.install_spill_page(cache, 3, exact)
+    assert np.array_equal(np.asarray(cache.k[:, 3]), orig_k)
+    # "int8" spill: bounded error, ~(head_dim+4)/(head_dim*4) the bytes
+    q = kvc.encode_spill_page(contents, quantized=False,
+                              spill_dtype="int8")
+    assert q["fmt"] == "int8"
+    assert kvc.spill_entry_bytes(q) < kvc.spill_entry_bytes(exact)
+    kvc.install_spill_page(cache, 1, q)
+    err = np.abs(np.asarray(cache.k[:, 1]) - orig_k).max()
+    assert 0 < err < 0.02 * np.abs(orig_k).max()
+    # a foreign-geometry entry reads as a miss, never a shape error
+    other = kvc.KVCache(n_layers=2, num_pages=4, page_size=4,
+                        n_heads=2, head_dim=8, dtype=jnp.float32)
+    assert not kvc.spill_entry_matches(other, exact)
+    # int8 caches pass codes + scales through verbatim
+    qcache = kvc.KVCache(n_layers=2, num_pages=4, page_size=8,
+                         n_heads=2, head_dim=8, dtype=jnp.float32,
+                         kv_dtype="int8")
+    qcache.k = qcache.k.at[:].set(
+        jnp.asarray(rng.integers(-127, 128, qcache.k.shape), jnp.int8))
+    qc = kvc.export_pages(qcache, [1])
+    entry = kvc.encode_spill_page(qc, quantized=True)
+    kvc.install_spill_page(qcache, 2, entry)
+    assert np.array_equal(np.asarray(qcache.k[:, 2]),
+                          np.asarray(qcache.k[:, 1]))
+
+
+# ------------------------------------------------- engine: demote/promote
+def test_tiered_demote_promote_exact(tiny_f32):
+    """Eviction pressure demotes the shared prefix host-side; the next
+    request sharing it promotes from DRAM (or the store) and continues
+    bit-exactly — in the spill's native-exact arms: model-dtype spill
+    on an f32 cache, and the default int8 spill on an int8 cache
+    (codes + scales move verbatim)."""
+    cfg, _ = tiny_f32
+    shared = _prompt(40, cfg.vocab_size, seed=7)
+    for kw in ({"spill_dtype": "model"}, {"kv_dtype": "int8"}):
+        cold = _make_engine(tiny_f32, num_pages=9, **kw)
+        ref = cold.generate([shared + [5, 6, 7]], max_new_tokens=8)[0]
+        eng = _make_engine(tiny_f32, num_pages=9, host_pages=4,
+                           store=True, **kw)
+        assert eng.generate([shared + [5, 6, 7]],
+                            max_new_tokens=8)[0] == ref
+        _pressure(eng, cfg.vocab_size)
+        st = eng.stats()["tiers"]
+        assert st["host"]["spills"] > 0 and st["spill_bytes"] > 0
+        out = eng.generate([shared + [5, 6, 7]], max_new_tokens=8)[0]
+        assert out == ref
+        st = eng.stats()["tiers"]
+        assert st["hits"]["dram"] + st["hits"]["store"] >= 1
+        assert st["fetches"] >= 1
+        assert eng.leak_free()
+
+
+def test_store_only_and_shared_store_cross_engine(tiny_f32):
+    """host_pages=0 with a store caps tier 1 at nothing — demotes go
+    straight to the store — and a second engine sharing the store
+    admits the first engine's spilled prefix as a store hit."""
+    from ray_tpu.inference import KVPageStore
+    cfg, _ = tiny_f32
+    shared = _prompt(40, cfg.vocab_size, seed=9)
+    cold = _make_engine(tiny_f32, num_pages=9, spill_dtype="model")
+    ref = cold.generate([shared + [1, 2]], max_new_tokens=6)[0]
+    store = KVPageStore(use_object_store=False)
+    a = _make_engine(tiny_f32, num_pages=9, host_pages=0, store=store,
+                     spill_dtype="model")
+    assert a.generate([shared + [1, 2]], max_new_tokens=6)[0] == ref
+    _pressure(a, cfg.vocab_size)
+    assert a.stats()["tiers"]["host"]["demotions"] > 0
+    assert len(store) > 0
+    b = _make_engine(tiny_f32, num_pages=9, host_pages=0, store=store,
+                     spill_dtype="model")
+    assert b.generate([shared + [1, 2]], max_new_tokens=6)[0] == ref
+    st = b.stats()["tiers"]
+    assert st["hits"]["store"] >= 2        # both full prefix pages
+    assert st["hits"]["hbm"] == 0
+    assert b.stats()["prefix"]["hit_tokens"] == 32
+    assert a.leak_free() and b.leak_free()
+    assert store.in_flight == 0
+
+
+def test_kv_chaos_all_legs_degrade_to_reprefill(tiny_f32):
+    """A ``kv.spill`` fault on the HBM->DRAM or DRAM->store leg, and a
+    ``kv.fetch`` fault (or ``:delay=``) on the promote leg, each
+    degrade to re-prefill-from-prompt: greedy continuations stay
+    exact, nothing hangs, and the tier partition audits clean."""
+    from ray_tpu.util import chaos
+    cfg, _ = tiny_f32
+    shared = _prompt(40, cfg.vocab_size, seed=11)
+    cold = _make_engine(tiny_f32, num_pages=9, spill_dtype="model")
+    ref = cold.generate([shared + [3, 4]], max_new_tokens=6)[0]
+    for spec, expect_fault in (("kv.spill@1", "spill"),
+                               ("kv.spill@4", "spill"),
+                               ("kv.fetch@1", "fetch"),
+                               ("kv.fetch@1..2:delay=0.01", None)):
+        eng = _make_engine(tiny_f32, num_pages=9, host_pages=2,
+                           store=True, spill_dtype="model")
+        assert eng.generate([shared + [3, 4]],
+                            max_new_tokens=6)[0] == ref
+        plan = chaos.install_faults(spec)
+        _pressure(eng, cfg.vocab_size)
+        out = eng.generate([shared + [3, 4]], max_new_tokens=6)[0]
+        chaos.clear_faults()
+        assert out == ref, spec
+        st = eng.stats()["tiers"]
+        if expect_fault == "spill":
+            # a faulted demote leg forgot a page (engine leg) or
+            # dropped it at the pool (store leg)
+            assert st["spill_faults"] + st["host"]["dropped"] >= 1, spec
+        elif expect_fault == "fetch":
+            assert st["fetch_faults"] >= 1, spec
+            assert len(plan.fired) >= 1
+        else:                              # delay: slow, not lossy
+            assert st["fetch_faults"] == 0 and st["fetches"] >= 1, spec
+        assert eng.leak_free(), spec
+
+
+def test_set_params_invalidates_all_tiers(tiny_f32):
+    """A weight swap flushes the resident prefix AND the host pool,
+    and the store's old-version keys can never hit again (key
+    invalidation — no sweep)."""
+    cfg, params = tiny_f32
+    shared = _prompt(40, cfg.vocab_size, seed=13)
+    eng = _make_engine(tiny_f32, num_pages=9, host_pages=4, store=True,
+                       spill_dtype="model")
+    eng.generate([shared + [8]], max_new_tokens=4)
+    _pressure(eng, cfg.vocab_size)
+    assert len(eng.host_pool) + len(eng.store) > 0
+    store_before = len(eng.store)
+    import jax
+    host_params = jax.tree.map(np.asarray, params)
+    eng.set_params(host_params)
+    assert len(eng.host_pool) == 0         # pool dropped outright
+    assert len(eng.store) == store_before  # store invalidated by key
+    before = dict(eng.stats()["tiers"]["hits"])
+    out = eng.generate([shared + [8]], max_new_tokens=4)[0]
+    after = eng.stats()["tiers"]["hits"]
+    assert after["dram"] == before["dram"]      # stale keys never hit
+    assert after["store"] == before["store"]
+    cold = _make_engine(tiny_f32, num_pages=9, spill_dtype="model")
+    assert out == cold.generate([shared + [8]], max_new_tokens=4)[0]
+    assert eng.leak_free()
+
+
+def test_tier_env_knobs(monkeypatch):
+    from ray_tpu.inference.config import infer_config
+    monkeypatch.setenv("RAY_TPU_KV_HOST_PAGES", "32")
+    monkeypatch.setenv("RAY_TPU_KV_STORE", "0")
+    monkeypatch.setenv("RAY_TPU_KV_SPILL_DTYPE", "model")
+    icfg = infer_config(refresh=True)
+    assert icfg.host_pages == 32 and icfg.store is False
+    assert icfg.spill_dtype == "model"
+    monkeypatch.setenv("RAY_TPU_KV_HOST_PAGES", "-3")
+    monkeypatch.setenv("RAY_TPU_KV_SPILL_DTYPE", "float8")
+    icfg = infer_config(refresh=True)
+    assert icfg.host_pages == 0            # negative -> tiering off
+    assert icfg.spill_dtype == "int8"      # unknown -> default
+    monkeypatch.delenv("RAY_TPU_KV_HOST_PAGES")
+    monkeypatch.delenv("RAY_TPU_KV_STORE")
+    monkeypatch.delenv("RAY_TPU_KV_SPILL_DTYPE")
+    icfg = infer_config(refresh=True)
+    assert icfg.host_pages == 0 and icfg.store is True
+    assert icfg.spill_dtype == "int8"
+
+
+# ------------------------------------------------------- router cost model
+def test_router_tier_aware_pick(tiny_f32):
+    """The affinity pick prefers HBM residency over DRAM spill over
+    nothing, and store coverage does not differentiate candidates."""
+    from ray_tpu.fleet import EngineReplica
+    cfg, _ = tiny_f32
+    shared = _prompt(40, cfg.vocab_size, seed=17)
+    from ray_tpu.inference.kv_cache import PrefixIndex
+    hashes = PrefixIndex.chain_hashes(shared, 16)
+    resident = EngineReplica("hot", _make_engine(
+        tiny_f32, num_pages=9, host_pages=4, store=True,
+        spill_dtype="model"))
+    spilled = EngineReplica("warm", _make_engine(
+        tiny_f32, num_pages=9, host_pages=4, store=True,
+        spill_dtype="model"))
+    cold = EngineReplica("cold", _make_engine(
+        tiny_f32, num_pages=9, host_pages=4, store=True,
+        spill_dtype="model"))
+    for rep in (resident, spilled):
+        rep.engine.generate([shared + [1]], max_new_tokens=2)
+    # two rounds evict "warm"'s prefix into its pool without pushing
+    # it on through to the store (pool capacity 4 absorbs it)
+    _pressure(spilled.engine, cfg.vocab_size, rounds=2)
+    assert resident.tier_hits(hashes)[0] == 2
+    n_hbm, n_dram = spilled.tier_hits(hashes)
+    assert n_hbm == 0 and n_dram >= 1
+    assert cold.tier_hits(hashes) == (0, 0)
+    from ray_tpu.fleet import FleetConfig, FleetRouter
+    router = FleetRouter(
+        [cold, spilled, resident],
+        cfg=FleetConfig(affinity=True, affinity_cap=8),
+        rng_seed=0)
+    pick = router._affinity_pick(shared + [2], router.healthy())
+    assert pick is resident                # HBM beats DRAM beats cold
+    pick = router._affinity_pick(shared + [2], [cold, spilled])
+    assert pick is spilled                 # DRAM beats cold
+    pick = router._affinity_pick(_prompt(40, cfg.vocab_size, seed=23),
+                                 router.healthy())
+    assert pick is None                    # store-only -> pow-2
+
+
+# ---------------------------------------------------------- THE acceptance
+def test_tiered_fleet_acceptance(tiny_f32):
+    """THE r23 acceptance: two-replica fleet, shared system prompt.
+    Replica A prefills it once; eviction pressure demotes it through
+    DRAM into the fleet-shared store; replica B's first request and a
+    restarted replica A both admit it as a store hit (prefill compute
+    only for the uncached suffix, asserted via the hit counters);
+    every continuation is bit-exact greedy vs a cold run; the
+    store-hit arms compile nothing; the leak audit is green including
+    the host pools and store in-flight."""
+    from ray_tpu.fleet import EngineReplica, FleetConfig, FleetRouter
+    from ray_tpu.inference import KVPageStore
+    cfg, _ = tiny_f32
+    system = _prompt(40, cfg.vocab_size, seed=31)   # 2 full pages @16
+    suffixes = [[5, 6, 7], [8, 9], [10, 11, 12]]
+    cold = _make_engine(tiny_f32, num_pages=9, kv_dtype="int8")
+    expected = [cold.generate([system + s], max_new_tokens=6)[0]
+                for s in suffixes]
+
+    store = KVPageStore(use_object_store=False)
+    exec_cache = dict(_EXEC_CACHE_INT8)   # shared across A, B, A'
+
+    def replica(rid):
+        return EngineReplica(rid, _make_engine(
+            tiny_f32, num_pages=9, kv_dtype="int8", host_pages=2,
+            store=store, executable_cache=exec_cache))
+
+    rep_a, rep_b = replica("ta"), replica("tb")
+    router = FleetRouter(
+        [rep_a, rep_b],
+        cfg=FleetConfig(affinity=True, affinity_cap=8, retries=2),
+        rng_seed=0)
+
+    def run(prompt, target):
+        """Route one greedy request, pinned to ``target`` by draining
+        the other replica for the submit (a real admission guard, so
+        the router's own pick does the pinning)."""
+        others = [r for r in router.replicas() if r.id != target.id]
+        for r in others:
+            r.draining = True
+        stream = router.remote({"tokens": prompt, "max_new_tokens": 6})
+        for r in others:
+            r.draining = False
+        out = list(stream)
+        assert stream.error is None
+        assert stream.replica_id == target.id
+        return out
+
+    # replica A prefills the system prompt once (plus one resident-hit
+    # request so the cached-prefill executable is already compiled
+    # before the arms whose compile counters must stay frozen)
+    assert run(system + suffixes[0], rep_a) == expected[0]
+    assert run(system + suffixes[1], rep_a) == expected[1]
+    assert rep_a.engine.stats()["tiers"]["hits"]["hbm"] == 2
+
+    # eviction pressure: the system pages demote HBM -> DRAM -> store
+    _pressure(rep_a.engine, cfg.vocab_size)
+    a_tiers = rep_a.engine.stats()["tiers"]
+    assert a_tiers["host"]["spills"] > 0        # through DRAM...
+    assert a_tiers["host"]["demotions"] > 0     # ...into the store
+    ver = rep_a.engine.param_version
+    from ray_tpu.inference.kv_cache import PrefixIndex
+    sys_hashes = PrefixIndex.chain_hashes(system, 16)
+    assert all((h, ver) in store for h in sys_hashes)
+
+    compiles_before = sum(
+        sum(r.engine.compile_counts.values())
+        for r in router.replicas())
+
+    # replica B's FIRST request admits the system prompt from the store
+    assert run(system + suffixes[2], rep_b) == expected[2]
+    b_stats = rep_b.engine.stats()
+    assert b_stats["tiers"]["hits"]["store"] == 2
+    assert b_stats["tiers"]["hits"]["hbm"] == 0
+    assert b_stats["prefix"]["hit_tokens"] == 32    # suffix-only prefill
+
+    # restart replica A: reap the corpse, spawn a fresh engine on the
+    # same shared store (the reconciler's factory contract) — its
+    # first request warms up from the store too
+    rep_a.alive = False
+    rep_a.reap()
+    router.remove_replica("ta")
+    rep_a2 = replica("ta2")
+    router.add_replica(rep_a2)
+    assert run(system + suffixes[0], rep_a2) == expected[0]
+    a2_stats = rep_a2.engine.stats()
+    assert a2_stats["tiers"]["hits"]["store"] == 2
+    assert a2_stats["prefix"]["hit_tokens"] == 32
+
+    # zero steady-state compiles across both store-hit arms: the
+    # shared executable cache means B and the restarted A compiled
+    # NOTHING, and nobody compiled during the store-hit admissions
+    assert sum(rep_b.engine.compile_counts.values()) == 0
+    assert sum(rep_a2.engine.compile_counts.values()) == 0
+    compiles_after = sum(
+        sum(r.engine.compile_counts.values())
+        for r in router.replicas()) \
+        + sum(rep_a.engine.compile_counts.values())
+    assert compiles_after == compiles_before
+
+    # fleet-wide leak audit, tiers included
+    assert router.leak_free()
+    assert store.in_flight == 0
+    assert router.stats()["kv_store"]["in_flight"] == 0
