@@ -785,6 +785,202 @@ def bench_infer_disagg(replicas_n: int):
         print(json.dumps(record))
 
 
+def bench_infer_trace(replicas_n: int):
+    """p99 TTFT attribution over the traced disagg fleet: ``python
+    bench.py --infer --trace``.
+
+    Runs the shared-prefix open-loop trace through a DisaggRouter
+    (tiers on: host-DRAM pool + fleet-shared page store) with
+    per-request tracing forced to sample=1, then decomposes every
+    request's TTFT from its span tree: ``queue`` (submit -> admit),
+    ``route`` (the router's pick loop), ``prefix_walk`` (the
+    scheduler's per-tier walk), ``tier_fetch`` (host/store page
+    fetches), ``handoff`` (export + import + install legs),
+    ``prefill`` (the compiled bucket run), ``first_decode`` (decode
+    ticks inside the TTFT window) and ``unattributed`` (dispatch gaps
+    between spans).  Prints ONE JSON line with per-component p50/p99
+    milliseconds; the component p50s must sum to the measured p50 TTFT
+    within 10% (``attribution_ratio`` — the spans tile the window, so
+    a miss means a hole in the instrumentation).  The slowest
+    request's full span tree rides the record (``slowest_tree``) and
+    echoes to stderr for humans."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.fleet import DisaggRouter, EngineReplica, fleet_config
+    from ray_tpu.inference import InferenceEngine
+    from ray_tpu.inference.config import infer_config
+    from ray_tpu.inference.kv_cache import KVPageStore
+    from ray_tpu.models.gpt import GPTConfig, init_params
+    from ray_tpu.telemetry import trace
+    from ray_tpu.telemetry.config import TelemetryConfig
+    from ray_tpu.telemetry.fleet import FleetTelemetry
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    quick = "--quick" in sys.argv or platform == "cpu"
+    # attribution needs every request traced and a ring big enough to
+    # hold the whole run (the report reads the ring after quiesce)
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "1"
+    os.environ.setdefault("RAY_TPU_TRACE_RING", "65536")
+    trace.trace_config(refresh=True)
+    trace.reset()
+    if quick:
+        cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                        n_heads=4, max_seq=256, dtype=jnp.float32)
+        slots, page, max_new = 4, 16, 8
+        shared_pages, gap_s = 2, 0.005
+        requests = 8 * replicas_n
+        suffix_lens = [9, 17, 5, 23, 12, 30, 7, 14]
+    else:
+        _kernel_smoke()
+        cfg = GPTConfig.gpt2(vocab_size=50304, max_seq=1024,
+                             dtype=jnp.bfloat16)
+        icfg = infer_config()
+        slots, page, max_new = icfg.slots, icfg.page_size, 32
+        shared_pages, gap_s = 3, 0.01
+        requests = 8 * replicas_n
+        suffix_lens = [32 + 23 * i % 224 for i in range(requests)]
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts, shared_len = _infer_trace(cfg, page, requests, rng_seed=1,
+                                       shared_pages=shared_pages,
+                                       suffix_lens=suffix_lens)
+    payloads = [{"tokens": p, "max_new_tokens": max_new}
+                for p in prompts]
+    executables = {}
+    for warm_prefix in (False, True):
+        warm = InferenceEngine(cfg, params, slots=slots,
+                               page_size=page, telemetry=False,
+                               max_queue=0, prefix=warm_prefix,
+                               executable_cache=executables)
+        _run_open_loop(warm, prompts, max_new, gap_s=0.0)
+        del warm
+
+    prefill_n = min(max(fleet_config().prefill_replicas, 1),
+                    replicas_n - 1)
+    store = KVPageStore(use_object_store=False)
+
+    def mk(rid):
+        return EngineReplica(rid, InferenceEngine(
+            cfg, params, slots=slots, page_size=page, telemetry=False,
+            max_queue=0, host_pages=4, store=store,
+            executable_cache=executables))
+
+    router = DisaggRouter(
+        [mk(f"p{i}") for i in range(prefill_n)],
+        [mk(f"d{i}") for i in range(replicas_n - prefill_n)],
+        cfg=fleet_config(), rng_seed=0,
+        telemetry=FleetTelemetry(config=TelemetryConfig(enabled=True)))
+    dt, streams = _run_fleet_open_loop(router, payloads, gap_s)
+    router.quiesce()
+
+    # ----------------------------------------------- TTFT decomposition
+    # each span's contribution is its overlap with the request's TTFT
+    # window [root start, first token] — spans past the first token
+    # (decode, the install leg on the decode replica) attribute 0, so
+    # the components tile the TTFT and their sum must reproduce it
+    direct = ("queue", "route", "prefix_walk", "tier_fetch", "prefill")
+    handoff_names = {"handoff.export", "handoff.import",
+                     "handoff.install"}
+    comp_names = direct + ("handoff", "first_decode", "delivery",
+                           "unattributed")
+    per_comp = {c: [] for c in comp_names}
+    decode_ticks = [r for r in trace.recorder().spans()
+                    if r["name"] == "decode_tick"]
+    ttfts, ranked = [], []
+    for s in streams:
+        if s.error is not None or s.first_token_ts is None:
+            continue
+        tid = s.trace.trace_id
+        spans = trace.spans_for(tid)
+        root = next((r for r in spans if r["name"] == "request"), None)
+        if root is None:
+            continue
+        ttft = s.first_token_ts - s.submitted_ts
+        w0, w1 = root["start"], root["start"] + ttft
+
+        def clipped(rec):
+            a = max(rec["start"], w0)
+            b = min(rec["start"] + rec.get("dur", 0.0), w1)
+            return max(b - a, 0.0)
+
+        acc = {c: 0.0 for c in comp_names}
+        for rec in spans:
+            name = rec["name"]
+            comp = ("handoff" if name in handoff_names
+                    else name if name in direct else None)
+            if comp is not None:
+                acc[comp] += clipped(rec)
+        for rec in decode_ticks:
+            if tid in (rec.get("attributes") or {}).get("trace_ids",
+                                                        ()):
+                acc["first_decode"] += clipped(rec)
+        # delivery: the host-driven dispatch gap between the engine
+        # recording the first token (inside its step — the rid-tagged
+        # first_token event) and the stream observing it (the window
+        # end).  In the host-sim fleet every replica steps in one
+        # process, so this is the poll loop's serialization cost.
+        eng_ft = min((rec["start"] for rec in spans
+                      if rec["name"] == "first_token"
+                      and "rid" in (rec.get("attributes") or {})),
+                     default=None)
+        if eng_ft is not None:
+            acc["delivery"] = max(w1 - max(eng_ft, w0), 0.0)
+        known = sum(acc.values())
+        acc["unattributed"] = max(ttft - known, 0.0)
+        for c in comp_names:
+            per_comp[c].append(acc[c])
+        ttfts.append(ttft)
+        ranked.append((ttft, tid))
+    ttfts.sort()
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    p50 = pct(ttfts, 0.50)
+    sum_p50 = sum(pct(v, 0.50) for v in per_comp.values())
+    slowest = max(ranked) if ranked else (0.0, None)
+    tree = trace.format_tree(slowest[1]) if slowest[1] else ""
+    record = {
+        "metric": "gpt_infer_ttft_p50_attribution",
+        "value": round(p50, 4),
+        "unit": "s",
+        "platform": platform,
+        "mode": "disagg",
+        "replicas": replicas_n,
+        "prefill_replicas": prefill_n,
+        "requests": requests,
+        "attributed": len(ttfts),
+        "errors": sum(1 for s in streams if s.error is not None),
+        "shared_prompt_tokens": shared_len,
+        "wall_s": round(dt, 3),
+        "ttft_p50_s": round(p50, 4),
+        "ttft_p99_s": round(pct(ttfts, 0.99), 4),
+        "components": {c: {"p50_ms": round(pct(v, 0.50) * 1e3, 3),
+                           "p99_ms": round(pct(v, 0.99) * 1e3, 3)}
+                       for c, v in per_comp.items()},
+        "component_p50_sum_s": round(sum_p50, 4),
+        # the acceptance gate: component p50s reproduce the p50 TTFT
+        "attribution_ratio": round(sum_p50 / p50, 4) if p50 > 0
+        else 0.0,
+        "spans_recorded": trace.recorder().recorded,
+        "spans_dropped": trace.recorder().dropped,
+        "slowest_trace_id": slowest[1],
+        "slowest_ttft_s": round(slowest[0], 4),
+        "slowest_tree": tree,
+        "leak_free": router.leak_free(),
+    }
+    print(json.dumps(record))
+    if tree:
+        print(f"slowest request ({slowest[0] * 1e3:.1f} ms TTFT):",
+              file=sys.stderr)
+        print(tree, file=sys.stderr)
+
+
 def bench_infer():
     """Inference headline: continuous-batching decode throughput.
 
@@ -1535,6 +1731,10 @@ def main():
             bench_infer_tiers()
         elif "--spec" in sys.argv:
             bench_infer_spec()
+        elif "--trace" in sys.argv:
+            # the attribution report wants the full disagg + tiers
+            # path in frame: >= 1 prefill + >= 2 decode replicas
+            bench_infer_trace(n if n > 1 else 3)
         elif "--gray" in sys.argv:
             # the demotion median wants an odd-one-out: 3+ replicas
             bench_infer_gray(n if n > 1 else 3)

@@ -70,6 +70,7 @@ from ray_tpu.fleet.router import ReplicaUnavailableError
 from ray_tpu.inference.kv_cache import (HandoffContentMissing, KVHandoff,
                                         PrefixIndex)
 from ray_tpu.inference.scheduler import QueueFullError
+from ray_tpu.telemetry import trace as trace_mod
 
 PREFILL = "prefill"
 DECODE = "decode"
@@ -156,6 +157,15 @@ class DisaggStream:
         self.eos_token = parsed["eos_token"]
         self.ttft_deadline_s = parsed["ttft_deadline_s"]
         self.deadline_s = parsed["deadline_s"]
+        # r24: every disagg request owns one trace — the context rides
+        # the prefill submit AND the handoff payload, so both replicas'
+        # spans join a single tree under this root
+        ctx = trace_mod.mint()
+        root_id = trace_mod.record_span(
+            "request", ctx, start=time.time(), dur=0.0,
+            prompt_tokens=len(self.prompt),
+            max_new=self.max_new_tokens, disagg=True)
+        self.trace = ctx.child(root_id) if root_id is not None else ctx
         self.submitted_ts = time.monotonic()
         self.first_token_ts: Optional[float] = None
         self.generated: List[int] = []
@@ -180,17 +190,26 @@ class DisaggStream:
         now = time.monotonic()
         if self.first_token_ts is None:
             self.first_token_ts = now
-            self._router._record_ttft(now - self.submitted_ts)
+            ttft = now - self.submitted_ts
+            self._router._record_ttft(ttft,
+                                      trace_id=self.trace.trace_id)
+            trace_mod.event("first_token", self.trace, ttft_s=ttft,
+                            replica=self.replica_id)
         self.generated.append(int(token))
         self.logprobs.append(float(logprob))
         self.token_ts.append(now)
 
     def _finish(self) -> None:
         self.done = True
+        trace_mod.event("request_end", self.trace,
+                        tokens=len(self.generated),
+                        handoffs=self.handoffs)
 
     def _fail(self, err: BaseException) -> None:
         self.error = err
         self.done = True
+        trace_mod.event("request_error", self.trace,
+                        error=type(err).__name__)
 
     @property
     def complete(self) -> bool:
@@ -420,6 +439,9 @@ class DisaggRouter:
                              if s > factor * med}
         for rid in sorted(newly - self._demoted[pool]):
             self.telemetry.record_demotion(rid)
+            trace_mod.anomaly("demotion", replica=rid, pool=pool,
+                              median_latency_s=med,
+                              slow_factor=factor)
         self._demoted[pool] = newly
         self._median_latency[pool] = med
 
@@ -501,6 +523,8 @@ class DisaggRouter:
             prompt, self.page_size)[:PrefixIndex.hit_eligible(
                 len(prompt), self.page_size)] if self.affinity else []
         excluded: set = set()
+        route_t0 = time.monotonic()
+        rejected: List[str] = []
         while True:
             cands = self._candidates(PREFILL, excluded)
             if not cands:
@@ -532,22 +556,37 @@ class DisaggRouter:
                     # token was delivered long ago)
                     ttft_deadline_s=(stream.ttft_deadline_s
                                      if not stream.generated else 0),
-                    deadline_s=self._remaining_deadline(stream))
+                    deadline_s=self._remaining_deadline(stream),
+                    trace_ctx=stream.trace)
             except chaos.InjectedFault:
                 self.telemetry.record_retry("dead")
+                rejected.append(f"dead:{replica.id}")
                 excluded.add(replica.id)
                 continue
             except ReplicaDrainingError:
                 self.telemetry.record_retry("draining")
+                rejected.append(f"draining:{replica.id}")
                 excluded.add(replica.id)
                 continue
             except QueueFullError:
                 self.telemetry.record_retry("queue_full")
+                rejected.append(f"queue_full:{replica.id}")
                 excluded.add(replica.id)
                 continue
             stream.phase = PREFILL
             stream.replica_id, stream.rid = replica.id, rid
             self._by_rid[(replica.id, rid)] = stream
+            if stream.trace.sampled:
+                now = time.monotonic()
+                trace_mod.record_span(
+                    "route", stream.trace,
+                    start=trace_mod.epoch_of(route_t0),
+                    dur=now - route_t0, picked=replica.id,
+                    pool=PREFILL, attempt=stream.retries,
+                    rejected=rejected,
+                    candidates={r.id: round(
+                        self._effective_load(r, PREFILL), 6)
+                        for r in cands})
             return
 
     def _remaining_deadline(self, stream: DisaggStream) -> Optional[float]:
@@ -583,6 +622,13 @@ class DisaggRouter:
             prefill_rep.engine.release_held(rid)
             self._failover(stream, cause="handoff")
             return
+        if stream.trace.sampled:
+            trace_mod.record_span(
+                "handoff.export", stream.trace,
+                start=trace_mod.epoch_of(t0),
+                dur=time.monotonic() - t0,
+                replica=prefill_rep.id, pages=handoff.n_pages,
+                nbytes=handoff.nbytes)
         try:
             self._import(handoff, stream, t0)
         except chaos.InjectedFault:
@@ -600,6 +646,7 @@ class DisaggRouter:
         from ray_tpu.inference.serve_gpt import ReplicaDrainingError
         from ray_tpu.util import chaos
         chaos.maybe_fail("serve.handoff")              # import leg
+        import_t0 = time.monotonic()
         remaining = stream.max_new_tokens - len(stream.generated)
         excluded: set = set()
         handle: Optional[int] = None
@@ -666,7 +713,16 @@ class DisaggRouter:
                 self.telemetry.record_handoff(
                     n_bytes=payload.nbytes,
                     seconds=time.monotonic() - t0,
-                    pages=len(payload.page_list), skipped=warm)
+                    pages=len(payload.page_list), skipped=warm,
+                    trace_id=stream.trace.trace_id)
+                if stream.trace.sampled:
+                    trace_mod.record_span(
+                        "handoff.import", stream.trace,
+                        start=trace_mod.epoch_of(import_t0),
+                        dur=time.monotonic() - import_t0,
+                        replica=replica.id, warm=warm,
+                        nbytes=payload.nbytes,
+                        pages=len(payload.page_list))
                 return
         finally:
             if handle is not None:
@@ -748,25 +804,40 @@ class DisaggRouter:
         slots/pages/prefix refs *and* any held exports."""
         bound = [(k, s) for k, s in list(self._by_rid.items())
                  if k[0] == replica.id]
+        cause = "dead" if reap else "wedged"
+        if not reap:
+            trace_mod.anomaly("wedge", replica=replica.id,
+                              bound_streams=len(bound))
         for key, stream in bound:
             del self._by_rid[key]
             if replica.alive:
                 replica.engine.cancel(key[1])
-            self._failover(stream)
+            self._failover(stream, cause=cause)
         if reap and not replica.alive and not replica.reaped:
             replica.reap()
 
     def _failover(self, stream: DisaggStream, *,
                   cause: str = "dead") -> None:
         self.telemetry.record_retry(cause)
+        self.telemetry.record_failover(cause)
+        from_replica = stream.replica_id
         stream.retries += 1
         if stream.retries > self.cfg.retries:
+            trace_mod.anomaly("failover_budget", trace=stream.trace,
+                              retries=stream.retries - 1, cause=cause)
             stream._fail(ReplicaUnavailableError(
                 f"failover budget exhausted after {stream.retries - 1} "
                 f"retr{'y' if stream.retries == 2 else 'ies'} "
                 "(RAY_TPU_FLEET_RETRIES)", retries=stream.retries - 1))
             return
         self._reroute(stream)
+        if not stream.done:
+            trace_mod.event(
+                "failover", stream.trace, cause=cause,
+                from_replica=from_replica,
+                to_replica=stream.replica_id,
+                tokens_resent=len(stream.generated),
+                retry=stream.retries)
 
     def _reroute(self, stream: DisaggStream) -> None:
         if stream.complete:
@@ -790,9 +861,11 @@ class DisaggRouter:
         stream._finish()
 
     # ------------------------------------------------------ observability
-    def _record_ttft(self, ttft_s: float) -> None:
+    def _record_ttft(self, ttft_s: float,
+                     trace_id: Optional[str] = None) -> None:
         self._ttfts.append(ttft_s)
-        self.telemetry.record_ttft(ttft_s, mode="disagg")
+        self.telemetry.record_ttft(ttft_s, mode="disagg",
+                                   trace_id=trace_id)
 
     def recent_ttfts(self) -> List[float]:
         return list(self._ttfts)

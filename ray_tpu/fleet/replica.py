@@ -71,6 +71,10 @@ class EngineReplica:
                  watchdog_s: float = 0.0):
         self.id = replica_id
         self.engine = engine
+        # r24 tracing: engine spans carry this replica's id, so a
+        # cross-replica trace tree (disagg, failover) attributes each
+        # span to the replica that did the work
+        engine.trace_label = replica_id
         self.alive = True
         self.draining = False
         self.watchdog = None
@@ -105,19 +109,21 @@ class EngineReplica:
     # --------------------------------------------------------- admission
     def submit(self, prompt, *, max_new_tokens: int, sampling=None,
                eos_token=None, ttft_deadline_s=None,
-               deadline_s=None, hold_pages: bool = False) -> int:
+               deadline_s=None, hold_pages: bool = False,
+               trace_ctx=None) -> int:
         """Admit one request; raises the typed re-route signals
         (``ReplicaDrainingError`` / ``QueueFullError``) the router
         retries on, or ``ValueError`` for a request this fleet's
         geometry can never serve (the router fails the stream).
-        ``hold_pages`` is the disagg prefill seam (see
-        :meth:`InferenceEngine.submit`)."""
+        ``hold_pages`` is the disagg prefill seam, ``trace_ctx`` the
+        r24 tracing one (see :meth:`InferenceEngine.submit`)."""
         self._check_admittable()
         return self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                   sampling=sampling, eos_token=eos_token,
                                   ttft_deadline_s=ttft_deadline_s,
                                   deadline_s=deadline_s,
-                                  hold_pages=hold_pages)
+                                  hold_pages=hold_pages,
+                                  trace_ctx=trace_ctx)
 
     def submit_import(self, handoff, *, max_new_tokens: int,
                       sampling=None, eos_token=None,

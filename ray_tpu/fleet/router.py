@@ -64,6 +64,7 @@ from ray_tpu.fleet.config import FleetConfig, fleet_config
 from ray_tpu.fleet.replica import EngineReplica
 from ray_tpu.inference.kv_cache import PrefixIndex
 from ray_tpu.inference.scheduler import QueueFullError
+from ray_tpu.telemetry import trace as trace_mod
 
 
 class ReplicaUnavailableError(RuntimeError):
@@ -93,6 +94,16 @@ class FleetStream:
         self.eos_token = parsed["eos_token"]
         self.ttft_deadline_s = parsed["ttft_deadline_s"]
         self.deadline_s = parsed["deadline_s"]
+        # r24 tracing: mint the request's TraceContext here — the
+        # router boundary IS the request's birth.  The root "request"
+        # span records immediately (dur=0) so a mid-request anomaly
+        # dump is still rooted, and every later span parents under it.
+        ctx = trace_mod.mint()
+        root_id = trace_mod.record_span(
+            "request", ctx, start=time.time(), dur=0.0,
+            prompt_tokens=len(self.prompt),
+            max_new=self.max_new_tokens)
+        self.trace = ctx.child(root_id) if root_id is not None else ctx
         self.submitted_ts = time.monotonic()
         self.first_token_ts: Optional[float] = None
         # every token the fleet has emitted for this request, in order
@@ -126,17 +137,22 @@ class FleetStream:
         now = time.monotonic()
         if self.first_token_ts is None:
             self.first_token_ts = now
-            self._router._record_ttft(now - self.submitted_ts)
+            self._router._record_ttft(now - self.submitted_ts,
+                                      trace_id=self.trace.trace_id)
         self.generated.append(int(token))
         self.logprobs.append(float(logprob))
         self.token_ts.append(now)
 
     def _finish(self) -> None:
         self.done = True
+        trace_mod.event("request_end", self.trace,
+                        tokens=len(self.generated))
 
     def _fail(self, err: BaseException) -> None:
         self.error = err
         self.done = True
+        trace_mod.event("request_error", self.trace,
+                        error=type(err).__name__)
 
     # ---------------------------------------------------------- consume
     def __iter__(self):
@@ -303,6 +319,8 @@ class FleetRouter:
                              if s > factor * med}
         for rid in sorted(newly - self._demoted):
             self.telemetry.record_demotion(rid)
+            trace_mod.anomaly("demotion", replica=rid,
+                              median_latency_s=med, slow_factor=factor)
         self._demoted = newly
         self._median_latency = med
 
@@ -412,6 +430,8 @@ class FleetRouter:
                 "cover prompt + max_new_tokens for failover-proof "
                 "requests", retries=stream.retries)
         excluded: set = set()
+        route_t0 = time.monotonic()
+        rejected: List[str] = []   # cause-tagged per-attempt rejections
         while True:
             cands = [r for r in self.healthy()
                      if r.id not in excluded]
@@ -449,23 +469,36 @@ class FleetRouter:
                     sampling=stream.sampling,
                     eos_token=stream.eos_token,
                     ttft_deadline_s=stream.ttft_deadline_s,
-                    deadline_s=stream.deadline_s)
+                    deadline_s=stream.deadline_s,
+                    trace_ctx=stream.trace)
             except chaos.InjectedFault:
                 # a routed submit failed in flight: indistinguishable
                 # from a dead target at the router — re-route
                 self.telemetry.record_retry("dead")
+                rejected.append(f"dead:{replica.id}")
                 excluded.add(replica.id)
                 continue
             except ReplicaDrainingError:
                 self.telemetry.record_retry("draining")
+                rejected.append(f"draining:{replica.id}")
                 excluded.add(replica.id)
                 continue
             except QueueFullError:
                 self.telemetry.record_retry("queue_full")
+                rejected.append(f"queue_full:{replica.id}")
                 excluded.add(replica.id)
                 continue
             stream.replica_id, stream.rid = replica.id, rid
             self._by_rid[(replica.id, rid)] = stream
+            if stream.trace.sampled:
+                trace_mod.record_span(
+                    "route", stream.trace,
+                    start=trace_mod.epoch_of(route_t0),
+                    dur=time.monotonic() - route_t0,
+                    picked=replica.id, attempt=stream.retries,
+                    rejected=rejected,
+                    candidates={r.id: round(self._effective_load(r), 6)
+                                for r in cands})
             return
 
     # --------------------------------------------------------- hedging
@@ -540,13 +573,19 @@ class FleetRouter:
                     sampling=stream.sampling,
                     eos_token=stream.eos_token,
                     ttft_deadline_s=stream.ttft_deadline_s,
-                    deadline_s=stream.deadline_s)
+                    deadline_s=stream.deadline_s,
+                    trace_ctx=stream.trace)
             except (ReplicaDrainingError, QueueFullError, ValueError):
                 continue              # best-effort: primary still runs
             stream.hedge_replica_id, stream.hedge_rid = replica.id, rid
             stream.hedges += 1
             self._by_rid[(replica.id, rid)] = stream
             self.telemetry.record_hedge("issued")
+            trace_mod.event("hedge_issued", stream.trace,
+                            hedge_replica=replica.id,
+                            primary_replica=stream.replica_id,
+                            waited_s=(time.monotonic()
+                                      - stream.submitted_ts))
             return
 
     def _other_binding(self, stream: FleetStream,
@@ -579,6 +618,11 @@ class FleetRouter:
         stream.replica_id, stream.rid = winner
         stream.hedge_replica_id = stream.hedge_rid = None
         self.telemetry.record_hedge("won" if hedge_won else "wasted")
+        self.telemetry.record_hedge_won(
+            "hedge" if hedge_won else "primary")
+        trace_mod.event("hedge_resolved", stream.trace,
+                        winner="hedge" if hedge_won else "primary",
+                        replica=winner[0])
 
     # ------------------------------------------------------- tick loop
     def quiesce(self, timeout_s: float = 5.0) -> bool:
@@ -745,8 +789,15 @@ class FleetRouter:
         replica keeps its engine state for the reconciler's restart,
         but its bound rids are cancelled so a revival cannot keep
         decoding for streams that have moved on."""
+        cause = "dead" if reap else "wedged"
         bound = [(k, s) for k, s in list(self._by_rid.items())
                  if k[0] == replica.id]
+        if not reap:
+            # a watchdog wedge is an anomaly trigger even with nothing
+            # bound: the record of what the fleet was doing when the
+            # step loop froze is the whole point of the recorder
+            trace_mod.anomaly("wedge", replica=replica.id,
+                              bound_streams=len(bound))
         for key, stream in bound:
             del self._by_rid[key]
             if replica.alive:
@@ -758,14 +809,19 @@ class FleetRouter:
                 # re-route ("won" when the hedge saved the stream)
                 self._resolve_hedge(stream, winner=other, loser=None)
                 continue
-            self._failover(stream)
+            self._failover(stream, cause=cause)
         if reap and not replica.alive and not replica.reaped:
             replica.reap()
 
-    def _failover(self, stream: FleetStream) -> None:
+    def _failover(self, stream: FleetStream, *,
+                  cause: str = "dead") -> None:
         self.telemetry.record_retry("dead")
+        self.telemetry.record_failover(cause)
+        from_replica = stream.replica_id
         stream.retries += 1
         if stream.retries > self.cfg.retries:
+            trace_mod.anomaly("failover_budget", trace=stream.trace,
+                              retries=stream.retries - 1, cause=cause)
             stream._fail(ReplicaUnavailableError(
                 f"failover budget exhausted after {stream.retries - 1} "
                 f"retr{'y' if stream.retries == 2 else 'ies'} "
@@ -775,6 +831,12 @@ class FleetRouter:
             self._route(stream)
         except (ReplicaUnavailableError, ValueError) as e:
             stream._fail(e)
+            return
+        trace_mod.event("failover", stream.trace, cause=cause,
+                        from_replica=from_replica,
+                        to_replica=stream.replica_id,
+                        tokens_resent=len(stream.generated),
+                        retry=stream.retries)
 
     def _cancel_stream(self, stream: FleetStream) -> None:
         if stream.replica_id is None or stream.done:
@@ -792,11 +854,14 @@ class FleetRouter:
         stream._finish()
 
     # ------------------------------------------------------ observability
-    def _record_ttft(self, ttft_s: float) -> None:
+    def _record_ttft(self, ttft_s: float,
+                     trace_id: Optional[str] = None) -> None:
         self._ttfts.append(ttft_s)
         # the single-pool arm of the r20 TTFT-by-pool-mode split (the
-        # disagg router records mode="disagg")
-        self.telemetry.record_ttft(ttft_s, mode="colocated")
+        # disagg router records mode="disagg"); the trace id rides the
+        # histogram as an exemplar (r24)
+        self.telemetry.record_ttft(ttft_s, mode="colocated",
+                                   trace_id=trace_id)
 
     def recent_ttfts(self) -> List[float]:
         """Recent first-token latencies (the reconciler's SLO signal

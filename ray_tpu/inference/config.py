@@ -108,6 +108,13 @@ class InferConfig:
       admission's walk extends through it, so every replica (including
       restarts and scale-from-zero spawns) warms up from pages any
       other replica prefilled.  ``0`` caps the hierarchy at host DRAM.
+    - ``RAY_TPU_KV_STORE_CAP`` (default ``0`` = unbounded): byte cap on
+      the fleet-shared page store (tier 2).  Over-cap puts evict the
+      least-recently-checked-out entries (never one mid-checkout —
+      in-flight fetches pin their entry), counted in the store's
+      ``evictions`` stat and the ``infer_kv_store_evictions_total``
+      counter.  A re-admit whose store pages were evicted degrades to
+      suffix prefill — exact continuations, just cold.
     - ``RAY_TPU_KV_SPILL_DTYPE`` (default ``int8``): spill/wire format
       for demoted pages — ``int8`` (per-vector block-scaled codes,
       ``head_dim + 4`` bytes per cached vector: ~2x cheaper DRAM/store
@@ -132,6 +139,7 @@ class InferConfig:
     spec_k: int = 4
     host_pages: int = 0
     store: bool = True
+    store_cap: int = 0
     spill_dtype: str = "int8"
 
 
@@ -188,6 +196,11 @@ def infer_config(refresh: bool = False) -> InferConfig:
             print(f"RAY_TPU_KV_HOST_PAGES={host_pages} negative; "
                   "using 0 (tiering off)", file=sys.stderr)
             host_pages = 0
+        store_cap = int(env("RAY_TPU_KV_STORE_CAP", "0"))
+        if store_cap < 0:
+            print(f"RAY_TPU_KV_STORE_CAP={store_cap} negative; "
+                  "using 0 (unbounded)", file=sys.stderr)
+            store_cap = 0
         spill_dtype = env("RAY_TPU_KV_SPILL_DTYPE", "int8")
         if spill_dtype not in ("int8", "model"):
             print(f"RAY_TPU_KV_SPILL_DTYPE={spill_dtype!r} unknown; "
@@ -210,6 +223,7 @@ def infer_config(refresh: bool = False) -> InferConfig:
             spec_k=spec_k,
             host_pages=host_pages,
             store=env("RAY_TPU_KV_STORE", "1") != "0",
+            store_cap=store_cap,
             spill_dtype=spill_dtype,
         )
     return _CONFIG

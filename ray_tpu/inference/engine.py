@@ -336,6 +336,14 @@ class InferenceEngine:
         self._held: Dict[int, Request] = {}
         self.exports = 0
         self.imports = 0
+        # r24 tracing: the replica id spans carry (set by
+        # fleet.replica.EngineReplica so cross-replica trace trees can
+        # attribute work; None = a bare engine)
+        self.trace_label: Optional[str] = None
+        # store-eviction telemetry is a scrape: the shared store's
+        # cumulative counter, deltas reported per tick
+        self._store_evictions_seen = (self.store.evictions
+                                      if self.store is not None else 0)
         self._next_rid = 0
         self._cancelled: set = set()
         self._lock = threading.Lock()   # submit() vs step() admissions
@@ -385,12 +393,16 @@ class InferenceEngine:
                eos_token: Optional[int] = None,
                ttft_deadline_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               hold_pages: bool = False) -> int:
+               hold_pages: bool = False,
+               trace_ctx=None) -> int:
         """Enqueue one request.  ``hold_pages`` is the disaggregation
         seam (first-token-stop mode is just ``max_new_tokens=1`` with
         it set): when the request retires, its page references survive
         for :meth:`export_request` instead of releasing — the prefill
-        side of a prefill/decode split."""
+        side of a prefill/decode split.  ``trace_ctx`` (r24, a
+        :class:`~ray_tpu.telemetry.trace.TraceContext`) attaches the
+        request to a distributed trace: queue / prefix-walk /
+        tier-fetch / prefill / verify spans all hang off its id."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -418,7 +430,8 @@ class InferenceEngine:
                                       is None else deadline_s or None),
                           hold_pages=bool(hold_pages),
                           spec_k=self._resolve_spec_k(
-                              sampling or SamplingParams()))
+                              sampling or SamplingParams()),
+                          trace=trace_ctx)
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
             depth = len(self.scheduler.waiting)
@@ -486,7 +499,9 @@ class InferenceEngine:
             chain_hashes=kvc.PrefixIndex.chain_hashes(context,
                                                       self.page_size),
             next_token=int(req.generated[-1]),
-            next_logprob=float(req.logprobs[-1]), **arrays)
+            next_logprob=float(req.logprobs[-1]),
+            trace=(req.trace.to_wire() if req.trace is not None
+                   else None), **arrays)
         self.scheduler.allocator.release(req.pages)
         req.pages = None
         self.exports += 1
@@ -545,6 +560,12 @@ class InferenceEngine:
                 f"context ({len(context)}) + remaining tokens "
                 f"({1 + max_new_tokens}) exceeds max_seq "
                 f"{self.cfg.max_seq}")
+        trace_ctx = None
+        if handoff.trace:
+            # the trace context rode the payload across replicas:
+            # importer-side spans join the exporter's tree
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_ctx = trace_mod.TraceContext.from_wire(handoff.trace)
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -562,7 +583,8 @@ class InferenceEngine:
                           chain_hashes=list(handoff.chain_hashes),
                           import_payload=handoff,
                           spec_k=self._resolve_spec_k(
-                              sampling or SamplingParams()))
+                              sampling or SamplingParams()),
+                          trace=trace_ctx)
             self.scheduler.submit(req)    # validates; may raise
             self._requests[rid] = req
             depth = len(self.scheduler.waiting)
@@ -633,6 +655,12 @@ class InferenceEngine:
             if self.telemetry.enabled:
                 self.telemetry.record_deadline_exceeded(
                     kind=req.error.kind)
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_mod.anomaly("deadline", trace=req.trace,
+                              rid=req.rid, budget=req.error.kind,
+                              budget_s=req.error.budget_s,
+                              waited_s=req.error.waited_s,
+                              replica=self.trace_label)
             events.append(StepEvent(req.rid, -1, True, 0.0,
                                     error=req.error))
 
@@ -784,6 +812,12 @@ class InferenceEngine:
                 self._verify(slot, drafts, events)
         self.ticks += 1
         self.last_tick_ts = time.monotonic()
+        if self.store is not None:
+            ev = self.store.evictions
+            if ev > self._store_evictions_seen:
+                self.telemetry.record_kv_store_evictions(
+                    ev - self._store_evictions_seen)
+                self._store_evictions_seen = ev
         if self.tiered and self.telemetry.enabled:
             self.telemetry.record_tier_occupancy(
                 hbm=len(self.scheduler.prefix_index or ()),
@@ -881,6 +915,21 @@ class InferenceEngine:
                 np.asarray(logits[0]))
         sched.lengths[slot] = plen
         now = time.monotonic()
+        tr = req.trace
+        if tr is not None and tr.sampled:
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_mod.record_span(
+                "queue", tr,
+                start=trace_mod.epoch_of(req.submitted_ts),
+                dur=req.admitted_ts - req.submitted_ts, rid=req.rid,
+                replica=self.trace_label)
+            trace_mod.record_span(
+                "prefill", tr, start=trace_mod.epoch_of(t0),
+                dur=now - t0, rid=req.rid, bucket=bucket,
+                cached=cached, kind=kind, replica=self.trace_label)
+            trace_mod.event("first_token", tr, rid=req.rid,
+                            ttft_s=now - req.submitted_ts,
+                            replica=self.trace_label)
         if self.telemetry.enabled:
             self.telemetry.record_queue(
                 req.admitted_ts - req.submitted_ts,
@@ -888,8 +937,9 @@ class InferenceEngine:
             self.telemetry.record_prefill(now - t0, prompt_tokens=plen,
                                           bucket=bucket,
                                           cached_tokens=cached)
-            self.telemetry.record_ttft(now - req.submitted_ts,
-                                       prefix_hit=cached > 0)
+            self.telemetry.record_ttft(
+                now - req.submitted_ts, prefix_hit=cached > 0,
+                trace_id=tr.trace_id if tr is not None else None)
         self._deliver(req, int(tok), float(logp), events)
 
     def _install_import(self, req: Request, events) -> None:
@@ -903,6 +953,7 @@ class InferenceEngine:
         handoff = req.import_payload
         sched = self.scheduler
         slot = req.slot
+        t0 = time.monotonic()
         n_ctx = len(req.prompt)
         n_pages = kvc.pages_needed(n_ctx, self.page_size)
         present = handoff.page_list
@@ -932,6 +983,14 @@ class InferenceEngine:
         req.cached_tokens = n_ctx
         req.import_payload = None      # drop the content reference
         self.imports += 1
+        if req.trace is not None and req.trace.sampled:
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_mod.record_span(
+                "handoff.install", req.trace,
+                start=trace_mod.epoch_of(t0),
+                dur=time.monotonic() - t0, rid=req.rid,
+                pages_written=len(needed), hit_pages=req.n_hit_pages,
+                replica=self.trace_label)
 
     # ------------------------------------------------ tiered cache (r23)
     def _register_prefix(self, req: Request) -> None:
@@ -1026,6 +1085,13 @@ class InferenceEngine:
             self.tier_hits[tier] += 1
             self.fetches += 1
             self.fetch_seconds += wall
+            if req.trace is not None and req.trace.sampled:
+                from ray_tpu.telemetry import trace as trace_mod
+                trace_mod.record_span(
+                    "tier_fetch", req.trace,
+                    start=trace_mod.epoch_of(t0), dur=wall,
+                    rid=req.rid, tier=tier, page_index=i,
+                    replica=self.trace_label)
             if self.telemetry.enabled:
                 self.telemetry.record_kv_fetch(wall, tier=tier)
                 self.telemetry.record_prefix_hits(1, tier=tier)
@@ -1102,6 +1168,17 @@ class InferenceEngine:
             self.cache.state = tuple(state)
             sampled, logps = self._sample_slots(logits, reqs)
         wall = time.monotonic() - t0
+        traced = [r.trace.trace_id for r in active
+                  if r.trace is not None and r.trace.sampled]
+        if traced:
+            # ONE coalesced span per tick (trace_id=None: a global
+            # span), carrying the sampled trace ids it served — a span
+            # per (tick, request) would swamp the ring at decode rate
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_mod.record_span(
+                "decode_tick", None, start=trace_mod.epoch_of(t0),
+                dur=wall, active=len(active), trace_ids=traced,
+                replica=self.trace_label)
         if self.telemetry.enabled:
             self.telemetry.record_decode(wall, active=len(active))
         if self.debug_logits:
@@ -1213,6 +1290,12 @@ class InferenceEngine:
         self.spec_proposed += n_drafts
         self.spec_accepted += m
         self.spec_k_hist[m] = self.spec_k_hist.get(m, 0) + 1
+        if req.trace is not None and req.trace.sampled:
+            from ray_tpu.telemetry import trace as trace_mod
+            trace_mod.record_span(
+                "verify", req.trace, start=trace_mod.epoch_of(t0),
+                dur=wall, rid=req.rid, proposed=n_drafts, accepted=m,
+                replica=self.trace_label)
         if self.debug_logits:
             host_logits = np.asarray(logits[0])
         delivered = 0

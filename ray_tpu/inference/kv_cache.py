@@ -151,6 +151,9 @@ class KVHandoff:
     # all of 0..n_pages): a stripped handoff ships only the pages its
     # target does not already hold by chain hash
     present: Optional[List[int]] = None
+    # wire form of the request's TraceContext (r24) — the trace rides
+    # the payload, so importer-side spans join the exporter's tree
+    trace: Optional[dict] = None
 
     @property
     def n_pages(self) -> int:
@@ -449,23 +452,45 @@ class KVPageStore:
     is shared, and the next replica's miss is this entry's hit.
     ``set_params`` invalidation is by key: a bumped param version
     simply never matches, no sweep required.
+
+    **Byte cap (r24).**  ``RAY_TPU_KV_STORE_CAP`` bounds resident
+    bytes: an over-cap put evicts least-recently-*used* entries
+    (checkout recency, then insertion order) until the new entry fits.
+    An entry mid-checkout is pinned — eviction skips it — so a fetch
+    in flight can never lose its payload; if nothing evictable remains
+    the cap is allowed to overshoot rather than drop live data.  A
+    request whose store pages were evicted simply misses on the walk
+    and prefills the suffix — exact greedy continuations, just cold.
     """
 
-    def __init__(self, use_object_store: Optional[bool] = None):
+    def __init__(self, use_object_store: Optional[bool] = None,
+                 capacity_bytes: Optional[int] = None):
         if use_object_store is None:
             try:
                 from ray_tpu._private.worker import is_initialized
                 use_object_store = is_initialized()
             except Exception:
                 use_object_store = False
+        if capacity_bytes is None:
+            from ray_tpu.inference.config import infer_config
+            capacity_bytes = infer_config().store_cap
         self._use_ray = bool(use_object_store)
-        self._entries: Dict[Tuple[bytes, int], object] = {}
+        self.capacity_bytes = int(capacity_bytes)   # 0 = unbounded
+        # insertion/recency-ordered: move_to_end on checkout makes the
+        # front the LRU eviction candidate
+        self._entries: "collections.OrderedDict[Tuple[bytes, int], object]" \
+            = collections.OrderedDict()
         self._bytes: Dict[Tuple[bytes, int], int] = {}
+        # per-key checkout pin counts — an entry with fetches in
+        # flight is never evicted
+        self._pins: Dict[Tuple[bytes, int], int] = {}
         self.puts = 0
         self.dup_puts = 0
         self.gets = 0
         self.misses = 0
         self.bytes_put = 0
+        self.evictions = 0
+        self.bytes_evicted = 0
         self.in_flight = 0
 
     def __len__(self) -> int:
@@ -478,16 +503,32 @@ class KVPageStore:
     def bytes(self) -> int:
         return sum(self._bytes.values())
 
+    def _evict_for(self, incoming: int) -> None:
+        if self.capacity_bytes <= 0:
+            return
+        resident = self.bytes
+        victims = [k for k in self._entries
+                   if not self._pins.get(k)]
+        for key in victims:
+            if resident + incoming <= self.capacity_bytes:
+                break
+            nb = self._bytes.pop(key, 0)
+            del self._entries[key]
+            resident -= nb
+            self.evictions += 1
+            self.bytes_evicted += nb
+
     def put(self, key: Tuple[bytes, int],
             entry: Dict[str, object]) -> None:
         if key in self._entries:        # content-addressed: a no-op
             self.dup_puts += 1
             return
+        nb = spill_entry_bytes(entry)
+        self._evict_for(nb)
         obj: object = entry
         if self._use_ray:
             import ray_tpu
             obj = ray_tpu.put(entry)
-        nb = spill_entry_bytes(entry)
         self._entries[key] = obj
         self._bytes[key] = nb
         self.puts += 1
@@ -497,11 +538,14 @@ class KVPageStore:
                  ) -> Optional[Dict[str, object]]:
         """Fetch an entry without removing it; pair with
         :meth:`checkin` once the install (or its failure path) is
-        done."""
+        done.  The entry is pinned against eviction until checked
+        back in."""
         obj = self._entries.get(key)
         if obj is None:
             self.misses += 1
             return None
+        self._entries.move_to_end(key)
+        self._pins[key] = self._pins.get(key, 0) + 1
         self.gets += 1
         self.in_flight += 1
         if self._use_ray:
@@ -511,12 +555,20 @@ class KVPageStore:
 
     def checkin(self, key: Tuple[bytes, int]) -> None:
         self.in_flight -= 1
+        pins = self._pins.get(key, 0) - 1
+        if pins > 0:
+            self._pins[key] = pins
+        else:
+            self._pins.pop(key, None)
 
     def stats(self) -> Dict[str, int]:
         return {"entries": len(self._entries), "bytes": self.bytes,
+                "capacity_bytes": self.capacity_bytes,
                 "puts": self.puts, "dup_puts": self.dup_puts,
                 "gets": self.gets, "misses": self.misses,
                 "bytes_put": self.bytes_put,
+                "evictions": self.evictions,
+                "bytes_evicted": self.bytes_evicted,
                 "in_flight": self.in_flight}
 
 

@@ -146,6 +146,11 @@ class Request:
     # ``SamplingParams.spec``/``spec_k`` overriding the engine
     # defaults, so the scheduler and engine never re-consult config.
     spec_k: int = 0
+    # distributed tracing (r24): the request's TraceContext (a
+    # telemetry.trace.TraceContext, None = untraced) — minted at the
+    # router/serve boundary, carried here so every lifecycle stage can
+    # hang spans off the same trace_id
+    trace: Optional[Any] = None
 
 
 class SlotScheduler:
@@ -259,7 +264,9 @@ class SlotScheduler:
         req = self.waiting[0]
         need = pages_needed(len(req.prompt) + req.max_new_tokens,
                             self.page_size)
+        walk_t0 = time.monotonic()
         hits = self._prefix_walk(req)
+        walk_dur = time.monotonic() - walk_t0
         # exact feasibility check before touching any state: acquiring
         # the hits removes the idle ones from the allocatable pool, so
         # the fresh allocation needs that much headroom beyond them —
@@ -289,6 +296,16 @@ class SlotScheduler:
             self.prefix_hit_pages += len(hits)
             self.prefix_hit_tokens += req.cached_tokens
             self.prefix_requests_hit += 1
+        if req.trace is not None and req.trace.sampled:
+            # only the admitting walk is recorded — blocked attempts
+            # re-walk but never admit, and a span per blocked tick
+            # would drown the ring
+            from ray_tpu.telemetry import trace as _trace
+            _trace.record_span(
+                "prefix_walk", req.trace,
+                start=_trace.epoch_of(walk_t0), dur=walk_dur,
+                hits=len(hits), tier_plan=req.tier_plan,
+                eligible=len(req.chain_hashes or []))
         return req
 
     def note_tier_hits(self, req: Request, n_pages: int) -> None:

@@ -175,13 +175,24 @@ class GPTDeployment:
                 "requests finishing — retry on another replica")
         parsed = parse_request(request)
         want_logprobs = parsed["want_logprobs"]
+        # r24: a bare deployment request (no fleet router in front)
+        # mints its own trace here — the engine's spans still land in
+        # the flight recorder and the dashboard timeline
+        from ray_tpu.telemetry import trace as trace_mod
+        ctx = trace_mod.mint()
+        root_id = trace_mod.record_span(
+            "request", ctx, start=time.time(), dur=0.0,
+            prompt_tokens=len(request["tokens"]),
+            max_new=parsed["max_new_tokens"])
+        trace_ctx = ctx.child(root_id) if root_id is not None else ctx
         rid = self.engine.submit(
             request["tokens"],
             max_new_tokens=parsed["max_new_tokens"],
             sampling=parsed["sampling"],
             eos_token=parsed["eos_token"],
             ttft_deadline_s=parsed["ttft_deadline_s"],
-            deadline_s=parsed["deadline_s"])
+            deadline_s=parsed["deadline_s"],
+            trace_ctx=trace_ctx)
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[rid] = queue
         self._last_pumped[rid] = time.monotonic()

@@ -1,13 +1,16 @@
 """Chrome-trace / Perfetto exporter: one unified host+train timeline.
 
-Merges two in-process sources into one ``traceEvents`` JSON that loads
-in Perfetto / ``chrome://tracing``:
+Merges three in-process sources into one ``traceEvents`` JSON that
+loads in Perfetto / ``chrome://tracing``:
 
 - the host-side span recorder (``ray_tpu.util.tracing`` fallback
   recorder — submit/task spans plus the named train-loop scopes the
-  telemetry wrapper emits when tracing is enabled), and
+  telemetry wrapper emits when tracing is enabled),
 - every live :class:`~ray_tpu.telemetry.step.StepTelemetry` recorder's
-  per-step records (step / dispatch / sync / compile complete-events).
+  per-step records (step / dispatch / sync / compile complete-events),
+- the r24 per-request flight recorder
+  (:mod:`ray_tpu.telemetry.trace` — routing, handoff, prefill and
+  decode spans, grouped by replica).
 
 The dashboard ``/api/timeline`` appends the same events to the
 task-event trace, so a browser pointed at the head node sees train
@@ -47,7 +50,8 @@ def _span_events(spans) -> List[Dict[str, Any]]:
 
 
 def trace_events(include_host: bool = True,
-                 include_steps: bool = True) -> List[Dict[str, Any]]:
+                 include_steps: bool = True,
+                 include_requests: bool = True) -> List[Dict[str, Any]]:
     """Every exportable event currently held in this process."""
     evs: List[Dict[str, Any]] = []
     if include_host:
@@ -57,6 +61,12 @@ def trace_events(include_host: bool = True,
         from ray_tpu.telemetry.step import recorders
         for rec in recorders():
             evs.extend(rec.chrome_events())
+    if include_requests:
+        # r24 per-request spans: the flight-recorder ring joins the
+        # same timeline, so /api/timeline shows serving requests next
+        # to train steps for free
+        from ray_tpu.telemetry import trace
+        evs.extend(trace.chrome_events())
     evs.sort(key=lambda e: e.get("ts", 0))
     return evs
 

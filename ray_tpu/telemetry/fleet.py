@@ -56,6 +56,12 @@ class FleetTelemetry:
         # "won" (the hedge delivered the stream), "wasted" (the
         # primary did — the hedge's work was thrown away)
         self.hedges: Dict[str, int] = {}
+        # winner -> count for resolved hedge races ("primary" /
+        # "hedge") — the r24 /metrics view of race outcomes
+        self.hedge_winners: Dict[str, int] = {}
+        # cause -> count for completed failovers ("dead" / "wedged" /
+        # "handoff" / ...) — previously only visible per-stream
+        self.failovers: Dict[str, int] = {}
         self.replica_demotions = 0
         self.latency_scores: Dict[str, float] = {}
         # r20 disaggregation series: handoff accounting + per-pool
@@ -105,6 +111,31 @@ class FleetTelemetry:
         self.hedges[outcome] = self.hedges.get(outcome, 0) + 1
         self._emit_hedge(outcome)
 
+    def record_hedge_won(self, winner: str) -> None:
+        """One resolved hedge race, by ``winner`` (``primary`` /
+        ``hedge``) — ``serve_hedges_won_total`` makes the race outcome
+        visible on ``/metrics`` instead of only as per-stream
+        attributes."""
+        if winner not in ("primary", "hedge"):
+            raise ValueError(f"unknown hedge winner {winner!r}; "
+                             "expected primary/hedge")
+        if not self.enabled:
+            return
+        self.hedge_winners[winner] = \
+            self.hedge_winners.get(winner, 0) + 1
+        self._emit_hedge_won(winner)
+
+    def record_failover(self, cause: str) -> None:
+        """One in-flight stream failed over to another replica, by
+        cause (``dead`` — replica death/wedge — or ``handoff`` — a
+        faulted disagg transfer leg).  Distinct from
+        ``record_retry``: retries count *submission* re-routes too;
+        this counts only mid-stream recoveries."""
+        if not self.enabled:
+            return
+        self.failovers[cause] = self.failovers.get(cause, 0) + 1
+        self._emit_failover(cause)
+
     def record_demotion(self, replica_id: str) -> None:
         """The router demoted a replica for latency (its EWMA tick
         latency crossed slow_factor x the fleet median) — counted once
@@ -133,11 +164,13 @@ class FleetTelemetry:
     _MAX_RECORDS = 10_000
 
     def record_handoff(self, *, n_bytes: int, seconds: float,
-                       pages: int, skipped: bool = False) -> None:
+                       pages: int, skipped: bool = False,
+                       trace_id: str = None) -> None:
         """One prefill→decode KV handoff (r20): content bytes moved
         through the object store (0 for a warm, metadata-only handoff
         — counted in ``handoffs_skipped``), wall seconds export→import,
-        and the page count behind the byte math."""
+        and the page count behind the byte math.  ``trace_id`` rides
+        the latency histogram as an exemplar (r24)."""
         if not self.enabled:
             return
         self.handoffs += 1
@@ -147,7 +180,7 @@ class FleetTelemetry:
         self.handoff_pages += int(pages)
         if len(self.handoff_s) < self._MAX_RECORDS:
             self.handoff_s.append(float(seconds))
-        self._emit_handoff(n_bytes, seconds)
+        self._emit_handoff(n_bytes, seconds, trace_id)
 
     def record_pool_depth(self, pool: str, depth: int) -> None:
         """Aggregate queue depth of one pool (``prefill`` /
@@ -165,18 +198,21 @@ class FleetTelemetry:
         self._pool_last[pool] = now
         self._emit_pool_depth(pool, depth)
 
-    def record_ttft(self, seconds: float, *, mode: str) -> None:
+    def record_ttft(self, seconds: float, *, mode: str,
+                    trace_id: str = None) -> None:
         """Per-request time-to-first-token, split by pool mode
         (``disagg`` when a dedicated prefill pool served it,
         ``colocated`` for the single-pool fleet) — the comparison the
         split exists for: prefill interference shows up exactly here
-        and in the decode inter-token tail."""
+        and in the decode inter-token tail.  ``trace_id`` rides the
+        histogram as an exemplar (r24): the jump from a p99 bucket to
+        that one request's flight-recorder span tree."""
         if not self.enabled:
             return
         bucket = self.ttfts_by_mode.setdefault(mode, [])
         if len(bucket) < self._MAX_RECORDS:
             bucket.append(float(seconds))
-        self._emit_ttft(seconds, mode)
+        self._emit_ttft(seconds, mode, trace_id)
 
     def record_affinity(self, *, hit: bool) -> None:
         """One routing decision with affinity enabled: ``hit`` when a
@@ -245,6 +281,8 @@ class FleetTelemetry:
             "affinity_hit_rate": self.affinity_hit_rate,
             "replica_queue_depth": dict(self.queue_depths),
             "hedges": dict(self.hedges),
+            "hedge_winners": dict(self.hedge_winners),
+            "failovers": dict(self.failovers),
             "replica_demotions": self.replica_demotions,
             "replica_latency_score": dict(self.latency_scores),
             # r20 disaggregation block
@@ -291,6 +329,16 @@ class FleetTelemetry:
                     "tail-latency hedges, by outcome (issued / won / "
                     "wasted)",
                     tag_keys=("label", "outcome")),
+                "hedges_won": Counter(
+                    "serve_hedges_won_total",
+                    "resolved hedge races, by winner (primary / "
+                    "hedge)",
+                    tag_keys=("label", "winner")),
+                "failovers": Counter(
+                    "serve_failovers_total",
+                    "mid-stream failovers to another replica, by "
+                    "cause (dead / handoff)",
+                    tag_keys=("label", "cause")),
                 "demotions": Counter(
                     "serve_replica_demotions_total",
                     "replicas demoted from routing for EWMA tick "
@@ -359,7 +407,30 @@ class FleetTelemetry:
         except Exception:  # noqa: BLE001 — never tax the router
             self._metrics_dead = True
 
-    def _emit_handoff(self, n_bytes: int, seconds: float):
+    def _emit_hedge_won(self, winner: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["hedges_won"].inc(
+                    1.0, tags={"label": self.label, "winner": winner})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_failover(self, cause: str):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["failovers"].inc(
+                    1.0, tags={"label": self.label, "cause": cause})
+        except Exception:  # noqa: BLE001 — never tax the router
+            self._metrics_dead = True
+
+    def _emit_handoff(self, n_bytes: int, seconds: float,
+                      trace_id: str = None):
         if self._metrics_dead:
             return
         try:
@@ -368,7 +439,9 @@ class FleetTelemetry:
                 metrics["handoff_bytes"].inc(
                     float(n_bytes), tags={"label": self.label})
                 metrics["handoff_s"].observe(
-                    float(seconds), tags={"label": self.label})
+                    float(seconds), tags={"label": self.label},
+                    exemplar=({"trace_id": trace_id}
+                              if trace_id else None))
         except Exception:  # noqa: BLE001 — never tax the router
             self._metrics_dead = True
 
@@ -382,7 +455,8 @@ class FleetTelemetry:
         except Exception:  # noqa: BLE001 — never tax the router
             self._metrics_dead = True
 
-    def _emit_ttft(self, seconds: float, mode: str):
+    def _emit_ttft(self, seconds: float, mode: str,
+                   trace_id: str = None):
         if self._metrics_dead:
             return
         try:
@@ -390,7 +464,9 @@ class FleetTelemetry:
             if metrics is not None:
                 metrics["ttft"].observe(
                     float(seconds),
-                    tags={"label": self.label, "mode": mode})
+                    tags={"label": self.label, "mode": mode},
+                    exemplar=({"trace_id": trace_id}
+                              if trace_id else None))
         except Exception:  # noqa: BLE001 — never tax the router
             self._metrics_dead = True
 

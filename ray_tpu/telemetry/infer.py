@@ -33,16 +33,13 @@ _TTFT_BOUNDARIES = [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                     1.0, 2.5, 5.0, 10.0, 30.0]
 _STEP_BOUNDARIES = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                     0.01, 0.025, 0.05, 0.1, 0.25, 1.0]
-# accepted drafts per verify step (integer counts; .5 edges put each
-# count in its own bucket up to 8, then coarse tails)
-_SPEC_BOUNDARIES = [0.5, 1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5, 8.5,
-                    12.5, 16.5]
 
 
 class InferTelemetry:
     """Per-engine recorder for prefill/decode/TTFT records."""
 
     _MAX_RECORDS = 10_000
+    _MAX_EXEMPLARS = 64
     _EMIT_INTERVAL_S = 0.5
 
     def __init__(self, *, label: str = "infer", config=None):
@@ -52,6 +49,8 @@ class InferTelemetry:
         self.prefills: List[Dict[str, Any]] = []
         self.decodes: List[Dict[str, Any]] = []
         self.ttfts: List[float] = []
+        # (ttft_s, trace_id) exemplars — the histogram-to-trace bridge
+        self.ttft_exemplars: List[Any] = []
         # TTFT split by prefix-cache outcome: a hit request's first
         # token only pays the suffix prefill, so the two populations
         # have different distributions worth reporting separately
@@ -77,6 +76,7 @@ class InferTelemetry:
         self.kv_spill_bytes = 0
         self.kv_fetches = 0
         self.kv_fetch_seconds = 0.0
+        self.kv_store_evictions = 0
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
@@ -127,8 +127,11 @@ class InferTelemetry:
         del self.decodes[:-self._MAX_RECORDS]
         self._emit_verify(wall_s, proposed, accepted, emitted)
 
-    def record_ttft(self, ttft_s: float, *,
-                    prefix_hit: bool = False) -> None:
+    def record_ttft(self, ttft_s: float, *, prefix_hit: bool = False,
+                    trace_id: Optional[str] = None) -> None:
+        """``trace_id`` (when the request was trace-sampled) rides the
+        Prometheus histogram as an exemplar — the jump from a p99
+        bucket to the one request's flight-recorder span tree."""
         if not self.enabled:
             return
         self.ttfts.append(ttft_s)
@@ -136,7 +139,10 @@ class InferTelemetry:
         split = self.ttfts_hit if prefix_hit else self.ttfts_miss
         split.append(ttft_s)
         del split[:-self._MAX_RECORDS]
-        self._emit_ttft(ttft_s)
+        if trace_id:
+            self.ttft_exemplars.append((ttft_s, trace_id))
+            del self.ttft_exemplars[:-self._MAX_EXEMPLARS]
+        self._emit_ttft(ttft_s, trace_id)
 
     def record_queue(self, wait_s: float, *, depth: int) -> None:
         """Admission-time record: how long the request waited in the
@@ -208,6 +214,16 @@ class InferTelemetry:
         self.kv_fetch_seconds += wall_s
         self._emit_kv_fetch(wall_s, tier)
 
+    def record_kv_store_evictions(self, n: int) -> None:
+        """``n`` entries LRU-evicted from the capped fleet page store
+        (``RAY_TPU_KV_STORE_CAP``) — the churn signal: a high rate says
+        the cap is below the working set and re-admits are paying
+        suffix prefills for pages the fleet once held."""
+        if not self.enabled or n <= 0:
+            return
+        self.kv_store_evictions += n
+        self._emit_store_evictions(n)
+
     def record_tier_occupancy(self, *, hbm: int, dram: int,
                               store: int) -> None:
         """Per-tick tier occupancy gauges (pages resident per tier),
@@ -267,11 +283,17 @@ class InferTelemetry:
                 "spill_bytes": self.kv_spill_bytes,
                 "fetches": self.kv_fetches,
                 "fetch_seconds": self.kv_fetch_seconds,
+                "store_evictions": self.kv_store_evictions,
             }
         if self.ttfts:
             out["ttft_s"] = statistics.median(self.ttfts)
             out["ttft_mean_s"] = statistics.fmean(self.ttfts)
             out["ttft_max_s"] = max(self.ttfts)
+        if self.ttft_exemplars:
+            # the worst traced request — where tail diagnosis starts
+            worst = max(self.ttft_exemplars, key=lambda e: e[0])
+            out["ttft_worst_trace"] = {"ttft_s": worst[0],
+                                       "trace_id": worst[1]}
         if self.ttfts_hit:
             out["ttft_prefix_hit_s"] = statistics.median(self.ttfts_hit)
         if self.ttfts_miss:
@@ -338,10 +360,15 @@ class InferTelemetry:
                     "infer_spec_accept_rate",
                     "cumulative speculative accept rate",
                     tag_keys=tags),
-                "spec_hist": Histogram(
+                # a gauge, not a histogram: draft counts are neither
+                # seconds nor bytes, and the naming lint
+                # (tests/test_metrics_naming.py) holds histograms to
+                # those units — the accept *distribution* lives in
+                # ``stats()["spec"]["k_hist"]``
+                "spec_hist": Gauge(
                     "infer_spec_accepted_tokens",
-                    "drafts accepted per verify step",
-                    boundaries=_SPEC_BOUNDARIES, tag_keys=tags),
+                    "drafts accepted in the most recent verify step",
+                    tag_keys=tags),
                 "prefix_hits": Counter(
                     "infer_prefix_hits_total",
                     "prefix pages served, by tier",
@@ -359,17 +386,25 @@ class InferTelemetry:
                     "infer_kv_tier_pages",
                     "prefix pages resident, by tier",
                     tag_keys=("label", "tier")),
+                "store_evictions": Counter(
+                    "infer_kv_store_evictions_total",
+                    "entries LRU-evicted from the capped fleet "
+                    "KV page store",
+                    tag_keys=tags),
             }
         return self._metrics
 
-    def _emit_ttft(self, ttft_s: float):
+    def _emit_ttft(self, ttft_s: float,
+                   trace_id: Optional[str] = None):
         if self._metrics_dead:
             return
         try:
             metrics = self._metric_objects()
             if metrics is not None:
-                metrics["ttft"].observe(ttft_s,
-                                        tags={"label": self.label})
+                metrics["ttft"].observe(
+                    ttft_s, tags={"label": self.label},
+                    exemplar=({"trace_id": trace_id}
+                              if trace_id else None))
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 
@@ -416,7 +451,7 @@ class InferTelemetry:
                     < self._EMIT_INTERVAL_S):
                 return
             self._metrics_last = now
-            metrics["spec_hist"].observe(float(accepted), tags=tags)
+            metrics["spec_hist"].set(float(accepted), tags=tags)
             if self.spec_proposed:
                 metrics["spec_rate"].set(
                     self.spec_accepted / self.spec_proposed, tags=tags)
@@ -457,6 +492,17 @@ class InferTelemetry:
             if metrics is not None:
                 metrics["kv_fetch"].observe(
                     wall_s, tags={"label": self.label, "tier": tier})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_store_evictions(self, n: int):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                metrics["store_evictions"].inc(
+                    float(n), tags={"label": self.label})
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

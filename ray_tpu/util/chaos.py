@@ -279,7 +279,12 @@ def maybe_fail(site: str) -> None:
     when an armed fault triggers.  Free when no plan is armed."""
     plan = fault_plan()
     if plan is not None and plan.fires(site):
-        raise InjectedFault(site, plan.hits(site))
+        hit = plan.hits(site)
+        # r24: every injected fault is a flight-recorder anomaly —
+        # lazy import keeps the un-armed fast path free of telemetry
+        from ray_tpu.telemetry import trace as trace_mod
+        trace_mod.on_injected_fault(site, hit)
+        raise InjectedFault(site, hit)
 
 
 class ResourceKiller:

@@ -87,7 +87,8 @@ class Histogram(Metric):
         self._spec_published = False
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None):
+                tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[Dict[str, str]] = None):
         cp = _cp()
         if not self._spec_published:
             # boundaries live beside the samples so the exposition can
@@ -101,6 +102,14 @@ class Histogram(Metric):
         cp.incr(f"user_histogram:{self._name}:{tk}:bucket:{idx}")
         cp.incr(f"user_histogram:{self._name}:{tk}:sum", float(value))
         cp.incr(f"user_histogram:{self._name}:{tk}:count")
+        if exemplar:
+            # latest-wins exemplar per series (OpenMetrics style: a
+            # trace id that explains one recent observation) — rendered
+            # after the +Inf bucket by ``prometheus_text``
+            cp.kv_put(f"histexemplar:{self._name}:{tk}".encode(),
+                      json.dumps({"labels": exemplar,
+                                  "value": float(value)}).encode(),
+                      namespace="_metrics")
 
 
 def _render_value(value) -> str:
@@ -171,9 +180,18 @@ def prometheus_text() -> str:
                 lines.append(
                     f'{safe}_bucket{{{base}{sep}le="{bound}"}} '
                     f'{_render_value(cum)}')
-            lines.append(
-                f'{safe}_bucket{{{base}{sep}le="+Inf"}} '
-                f'{_render_value(series["count"])}')
+            inf_line = (f'{safe}_bucket{{{base}{sep}le="+Inf"}} '
+                        f'{_render_value(series["count"])}')
+            raw_ex = cp.kv_get(f"histexemplar:{name}:{tk}".encode(),
+                               namespace="_metrics")
+            if raw_ex:
+                ex = json.loads(raw_ex)
+                ex_labels = ",".join(
+                    f'{_sanitize(k)}="{_escape_label(v)}"'
+                    for k, v in sorted(ex["labels"].items()))
+                inf_line += (f' # {{{ex_labels}}} '
+                             f'{_render_value(ex["value"])}')
+            lines.append(inf_line)
             suffix = f"{{{base}}}" if base else ""
             lines.append(
                 f'{safe}_sum{suffix} {_render_value(series["sum"])}')

@@ -1,0 +1,78 @@
+"""Metrics-naming drift lint (r24, the test_env_knobs precedent).
+
+Prometheus naming conventions are load-bearing for dashboards and
+recording rules: a counter that does not end ``_total`` breaks
+``rate()`` idioms, a histogram without a unit suffix is ambiguous, and
+two modules registering the same metric name silently merge series.
+This test AST-scans every ``Counter``/``Histogram``/``Gauge``
+registration in ``ray_tpu/telemetry/*.py`` and fails on violations —
+the same automate-the-review-rule move as the env-knob lint.
+"""
+
+import ast
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+HIST_SUFFIXES = ("_seconds", "_bytes")
+
+
+def metric_registrations():
+    """``[(file, kind, name), ...]`` for every metric constructed with
+    a literal name in the telemetry package."""
+    out = []
+    for f in sorted((REPO / "ray_tpu" / "telemetry").glob("*.py")):
+        tree = ast.parse(f.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            kind = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None)
+            if kind not in ("Counter", "Histogram", "Gauge"):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                out.append((f.name, kind, first.value))
+    return out
+
+
+def test_lint_finds_registrations():
+    regs = metric_registrations()
+    # sanity: the scan sees the known registries (an empty result
+    # would green-light everything)
+    assert len(regs) >= 20
+    assert any(n == "serve_failovers_total" for _, _, n in regs)
+    assert any(n == "serve_hedges_won_total" for _, _, n in regs)
+
+
+def test_counters_end_in_total():
+    bad = [(f, n) for f, kind, n in metric_registrations()
+           if kind == "Counter" and not n.endswith("_total")]
+    assert not bad, (
+        "Counter names must end '_total' (Prometheus convention — "
+        f"rate() and dashboards assume it): {bad}")
+
+
+def test_histograms_carry_a_unit_suffix():
+    bad = [(f, n) for f, kind, n in metric_registrations()
+           if kind == "Histogram"
+           and not n.endswith(HIST_SUFFIXES)]
+    assert not bad, (
+        "Histogram names must end in a unit suffix "
+        f"{HIST_SUFFIXES}: {bad}")
+
+
+def test_no_duplicate_metric_names_across_modules():
+    seen = {}
+    dups = []
+    for f, kind, n in metric_registrations():
+        prev = seen.setdefault(n, (f, kind))
+        if prev != (f, kind):
+            dups.append((n, prev, (f, kind)))
+    assert not dups, (
+        "metric name registered by more than one module (series "
+        f"would silently merge): {dups}")
