@@ -1384,6 +1384,162 @@ def bench_infer_spec():
     print(json.dumps(result))
 
 
+def bench_infer_lora():
+    """Multi-tenant LoRA A/B: ``python bench.py --infer --lora``.
+
+    Two experiments over one warmed executable cache.  (1) Tenant-count
+    sweep on a single engine: decode tokens/s under 0 (base), 1, 8 and
+    64 distinct tenants round-robined through a bank with 8 cache
+    slots — 1 and 8 are steady-state resident (every request a cache
+    hit), 64 is the churn regime (evictions + store reloads on the
+    request path).  The grouped-gather decode applies per-slot factors,
+    so the per-token cost is flat in resident tenant count; churn pays
+    only the eager bank installs.  (2) Router A/B: a two-replica fleet
+    serving 6 tenants with adapter affinity on vs residency-blind
+    (``adapter_affinity=False``) — reports per-arm adapter cache hit
+    rate and store loads (the affinity arm pins tenants to the replica
+    whose bank already holds them, so its miss/load count collapses).
+    Prints ONE JSON line; compile counters must stay frozen across
+    every arm (adapters are call args, never exec-key material), and
+    every engine must pass the leak audit (slots, pages, pins, store
+    ``in_flight``).  On CPU the model shrinks to a smoke configuration
+    (numbers exercise the engine, not the hardware).
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.adapters import AdapterStore, LoraConfig, init_adapter
+    from ray_tpu.adapters import adapter_nbytes
+    from ray_tpu.fleet import EngineReplica, FleetConfig, FleetRouter
+    from ray_tpu.inference import InferenceEngine, SamplingParams
+    from ray_tpu.models.gpt import GPTConfig, init_params
+
+    platform = jax.devices()[0].platform
+    cfg = GPTConfig(vocab_size=2048, d_model=128, n_layers=2,
+                    n_heads=4, max_seq=256, dtype=jnp.float32)
+    slots, page, max_new = 2, 16, 8
+    buckets = (16, 32)
+    lcfg = LoraConfig(enabled=True, rank=8, scale=0.5, cache_slots=8)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(25)
+    store = AdapterStore(use_object_store=False)
+    tenants = [f"tenant-{i:02d}" for i in range(64)]
+    for i, mid in enumerate(tenants):
+        store.put(mid, init_adapter(cfg, lcfg, jax.random.PRNGKey(i),
+                                    random_b=True), scale=0.5)
+    publish_bytes = store.stats()["bytes_published"] // len(tenants)
+    full_bytes = sum(np.asarray(v).nbytes
+                     for v in jax.tree.leaves(params))
+
+    executables = {}
+
+    def build():
+        return InferenceEngine(
+            cfg, params, slots=slots, page_size=page, buckets=buckets,
+            telemetry=False, max_queue=0, lora=lcfg,
+            adapter_store=store, executable_cache=executables)
+
+    prompts = [list(rng.randint(1, cfg.vocab_size, size=9))
+               for _ in range(16)]
+    warmup = build()
+    warmup.generate([prompts[0]], max_new_tokens=max_new)
+    warmup.generate(
+        [prompts[1]], max_new_tokens=max_new,
+        sampling=SamplingParams(temperature=0.0, model_id=tenants[0]))
+    warmup_compiles = dict(warmup.compile_counts)
+    del warmup
+
+    # ---- (1) tenant-count sweep + churn on one engine ----
+    arms = []
+    for n_tenants in (0, 1, 8, 64):
+        engine = build()
+        reqs = 32
+        t0 = _time.monotonic()
+        emitted = 0
+        for i in range(reqs):
+            mid = (tenants[i % n_tenants] if n_tenants else None)
+            out = engine.generate(
+                [prompts[i % len(prompts)]], max_new_tokens=max_new,
+                sampling=SamplingParams(temperature=0.0, model_id=mid))
+            emitted += len(out[0])
+        wall = _time.monotonic() - t0
+        st = engine.stats()
+        ad = st["adapters"] if n_tenants else {}
+        arms.append({
+            "tenants": n_tenants,
+            "decode_tok_s": round(emitted / wall, 2),
+            "requests": reqs,
+            "cache_hits": ad.get("hits", 0),
+            "loads": ad.get("loads", 0),
+            "evictions": ad.get("evictions", 0),
+            "load_seconds": ad.get("load_seconds", 0.0),
+            "compiles": st["compiles"],
+        })
+        assert sum(st["compiles"].values()) == 0, (n_tenants,
+                                                   st["compiles"])
+        assert engine.leak_free(), n_tenants
+    base_tok_s = arms[0]["decode_tok_s"]
+    for arm in arms:
+        arm["vs_base"] = round(arm["decode_tok_s"] / base_tok_s, 4)
+
+    # ---- (2) adapter-affinity vs residency-blind routing ----
+    ab = []
+    for affinity_on in (True, False):
+        replicas = [EngineReplica(f"r{i}", build()) for i in range(2)]
+        fcfg = FleetConfig(retries=2, affinity=True,
+                           adapter_affinity=affinity_on, hedge=False,
+                           dwell=1.0, backoff=1.0)
+        router = FleetRouter(replicas, cfg=fcfg, rng_seed=7)
+        mix = tenants[:6]
+        streams = []
+        for i in range(36):
+            streams.append(router.remote({
+                "tokens": prompts[i % len(prompts)],
+                "max_new_tokens": max_new,
+                "model_id": mix[i % len(mix)]}))
+            if len(streams) >= 4:
+                streams.pop(0).result()
+        for s in streams:
+            s.result()
+        hits = misses = loads = 0
+        for r in replicas:
+            ad = r.engine.stats()["adapters"]
+            hits += ad["hits"]
+            misses += ad["misses"]
+            loads += ad["loads"]
+            assert r.leak_free(), r.id
+        ab.append({
+            "arm": ("adapter_affinity" if affinity_on
+                    else "residency_blind"),
+            "adapter_cache_hit_rate": round(hits / (hits + misses), 4),
+            "loads": loads,
+            "evictions": sum(
+                r.engine.stats()["adapters"]["evictions"]
+                for r in replicas),
+        })
+    assert store.stats()["in_flight"] == 0
+
+    result = {
+        "metric": "infer_lora_ab",
+        "platform": platform,
+        "rank": lcfg.rank,
+        "cache_slots": lcfg.cache_slots,
+        "published_tenants": len(tenants),
+        # the adapter-only publish win: bytes per republish vs the
+        # full-weights payload the store replaces
+        "publish_bytes_per_adapter": int(publish_bytes),
+        "full_params_bytes": int(full_bytes),
+        "publish_shrink_x": round(full_bytes / publish_bytes, 1),
+        "warmup_compiles": warmup_compiles,
+        "tenant_sweep": arms,
+        "router_ab": ab,
+    }
+    print(json.dumps(result))
+
+
 def bench_rl():
     """RL-loop headline: open-loop actor/learner co-run.
 
@@ -1729,6 +1885,8 @@ def main():
         n = _replicas_arg()
         if "--tiers" in sys.argv:
             bench_infer_tiers()
+        elif "--lora" in sys.argv:
+            bench_infer_lora()
         elif "--spec" in sys.argv:
             bench_infer_spec()
         elif "--trace" in sys.argv:

@@ -1,0 +1,114 @@
+"""AdapterRegistry: per-engine resident-adapter bookkeeping.
+
+The engine's bank has ``cache_slots`` writable rows (slot 0 is the
+identity).  This registry decides which tenant occupies which row:
+
+- ``lookup``/``touch`` — LRU order over residents;
+- ``pin``/``unpin`` — every admitted request pins its tenant for its
+  lifetime, so an adapter mid-decode can never be evicted under the
+  requests using it (the page-allocator hold discipline, applied to
+  bank rows);
+- ``place`` — allocate a row for a new tenant, evicting the
+  least-recently-used *unpinned* resident when full; all-pinned is a
+  typed :class:`~ray_tpu.adapters.store.AdapterUnavailableError`
+  (the router re-routes), never a hang.
+
+Leak-audit contract: ``pinned_total == 0`` after a drain.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.adapters.store import AdapterUnavailableError
+
+
+class AdapterRegistry:
+    def __init__(self, cache_slots: int):
+        if cache_slots < 1:
+            raise ValueError(f"cache_slots must be >= 1, got {cache_slots}")
+        self.cache_slots = cache_slots
+        # model_id -> (bank slot, installed version); insertion order
+        # is LRU order (move_to_end on touch)
+        self._resident: "collections.OrderedDict[str, Tuple[int, int]]" = \
+            collections.OrderedDict()
+        self._free = list(range(cache_slots, 0, -1))  # pop() yields slot 1 first
+        self._pins: Dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.loads = 0
+        self.evictions = 0
+        self.load_seconds = 0.0
+
+    def lookup(self, model_id: str) -> Optional[Tuple[int, int]]:
+        return self._resident.get(model_id)
+
+    def touch(self, model_id: str) -> None:
+        self._resident.move_to_end(model_id)
+
+    def pin(self, model_id: str) -> None:
+        self._pins[model_id] = self._pins.get(model_id, 0) + 1
+
+    def unpin(self, model_id: str) -> None:
+        n = self._pins.get(model_id, 0) - 1
+        if n < 0:
+            raise RuntimeError(f"unpin of {model_id!r} without a pin")
+        if n == 0:
+            self._pins.pop(model_id)
+        else:
+            self._pins[model_id] = n
+
+    def place(self, model_id: str, version: int) -> Tuple[int, Optional[str]]:
+        """Allocate a bank row for ``model_id`` -> ``(slot, evicted)``.
+
+        A stale resident (version bump) keeps its row.  Otherwise take
+        a free row, else evict the LRU unpinned resident; if every
+        resident is pinned by in-flight requests the bank is genuinely
+        full and the caller gets the typed error."""
+        ent = self._resident.get(model_id)
+        if ent is not None:
+            slot = ent[0]
+            self._resident[model_id] = (slot, version)
+            self._resident.move_to_end(model_id)
+            return slot, None
+        evicted = None
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = next((m for m in self._resident if m not in self._pins),
+                          None)
+            if victim is None:
+                raise AdapterUnavailableError(
+                    model_id,
+                    f"all {self.cache_slots} resident adapters are "
+                    "pinned by in-flight requests")
+            slot = self._resident.pop(victim)[0]
+            self.evictions += 1
+            evicted = victim
+        self._resident[model_id] = (slot, version)
+        return slot, evicted
+
+    @property
+    def resident_ids(self) -> Tuple[str, ...]:
+        return tuple(self._resident)
+
+    @property
+    def pinned_total(self) -> int:
+        return sum(self._pins.values())
+
+    def digest(self) -> frozenset:
+        """Residency digest the router composes into affinity scores."""
+        return frozenset(self._resident)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "resident": len(self._resident),
+            "cache_slots": self.cache_slots,
+            "hits": self.hits,
+            "misses": self.misses,
+            "loads": self.loads,
+            "evictions": self.evictions,
+            "pins": self.pinned_total,
+            "load_seconds": round(self.load_seconds, 6),
+        }

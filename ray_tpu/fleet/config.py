@@ -33,6 +33,13 @@ class FleetConfig:
       above which an affinity hit is overridden — a hot replica must
       not absorb every shared-prefix request while its neighbours sit
       idle (the arXiv:2011.03641 saturated-not-overloaded argument).
+    - ``RAY_TPU_FLEET_ADAPTER_AFFINITY`` (default ``1``): adapter-
+      residency affinity (r25 multi-tenant serving) — a request whose
+      ``model_id`` is already resident in a replica's LoRA bank scores
+      toward that replica (skipping the store fetch + bank install a
+      cold replica would pay), composing with the prefix-affinity
+      score above; ``0`` is the residency-blind A/B arm
+      (``bench.py --infer --lora`` measures the delta).
     - ``RAY_TPU_FLEET_UP_DEPTH`` (default ``4``): mean waiting-queue
       depth per running replica that, sustained for the dwell, scales
       the fleet up.
@@ -85,6 +92,7 @@ class FleetConfig:
     retries: int = 2
     affinity: bool = True
     affinity_cap: int = 8
+    adapter_affinity: bool = True
     up_depth: float = 4.0
     ttft_slo: float = 0.0
     dwell: float = 5.0
@@ -120,6 +128,8 @@ def fleet_config(refresh: bool = False) -> FleetConfig:
             retries=nonneg("RAY_TPU_FLEET_RETRIES", "2", int),
             affinity=env("RAY_TPU_FLEET_AFFINITY", "1") != "0",
             affinity_cap=nonneg("RAY_TPU_FLEET_AFFINITY_CAP", "8", int),
+            adapter_affinity=env("RAY_TPU_FLEET_ADAPTER_AFFINITY",
+                                 "1") != "0",
             up_depth=nonneg("RAY_TPU_FLEET_UP_DEPTH", "4"),
             ttft_slo=nonneg("RAY_TPU_FLEET_TTFT_SLO", "0"),
             dwell=nonneg("RAY_TPU_FLEET_DWELL", "5"),

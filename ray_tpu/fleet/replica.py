@@ -234,6 +234,14 @@ class EngineReplica:
             self._digest_ticks = ticks
         return self._digest
 
+    def adapter_digest(self) -> frozenset:
+        """Resident tenant model_ids (r25): the router's adapter-
+        affinity signal — a request for a resident tenant skips the
+        store fetch + bank install entirely.  Cheap enough (a few
+        entries, bounded by the bank) not to memo like the prefix
+        digest."""
+        return self.engine.adapter_digest()
+
     # ------------------------------------------------------------- drain
     def drain(self) -> None:
         self.draining = True

@@ -60,6 +60,7 @@ import statistics
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.adapters import AdapterUnavailableError
 from ray_tpu.fleet.config import FleetConfig, fleet_config
 from ray_tpu.fleet.replica import EngineReplica
 from ray_tpu.inference.kv_cache import PrefixIndex
@@ -354,15 +355,33 @@ class FleetRouter:
             stream._fail(e)
         return stream
 
-    def _chain_hashes(self, prompt: List[int]) -> List[bytes]:
+    def _chain_hashes(self, prompt: List[int],
+                      salt: bytes = b"") -> List[bytes]:
         """Hit-eligible chained page hashes of a prompt — the
         scheduler's own walk (shared helper, so the hashing scheme
         and the final-page eligibility rule can never drift between
-        routing and admission)."""
+        routing and admission).  ``salt`` (r25) is the per-tenant
+        chain salt: a multi-tenant request's routing-side hashes must
+        match the salted entries its admission will register, or
+        affinity would score adapter traffic against base K/V it can
+        never legally hit."""
         eligible = PrefixIndex.hit_eligible(len(prompt),
                                             self.page_size)
-        return PrefixIndex.chain_hashes(prompt,
-                                        self.page_size)[:eligible]
+        return PrefixIndex.chain_hashes(prompt, self.page_size,
+                                        salt=salt)[:eligible]
+
+    def _adapter_salt(self, model_id: Optional[str]) -> bytes:
+        """The routing-side view of a tenant's prefix-chain salt,
+        through the fleet-shared adapter store (the first replica
+        wired to one — replicas of a fleet share the instance)."""
+        if not model_id:
+            return b""
+        store = next(
+            (getattr(r.engine, "adapter_store", None)
+             for r in self._replicas.values()
+             if getattr(r.engine, "adapter_store", None) is not None),
+            None)
+        return store.salt_for(model_id) if store is not None else b""
 
     # Tier-aware affinity weights (r23): an HBM-resident page is a
     # pure refcount bump; a host-DRAM page pays one host->device page
@@ -374,22 +393,40 @@ class FleetRouter:
     # through to the pow-2 load pick and warm whichever replica wins).
     TIER_WEIGHT_HBM = 1.0
     TIER_WEIGHT_DRAM = 0.8
+    # Adapter residency (r25): a resident tenant skips the store
+    # fetch + bank install a cold replica would pay — worth a couple
+    # of page hits, but a long prefix hit should still dominate (the
+    # saved prefill FLOPs scale with the prefix; the adapter load is
+    # one bounded host-side install)
+    ADAPTER_WEIGHT = 2.0
 
-    def _affinity_pick(self, prompt, cands) -> Optional[EngineReplica]:
+    def _affinity_pick(self, prompt, cands,
+                       model_id: Optional[str] = None
+                       ) -> Optional[EngineReplica]:
         """The tier-aware cost model over the r16 prefix-affinity
         pick: candidates score by how much re-prefill their warm tiers
         save (HBM hit > DRAM hit > nothing; ties break toward the
         shallower queue), and the winner still yields to pow-2 when
         its queue is past the affinity cap — a hot cache must not
-        become a hot spot."""
-        hashes = self._chain_hashes(prompt)
-        if not hashes:
+        become a hot spot.  Multi-tenant requests (r25) compose an
+        adapter-residency bonus into the same score — their prefix
+        hashes are salted per tenant, so the two signals can never
+        double-count the same pages — unless
+        ``RAY_TPU_FLEET_ADAPTER_AFFINITY=0`` pins the residency-blind
+        A/B arm."""
+        hashes = self._chain_hashes(prompt,
+                                    salt=self._adapter_salt(model_id))
+        score_adapters = (model_id is not None
+                          and self.cfg.adapter_affinity)
+        if not hashes and not score_adapters:
             return None
         best, best_score = None, 0.0
         for r in cands:
-            n_hbm, n_dram = r.tier_hits(hashes)
+            n_hbm, n_dram = r.tier_hits(hashes) if hashes else (0, 0)
             score = (n_hbm * self.TIER_WEIGHT_HBM
                      + n_dram * self.TIER_WEIGHT_DRAM)
+            if score_adapters and model_id in r.adapter_digest():
+                score += self.ADAPTER_WEIGHT
             if score > best_score or (
                     score == best_score and best is not None
                     and score > 0.0
@@ -450,7 +487,8 @@ class FleetRouter:
             cands = fast or cands
             replica = None
             if self.affinity:
-                replica = self._affinity_pick(prompt, cands)
+                replica = self._affinity_pick(
+                    prompt, cands, model_id=stream.sampling.model_id)
                 if not excluded and stream.retries == 0:
                     # one decision per REQUEST: re-routes and failover
                     # re-admissions must not multiply-count a request
@@ -486,6 +524,15 @@ class FleetRouter:
             except QueueFullError:
                 self.telemetry.record_retry("queue_full")
                 rejected.append(f"queue_full:{replica.id}")
+                excluded.add(replica.id)
+                continue
+            except AdapterUnavailableError:
+                # this replica cannot serve the tenant (no adapter
+                # support / bank full of pinned tenants): try the
+                # others — only when EVERY replica rejects does the
+                # typed error surface (via the empty-candidates raise)
+                self.telemetry.record_retry("adapter")
+                rejected.append(f"adapter:{replica.id}")
                 excluded.add(replica.id)
                 continue
             stream.replica_id, stream.rid = replica.id, rid
@@ -575,7 +622,8 @@ class FleetRouter:
                     ttft_deadline_s=stream.ttft_deadline_s,
                     deadline_s=stream.deadline_s,
                     trace_ctx=stream.trace)
-            except (ReplicaDrainingError, QueueFullError, ValueError):
+            except (ReplicaDrainingError, QueueFullError, ValueError,
+                    AdapterUnavailableError):
                 continue              # best-effort: primary still runs
             stream.hedge_replica_id, stream.hedge_rid = replica.id, rid
             stream.hedges += 1
@@ -899,4 +947,11 @@ class FleetRouter:
                 (r.engine.store.stats()
                  for r in self._replicas.values()
                  if r.engine.store is not None), None),
+            # r25: the fleet-shared adapter store (same one-instance
+            # convention as kv_store)
+            "adapter_store": next(
+                (getattr(r.engine, "adapter_store", None).stats()
+                 for r in self._replicas.values()
+                 if getattr(r.engine, "adapter_store", None)
+                 is not None), None),
         }

@@ -85,6 +85,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ray_tpu.adapters import (AdapterRegistry, AdapterStore,
+                              AdapterUnavailableError, LoraConfig,
+                              lora_config, salt_bytes)
+from ray_tpu.adapters import lora as lora_mod
 from ray_tpu.inference import kv_cache as kvc
 from ray_tpu.inference.config import default_buckets, infer_config
 from ray_tpu.inference.sampling import (SamplingParams, accept_drafts,
@@ -204,7 +208,9 @@ class InferenceEngine:
                  spill_dtype: Optional[str] = None,
                  telemetry: Optional[bool] = None,
                  debug_logits: bool = False,
-                 executable_cache: Optional[Dict[Any, Any]] = None):
+                 executable_cache: Optional[Dict[Any, Any]] = None,
+                 lora: Union["LoraConfig", bool, None] = None,
+                 adapter_store: Optional["AdapterStore"] = None):
         if cfg.n_experts > 0:
             raise NotImplementedError("MoE decode cache not supported yet")
         icfg = infer_config()
@@ -304,6 +310,40 @@ class InferenceEngine:
         self.fetches = 0
         self.fetch_seconds = 0.0
         self.fetch_faults = 0
+        # multi-tenant LoRA serving (r25): ``lora`` takes a LoraConfig
+        # (explicit geometry), True (env defaults, forced on), or
+        # None/False (follow RAY_TPU_LORA).  When on, the engine holds
+        # an adapter **bank** — stacked [N, L, in, r]/[N, L, r, out]
+        # factors, slot 0 the all-zeros identity — that rides every
+        # compiled step as a call argument, plus the per-engine LRU
+        # registry mapping model_id -> bank slot.  ``adapter_store``
+        # shares the fleet's publication point; lora-on engines
+        # default to a private store so direct put()/load flows work.
+        if isinstance(lora, LoraConfig):
+            self.lora_cfg: Optional[LoraConfig] = lora
+        elif lora is True:
+            self.lora_cfg = lora_config()
+        elif lora is None and lora_config().enabled:
+            self.lora_cfg = lora_config()
+        else:
+            self.lora_cfg = None
+        if self.lora_cfg is not None:
+            self._lora_targets = lora_mod.effective_targets(
+                cfg, self.lora_cfg)
+            self.lora_bank = lora_mod.bank_zeros(cfg, self.lora_cfg)
+            self.adapters: Optional[AdapterRegistry] = AdapterRegistry(
+                self.lora_cfg.cache_slots)
+            self.adapter_store: Optional[AdapterStore] = (
+                adapter_store if adapter_store is not None
+                else AdapterStore())
+            lora_key = ("lora", self.lora_cfg.rank,
+                        self.lora_cfg.bank_slots, self._lora_targets)
+        else:
+            self._lora_targets = ()
+            self.lora_bank = None
+            self.adapters = None
+            self.adapter_store = adapter_store
+            lora_key = None
         # compile cache: key -> AOT executable; an executable raises on
         # shape drift, so the counters below are honest.  Keys carry
         # the full (cfg, geometry) so a shared cache cannot alias
@@ -312,7 +352,7 @@ class InferenceEngine:
             executable_cache if executable_cache is not None else {})
         self._exec_key = (cfg, self.slots, self.page_size, num_pages,
                           max_pages_per_slot, self.decode_impl,
-                          self.kv_dtype)
+                          self.kv_dtype, lora_key)
         self.compile_counts: Dict[str, int] = {
             "prefill": 0, "prefill_cached": 0, "decode": 0,
             "verify": 0}
@@ -373,6 +413,120 @@ class InferenceEngine:
             kv_bytes_per_slot=self.cache.bytes_per_slot(
                 max_pages_per_slot))
 
+    # ---------------------------------------- multi-tenant LoRA (r25)
+    def _adapter_release(self, req: Request) -> None:
+        """Drop a retiring request's pin on its tenant (idempotent:
+        the slot resets so double-retire paths can't double-unpin)."""
+        if req.adapter_slot > 0 and self.adapters is not None:
+            self.adapters.unpin(req.model_id)
+        req.adapter_slot = 0
+
+    def _load_adapter(self, model_id: str,
+                      version: Optional[int] = None) -> Tuple[int, int]:
+        """Resolve ``model_id`` to a resident bank slot -> ``(slot,
+        installed version)``, loading through the adapter store on a
+        miss (or a version bump: ``version=None`` tracks the store's
+        latest, so a mid-traffic republish reloads in place).  The
+        install is an eager ``.at[].set`` over the bank call-arg —
+        compile counters never move.  Fault site ``serve.adapter_load``
+        fires on the load leg only (cache hits are unaffected) and
+        surfaces as the typed :class:`AdapterUnavailableError`."""
+        reg = self.adapters
+        ent = reg.lookup(model_id)
+        want = version
+        if want is None and self.adapter_store is not None:
+            want = self.adapter_store.latest_version(model_id)
+        if ent is not None and (want is None or ent[1] == want):
+            reg.touch(model_id)
+            reg.hits += 1
+            if self.telemetry.enabled:
+                self.telemetry.record_adapter_cache(hit=True)
+            return ent
+        reg.misses += 1
+        if self.telemetry.enabled:
+            self.telemetry.record_adapter_cache(hit=False)
+        from ray_tpu.util import chaos
+        try:
+            chaos.maybe_fail("serve.adapter_load")
+        except chaos.InjectedFault as fault:
+            raise AdapterUnavailableError(
+                model_id, f"load failed: {fault}") from fault
+        if self.adapter_store is None:
+            raise AdapterUnavailableError(
+                model_id, "not resident and the engine has no "
+                "adapter store to fetch through")
+        t0 = time.monotonic()
+        got, adapter, scale = self.adapter_store.checkout(model_id, want)
+        try:
+            slot, _evicted = reg.place(model_id, got)
+            self.lora_bank = lora_mod.bank_install(
+                self.lora_bank, slot, adapter, scale=scale)
+        finally:
+            self.adapter_store.checkin()
+        wall = time.monotonic() - t0
+        reg.loads += 1
+        reg.load_seconds += wall
+        if self.telemetry.enabled:
+            self.telemetry.record_adapter_load(
+                wall, resident=len(reg.resident_ids))
+        return slot, got
+
+    def load_adapter(self, model_id: str, adapter, *,
+                     scale: float = 1.0, version: int = 1) -> int:
+        """Install an adapter's host factors directly into the bank
+        (the storeless path: tests, a colocated learner).  Returns the
+        bank slot.  Requests referencing ``model_id`` resolve to it
+        without touching any store."""
+        if self.lora_cfg is None:
+            raise AdapterUnavailableError(
+                model_id, "engine built without adapter support "
+                "(RAY_TPU_LORA / lora=)")
+        slot, _evicted = self.adapters.place(model_id, int(version))
+        self.lora_bank = lora_mod.bank_install(
+            self.lora_bank, slot, adapter, scale=scale)
+        self.adapters.loads += 1
+        return slot
+
+    def _resolve_adapters(self, events: List["StepEvent"]) -> None:
+        """Give every waiting multi-tenant request a resident, pinned
+        bank slot before admission (step()-only, like every bank
+        mutation).  Resolution sets the prefix-chain salt — it MUST
+        land before ``_prefix_walk`` first hashes the prompt, so
+        adapter K/V never aliases base K/V.  A failed load retires the
+        request with the typed error — degraded, never hung."""
+        if self.lora_cfg is None:
+            return
+        failed: List[Request] = []
+        with self._lock:
+            for req in list(self.scheduler.waiting):
+                if req.adapter_slot != -1:
+                    continue
+                try:
+                    slot, got = self._load_adapter(
+                        req.model_id, req.adapter_version or None)
+                except AdapterUnavailableError as err:
+                    self.scheduler.waiting.remove(req)
+                    req.error = err
+                    req.done = True
+                    self._requests.pop(req.rid, None)
+                    failed.append(req)
+                    continue
+                req.adapter_slot = slot
+                req.adapter_version = got
+                req.hash_salt = salt_bytes(req.model_id, got)
+                self.adapters.pin(req.model_id)
+        for req in failed:
+            events.append(StepEvent(req.rid, -1, True, 0.0,
+                                    error=req.error))
+
+    def adapter_digest(self) -> frozenset:
+        """Resident tenant model_ids — the router composes this into
+        its affinity score beside the prefix digest."""
+        if self.adapters is None:
+            return frozenset()
+        with self._lock:
+            return self.adapters.digest()
+
     # --------------------------------------------------------- requests
     def _resolve_spec_k(self, sampling: SamplingParams) -> int:
         """The request's speculative draft budget (0 = plain decode):
@@ -415,6 +569,21 @@ class InferenceEngine:
         if len(prompt) > self.buckets[-1]:
             raise ValueError(f"prompt length {len(prompt)} exceeds the "
                              f"largest prefill bucket {self.buckets[-1]}")
+        # multi-tenant (r25): validate the tenant up front — a typed
+        # submit-time rejection the router can re-route — but defer the
+        # actual bank load to step() (``_resolve_adapters``), the only
+        # thread that may mutate the bank
+        model_id = sampling.model_id if sampling is not None else None
+        if model_id:
+            if self.lora_cfg is None:
+                raise AdapterUnavailableError(
+                    model_id, "engine built without adapter support "
+                    "(RAY_TPU_LORA / lora=)")
+            if (self.adapters.lookup(model_id) is None
+                    and (self.adapter_store is None
+                         or model_id not in self.adapter_store)):
+                raise AdapterUnavailableError(
+                    model_id, "never published to the adapter store")
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -431,7 +600,9 @@ class InferenceEngine:
                           hold_pages=bool(hold_pages),
                           spec_k=self._resolve_spec_k(
                               sampling or SamplingParams()),
-                          trace=trace_ctx)
+                          trace=trace_ctx,
+                          model_id=model_id or None,
+                          adapter_slot=-1 if model_id else 0)
             self.scheduler.submit(req)    # validates; may raise —
             self._requests[rid] = req     # register only if accepted
             depth = len(self.scheduler.waiting)
@@ -496,12 +667,14 @@ class InferenceEngine:
         handoff = kvc.KVHandoff(
             context=context, page_size=self.page_size,
             kv_dtype=self.kv_dtype, dtype=str(self.cache.k.dtype),
-            chain_hashes=kvc.PrefixIndex.chain_hashes(context,
-                                                      self.page_size),
+            chain_hashes=kvc.PrefixIndex.chain_hashes(
+                context, self.page_size, salt=req.hash_salt),
             next_token=int(req.generated[-1]),
             next_logprob=float(req.logprobs[-1]),
             trace=(req.trace.to_wire() if req.trace is not None
-                   else None), **arrays)
+                   else None),
+            model_id=req.model_id,
+            adapter_version=req.adapter_version, **arrays)
         self.scheduler.allocator.release(req.pages)
         req.pages = None
         self.exports += 1
@@ -560,6 +733,11 @@ class InferenceEngine:
                 f"context ({len(context)}) + remaining tokens "
                 f"({1 + max_new_tokens}) exceeds max_seq "
                 f"{self.cfg.max_seq}")
+        model_id = getattr(handoff, "model_id", None)
+        if model_id and self.lora_cfg is None:
+            raise AdapterUnavailableError(
+                model_id, "decode-side engine built without adapter "
+                "support (RAY_TPU_LORA / lora=)")
         trace_ctx = None
         if handoff.trace:
             # the trace context rode the payload across replicas:
@@ -584,7 +762,18 @@ class InferenceEngine:
                           import_payload=handoff,
                           spec_k=self._resolve_spec_k(
                               sampling or SamplingParams()),
-                          trace=trace_ctx)
+                          trace=trace_ctx,
+                          # the importer must decode under the EXACT
+                          # factors the prefill used: the version pins
+                          # the store fetch across republishes, and the
+                          # handoff's chain hashes are already salted
+                          model_id=model_id or None,
+                          adapter_slot=-1 if model_id else 0,
+                          adapter_version=getattr(
+                              handoff, "adapter_version", 0),
+                          hash_salt=salt_bytes(
+                              model_id, getattr(handoff,
+                                                "adapter_version", 0)))
             self.scheduler.submit(req)    # validates; may raise
             self._requests[rid] = req
             depth = len(self.scheduler.waiting)
@@ -603,11 +792,13 @@ class InferenceEngine:
                     sched.retire(slot)
                     self._requests.pop(req.rid, None)
                     self._drafts.pop(req.rid, None)
+                    self._adapter_release(req)
             for req in [r for r in sched.waiting
                         if r.rid in cancelled]:
                 sched.waiting.remove(req)
                 req.done = True
                 self._requests.pop(req.rid, None)
+                self._adapter_release(req)
 
     def _expire_deadlines(self, events: List["StepEvent"]) -> None:
         """Retire every request past its deadline, at the same safe
@@ -641,6 +832,7 @@ class InferenceEngine:
                 req.error = err
                 req.done = True
                 self._requests.pop(req.rid, None)
+                self._adapter_release(req)
                 expired.append(req)
             for slot, req in list(sched.active.items()):
                 err = expiry(req, False)
@@ -649,6 +841,7 @@ class InferenceEngine:
                     req.error = err
                     self._requests.pop(req.rid, None)
                     self._drafts.pop(req.rid, None)
+                    self._adapter_release(req)
                     expired.append(req)
         for req in expired:
             self.deadline_exceeded += 1
@@ -774,6 +967,15 @@ class InferenceEngine:
                 "store": (self.store.stats()
                           if self.store is not None else None),
             },
+            # multi-tenant LoRA (r25): registry residency/hit counters
+            # plus the shared store's publish/fetch accounting
+            "adapters": {
+                "enabled": self.lora_cfg is not None,
+                **(self.adapters.stats()
+                   if self.adapters is not None else {}),
+                "store": (self.adapter_store.stats()
+                          if self.adapter_store is not None else None),
+            },
         }
 
     # ------------------------------------------------------ engine tick
@@ -784,6 +986,7 @@ class InferenceEngine:
         events: List[StepEvent] = []
         self._process_cancels()
         self._expire_deadlines(events)
+        self._resolve_adapters(events)
         while True:
             with self._lock:
                 req = self.scheduler.try_admit()
@@ -899,8 +1102,13 @@ class InferenceEngine:
         t0 = time.monotonic()
         with tracing.span(f"infer/{kind}", rid=req.rid, bucket=bucket,
                           cached=cached):
-            args = (self.params, *self.cache.state, tokens, *scalars,
-                    sched.page_table[slot])
+            if self.lora_cfg is not None:
+                aid = np.array([max(req.adapter_slot, 0)], np.int32)
+                args = (self.params, self.lora_bank, *self.cache.state,
+                        tokens, *scalars, sched.page_table[slot], aid)
+            else:
+                args = (self.params, *self.cache.state, tokens,
+                        *scalars, sched.page_table[slot])
             fn = self._get_compiled((kind, bucket), build, args,
                                     kind=kind)
             logits, *state = fn(*args)
@@ -967,6 +1175,7 @@ class InferenceEngine:
             sched.retire(slot)
             req.error = kvc.HandoffContentMissing(req.rid, len(missing))
             self._requests.pop(req.rid, None)
+            self._adapter_release(req)
             events.append(StepEvent(req.rid, -1, True, 0.0,
                                     error=req.error))
             return
@@ -1125,6 +1334,16 @@ class InferenceEngine:
                     return False
         if self.store is not None and self.store.in_flight != 0:
             return False
+        if self.adapters is not None:
+            # every live pin must belong to a live multi-tenant
+            # request, and store checkouts must have been checked in
+            live = sum(1 for r in self._requests.values()
+                       if r.adapter_slot > 0)
+            if self.adapters.pinned_total != live:
+                return False
+        if (self.adapter_store is not None
+                and self.adapter_store.in_flight != 0):
+            return False
         return True
 
     # ----------------------------------------------------------- decode
@@ -1157,14 +1376,22 @@ class InferenceEngine:
             page_table[list(skip), :] = kvc.GARBAGE_PAGE
         t0 = time.monotonic()
         with tracing.span("infer/decode", active=len(active)):
-            fn = self._get_compiled(
-                ("decode",), self._build_decode,
-                (self.params, *self.cache.state, tokens,
-                 sched.lengths, page_table),
-                kind="decode")
-            logits, *state = fn(
-                self.params, *self.cache.state, tokens,
-                sched.lengths, page_table)
+            if self.lora_cfg is not None:
+                # per-slot adapter ids: co-batched tenants share this
+                # one tick (the bank gather routes each row through its
+                # own A/B factors; dead/base rows ride slot 0 identity)
+                aids = np.zeros((self.slots,), np.int32)
+                for slot, req in sched.active.items():
+                    if slot not in skip and req.adapter_slot > 0:
+                        aids[slot] = req.adapter_slot
+                args = (self.params, self.lora_bank, *self.cache.state,
+                        tokens, sched.lengths, page_table, aids)
+            else:
+                args = (self.params, *self.cache.state, tokens,
+                        sched.lengths, page_table)
+            fn = self._get_compiled(("decode",), self._build_decode,
+                                    args, kind="decode")
+            logits, *state = fn(*args)
             self.cache.state = tuple(state)
             sampled, logps = self._sample_slots(logits, reqs)
         wall = time.monotonic() - t0
@@ -1261,9 +1488,15 @@ class InferenceEngine:
         tokens[0, 1:1 + n_drafts] = drafts
         t0 = time.monotonic()
         with tracing.span("infer/verify", rid=req.rid, k=n_drafts):
-            args = (self.params, *self.cache.state, tokens,
-                    np.int32(L), np.int32(n_drafts + 1),
-                    sched.page_table[slot])
+            if self.lora_cfg is not None:
+                aid = np.array([max(req.adapter_slot, 0)], np.int32)
+                args = (self.params, self.lora_bank, *self.cache.state,
+                        tokens, np.int32(L), np.int32(n_drafts + 1),
+                        sched.page_table[slot], aid)
+            else:
+                args = (self.params, *self.cache.state, tokens,
+                        np.int32(L), np.int32(n_drafts + 1),
+                        sched.page_table[slot])
             fn = self._get_compiled(
                 ("verify", kb),
                 functools.partial(self._build_prefill_cached,
@@ -1331,6 +1564,10 @@ class InferenceEngine:
                 self._held[req.rid] = req
             else:
                 self.scheduler.retire(req.slot)
+            # the adapter unpins with the slot either way: a held
+            # export only needs pages — the importer re-pins the
+            # adapter on its own replica through the handoff metadata
+            self._adapter_release(req)
             if self.telemetry.enabled:
                 self.telemetry.record_request_done()
             self._drafts.pop(req.rid, None)
@@ -1389,7 +1626,8 @@ class InferenceEngine:
             x = x + (pe if positions.ndim == 2 else pe[None])
         return x
 
-    def _layer_scan(self, params, x, caches, positions, attn_hook):
+    def _layer_scan(self, params, x, caches, positions, attn_hook,
+                    lora_bank=None, lora_ids=None):
         """Run the layer stack with per-layer cache slices in the scan
         carry (dynamic-slice in / dynamic-update out, the donation-
         friendly pattern) -> (final normed hidden, caches).
@@ -1397,7 +1635,13 @@ class InferenceEngine:
         ``caches`` is the cache's state tuple of stacked ``[L, ...]``
         arrays — ``(k, v)`` or, quantized, ``(k, v, k_scale,
         v_scale)``; the per-layer slice tuple is opaque to
-        ``layer_apply`` and round-trips through ``attn_hook``."""
+        ``layer_apply`` and round-trips through ``attn_hook``.
+
+        ``lora_bank``/``lora_ids`` (r25 multi-tenant): bank factors are
+        stacked ``[N, L, ...]`` — layer axis 1 — sliced per scan step;
+        ``lora_ids`` [B] routes each batch row through its tenant's
+        slot (slot 0 is the all-zeros identity, so base rows cost one
+        fused-zero gather, never a branch)."""
         cfg = self.cfg
 
         def body(carry, i):
@@ -1406,12 +1650,19 @@ class InferenceEngine:
                 lambda a: lax.dynamic_index_in_dim(a, i, 0,
                                                    keepdims=False),
                 params["layers"])
+            lora = None
+            if lora_bank is not None:
+                lora = {k: lax.dynamic_index_in_dim(v, i, 1,
+                                                    keepdims=False)
+                        for k, v in lora_bank.items() if k != "scale"}
+                lora["scale"] = lora_bank["scale"]
+                lora["ids"] = lora_ids
             layer_cache = tuple(
                 lax.dynamic_index_in_dim(c, i, 0, keepdims=False)
                 for c in caches)
             x, _aux, layer_cache = gpt_mod.layer_apply(
                 lp, x, cfg, positions=positions, attn_fn=attn_hook,
-                cache=layer_cache)
+                cache=layer_cache, lora=lora)
             caches = tuple(
                 lax.dynamic_update_index_in_dim(c, nc, i, 0)
                 for c, nc in zip(caches, layer_cache))
@@ -1437,10 +1688,17 @@ class InferenceEngine:
         page_size = self.page_size
         quantized = self.kv_dtype == "int8"
 
+        lora_on = self.lora_cfg is not None
+
         def prefill(params, *args):
-            """(params, *cache_state, tokens [1, S_bucket], length
-            scalar (valid prefix), page_row [max_pages]) ->
-            (last-token logits [1, V] f32, *cache_state)."""
+            """(params, [lora_bank,] *cache_state, tokens [1,
+            S_bucket], length scalar (valid prefix), page_row
+            [max_pages][, adapter_ids [1]]) -> (last-token logits
+            [1, V] f32, *cache_state)."""
+            bank = aids = None
+            if lora_on:
+                bank, *args = args
+                *args, aids = args
             *cache_state, tokens, length, page_row = args
             S = tokens.shape[1]
             positions = jnp.arange(S)
@@ -1473,15 +1731,19 @@ class InferenceEngine:
             x = self._embed(params, tokens, positions)
             x, cache_state = self._layer_scan(params, x,
                                               tuple(cache_state),
-                                              positions, attn_hook)
+                                              positions, attn_hook,
+                                              lora_bank=bank,
+                                              lora_ids=aids)
             h = jnp.take(x[0], length - 1, axis=0)[None, None]  # [1,1,d]
             logits = jnp.einsum("bsd,dv->bsv", h,
                                 gpt_mod.lm_head(params, cfg))
             return (logits[:, 0].astype(jnp.float32),) + cache_state
 
         n_state = len(self.cache.state)
+        first = 2 if lora_on else 1      # cache state shifts past bank
         return jax.jit(prefill,
-                       donate_argnums=tuple(range(1, 1 + n_state)))
+                       donate_argnums=tuple(range(first,
+                                                  first + n_state)))
 
     def _prefill_attention(self, q, k, v):
         """Causal self-attention over the bucket (no cache read — the
@@ -1523,13 +1785,18 @@ class InferenceEngine:
         cfg = self.cfg
         page_size = self.page_size
         quantized = self.kv_dtype == "int8"
+        lora_on = self.lora_cfg is not None
 
         def prefill_cached(params, *args):
-            """(params, *cache_state, tokens [1, S_bucket] (suffix,
-            padded), cached_len scalar (prefix tokens already in
-            cache), suffix_len scalar (valid suffix), page_row
-            [max_pages]) -> (last-suffix-token logits [1, V] f32,
-            *cache_state)."""
+            """(params, [lora_bank,] *cache_state, tokens [1, S_bucket]
+            (suffix, padded), cached_len scalar (prefix tokens already
+            in cache), suffix_len scalar (valid suffix), page_row
+            [max_pages][, adapter_ids [1]]) -> (last-suffix-token
+            logits [1, V] f32, *cache_state)."""
+            bank = aids = None
+            if lora_on:
+                bank, *args = args
+                *args, aids = args
             *cache_state, tokens, cached_len, suffix_len, page_row = args
             S = tokens.shape[1]
             positions = cached_len + jnp.arange(S)   # absolute
@@ -1582,7 +1849,9 @@ class InferenceEngine:
             x = self._embed(params, tokens, positions)
             x, cache_state = self._layer_scan(params, x,
                                               tuple(cache_state),
-                                              positions, attn_hook)
+                                              positions, attn_hook,
+                                              lora_bank=bank,
+                                              lora_ids=aids)
             if all_rows:
                 logits = jnp.einsum("bsd,dv->bsv", x,
                                     gpt_mod.lm_head(params, cfg))
@@ -1593,21 +1862,28 @@ class InferenceEngine:
             return (logits[:, 0].astype(jnp.float32),) + cache_state
 
         n_state = len(self.cache.state)
+        first = 2 if lora_on else 1
         return jax.jit(prefill_cached,
-                       donate_argnums=tuple(range(1, 1 + n_state)))
+                       donate_argnums=tuple(range(first,
+                                                  first + n_state)))
 
     def _build_decode(self):
         cfg = self.cfg
         page_size = self.page_size
         impl = self.decode_impl
         quantized = self.kv_dtype == "int8"
+        lora_on = self.lora_cfg is not None
 
         def decode(params, *args):
-            """(params, *cache_state, tokens [slots] (each slot's next
-            input token), lengths [slots] (tokens already cached = the
-            new token's absolute position), page_table
-            [slots, max_pages]) -> (logits [slots, V] f32,
-            *cache_state)."""
+            """(params, [lora_bank,] *cache_state, tokens [slots] (each
+            slot's next input token), lengths [slots] (tokens already
+            cached = the new token's absolute position), page_table
+            [slots, max_pages][, adapter_ids [slots]]) -> (logits
+            [slots, V] f32, *cache_state)."""
+            bank = aids = None
+            if lora_on:
+                bank, *args = args
+                *args, aids = args
             *cache_state, tokens, lengths, page_table = args
             positions = lengths[:, None]                   # [B, 1]
 
@@ -1646,11 +1922,15 @@ class InferenceEngine:
             x = self._embed(params, tokens[:, None], positions)
             x, cache_state = self._layer_scan(params, x,
                                               tuple(cache_state),
-                                              positions, attn_hook)
+                                              positions, attn_hook,
+                                              lora_bank=bank,
+                                              lora_ids=aids)
             logits = jnp.einsum("bsd,dv->bsv", x,
                                 gpt_mod.lm_head(params, cfg))
             return (logits[:, 0].astype(jnp.float32),) + cache_state
 
         n_state = len(self.cache.state)
+        first = 2 if lora_on else 1
         return jax.jit(decode,
-                       donate_argnums=tuple(range(1, 1 + n_state)))
+                       donate_argnums=tuple(range(first,
+                                                  first + n_state)))

@@ -154,6 +154,15 @@ class KVHandoff:
     # wire form of the request's TraceContext (r24) — the trace rides
     # the payload, so importer-side spans join the exporter's tree
     trace: Optional[dict] = None
+    # multi-tenant serving (r25): the adapter the context was prefilled
+    # under (None = base).  ``adapter_version`` pins the exact store
+    # version — the decode side must attend under the same factors the
+    # prefill used, even across a mid-traffic republish; a decode
+    # replica lacking it fetches through the AdapterStore on import.
+    # The handoff's chain_hashes are already salted by (model_id,
+    # version), so prefix digests never alias tenants.
+    model_id: Optional[str] = None
+    adapter_version: int = 0
 
     @property
     def n_pages(self) -> int:
@@ -607,12 +616,19 @@ class PrefixIndex:
 
     @classmethod
     def chain_hashes(cls, tokens: Sequence[int],
-                     page_size: int) -> List[bytes]:
+                     page_size: int, salt: bytes = b"") -> List[bytes]:
         """Chained hashes of every *full* page of ``tokens`` — the one
         walk both the scheduler (registration/hit lookup) and the
         fleet router (affinity matching) must agree on byte-for-byte,
-        so it lives here."""
-        h = cls.ROOT
+        so it lives here.
+
+        ``salt`` overrides the chain root (r25 multi-tenant serving:
+        ``adapters.lora.salt_bytes(model_id, version)``).  Adapter K/V
+        differs from base K/V for identical token prefixes, so salted
+        chains keep tenants from ever aliasing in the prefix index or
+        the tiered store; base traffic keeps the unsalted root, so its
+        hashes — and every pre-r25 digest — are unchanged."""
+        h = salt or cls.ROOT
         out = []
         for i in range(len(tokens) // page_size):
             h = cls.chain(h, tokens[i * page_size:(i + 1) * page_size])

@@ -52,13 +52,22 @@ class SamplingParams:
     to ``spec_k`` drafted tokens per verify step.  Speculation never
     changes what is sampled — the verify rows run the SAME
     ``fold_in(seed, n_generated)`` key chain as plain decode, so the
-    knobs are pure throughput knobs."""
+    knobs are pure throughput knobs.
+
+    ``model_id`` (r25 multi-tenant serving) selects the LoRA adapter
+    this request decodes under (``None`` = the base model).  It rides
+    the per-request path like every other knob — serve payload ->
+    engine — where it resolves to a slot of the engine's adapter bank,
+    loaded through the fleet :class:`~ray_tpu.adapters.AdapterStore`
+    on miss; an unknown tenant surfaces the typed
+    :class:`~ray_tpu.adapters.AdapterUnavailableError`."""
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
     spec: Optional[bool] = None
     spec_k: Optional[int] = None
+    model_id: Optional[str] = None
 
 
 def _sample_one(logits, seed, count, temp, top_k, top_p):

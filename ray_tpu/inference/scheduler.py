@@ -151,6 +151,19 @@ class Request:
     # router/serve boundary, carried here so every lifecycle stage can
     # hang spans off the same trace_id
     trace: Optional[Any] = None
+    # multi-tenant serving (r25): the adapter this request decodes
+    # under (None = base).  ``adapter_slot`` is the engine's bank row:
+    # 0 = the identity slot, -1 = not yet resolved (the engine loads
+    # the adapter and pins it before this request's first admission
+    # attempt); ``adapter_version`` pins the store version (0 = latest,
+    # resolved in place).  ``hash_salt`` overrides the prefix-chain
+    # root so adapter K/V never aliases base K/V in the index/tiers —
+    # it MUST be set before the first ``_prefix_walk`` computes
+    # ``chain_hashes``.
+    model_id: Optional[str] = None
+    adapter_slot: int = 0
+    adapter_version: int = 0
+    hash_salt: bytes = b""
 
 
 class SlotScheduler:
@@ -225,7 +238,7 @@ class SlotScheduler:
             return []
         if req.chain_hashes is None:
             req.chain_hashes = PrefixIndex.chain_hashes(
-                req.prompt, self.page_size)
+                req.prompt, self.page_size, salt=req.hash_salt)
         hits: List[int] = []
         # an imported request (r20 disagg) never prefills: EVERY full
         # context page is hit-eligible, including the one holding the

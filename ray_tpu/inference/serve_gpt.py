@@ -84,7 +84,10 @@ def parse_request(request: Dict[str, Any]) -> Dict[str, Any]:
             # request on or off — a pure throughput knob, outputs are
             # distribution-exact either way
             spec=None if spec is None else bool(spec),
-            spec_k=None if spec_k is None else int(spec_k)),
+            spec_k=None if spec_k is None else int(spec_k),
+            # multi-tenant (r25): which LoRA adapter this request
+            # decodes under; absent/None = the base model
+            model_id=request.get("model_id")),
         "want_logprobs": bool(request.get("logprobs", False)),
         "eos_token": request.get("eos_token"),
         "ttft_deadline_s": request.get("ttft_deadline_s"),
@@ -121,7 +124,8 @@ class GPTDeployment:
     int, "temperature": float, "top_k": int, "top_p": float, "seed":
     int, "eos_token": int | None, "logprobs": bool,
     "ttft_deadline_s": float | None, "deadline_s": float | None,
-    "speculation": bool | None, "speculation_k": int | None}`` —
+    "speculation": bool | None, "speculation_k": int | None,
+    "model_id": str | None}`` —
     yields generated token ids; with ``"logprobs": True`` each item is
     ``{"token": int, "logprob": float}`` instead (the sampled token's
     model logprob — ``log_softmax`` of the raw logits, parity-tested

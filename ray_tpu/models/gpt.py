@@ -243,12 +243,56 @@ def _rope(x, positions, theta: float):
     return rope_rotate(x, positions, theta)
 
 
-def _dense_ffn(lp, x, cfg: GPTConfig):
+def lora_delta(lora, name: str, x):
+    """Low-rank delta ``scale * (x @ A) @ B`` for one target matmul,
+    or None when the adapter tree carries no factors for ``name``.
+
+    Two modes, dispatched on the presence of ``ids``:
+
+    - **single adapter** (training): ``<name>_a`` [in, r] /
+      ``<name>_b`` [r, out] shared across the batch, scalar ``scale``
+      — the trainable-adapter path in ``models/training.py``.
+    - **banked** (serving): factors carry a leading bank axis
+      ([N, in, r] / [N, r, out], ``scale`` [N]) and ``ids`` [B] picks
+      one bank slot per batch row — the grouped matmul that lets
+      co-batched tenants share a single decode tick.  Slot 0 is
+      all-zeros, so base traffic pays two skinny einsums against zero
+      factors and lands on the exact base output.
+
+    Rank-space accumulation runs in the activation dtype (matching the
+    base matmuls); the f32 per-slot scale is applied last."""
+    a = lora.get(name + "_a")
+    if a is None:
+        return None
+    b = lora[name + "_b"]
+    scale = jnp.asarray(lora["scale"], jnp.float32)
+    ids = lora.get("ids")
+    if ids is None:
+        t = jnp.einsum("bsi,ir->bsr", x, a.astype(x.dtype))
+        d = jnp.einsum("bsr,ro->bso", t, b.astype(x.dtype))
+        return (d.astype(jnp.float32) * scale).astype(x.dtype)
+    av = jnp.take(a, ids, axis=0)
+    bv = jnp.take(b, ids, axis=0)
+    s = jnp.take(scale, ids, axis=0)
+    t = jnp.einsum("bsi,bir->bsr", x, av.astype(x.dtype))
+    d = jnp.einsum("bsr,bro->bso", t, bv.astype(x.dtype))
+    return (d.astype(jnp.float32) * s[:, None, None]).astype(x.dtype)
+
+
+def _dense_ffn(lp, x, cfg: GPTConfig, lora=None):
     h = jnp.einsum("bsd,df->bsf", x, lp["w1"])
+    if lora is not None:
+        d1 = lora_delta(lora, "w1", x)
+        if d1 is not None:
+            h = h + d1
     if "b1" in lp:
         h = h + lp["b1"]
     if cfg.act == "swiglu":
         g = jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        if lora is not None:
+            d3 = lora_delta(lora, "w3", x)
+            if d3 is not None:
+                g = g + d3
         if "b3" in lp:
             g = g + lp["b3"]
         h = jax.nn.silu(h) * g
@@ -256,6 +300,10 @@ def _dense_ffn(lp, x, cfg: GPTConfig):
         h = jax.nn.gelu(h)
     h = shd.constrain(h, ("batch", "seq", "mlp"))
     out = jnp.einsum("bsf,fd->bsd", h, lp["w2"])
+    if lora is not None:
+        d2 = lora_delta(lora, "w2", h)
+        if d2 is not None:
+            out = out + d2
     if "b2" in lp:
         out = out + lp["b2"]
     return out
@@ -285,7 +333,7 @@ def _moe_ffn(lp, x, cfg: GPTConfig):
 
 
 def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
-                cache=None, fuse_norm=None):
+                cache=None, fuse_norm=None, lora=None):
     """One transformer block: ``(layer params, hidden [B,S,d]) -> (hidden,
     moe aux)``.  Shared by the stacked ``lax.scan`` in ``forward_hidden``,
     the per-stage scan in the pipeline-parallel trainer
@@ -307,7 +355,14 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
     ``RAY_TPU_FUSE_NORM``.  The dispatch gate
     (``fused_norm.out_proj_norm_plan``) declines layernorm, biases,
     sharded meshes and the S=1 decode step — those keep the XLA
-    einsum + ``_norm`` path unchanged."""
+    einsum + ``_norm`` path unchanged.
+
+    ``lora``: per-layer low-rank adapter factors (``lora_delta``
+    layout, single or banked) added to the qkv/out-proj/MLP matmul
+    outputs before biases and RoPE — so the result equals running the
+    merged weights ``W + scale * A @ B`` through the base block.  An
+    active ``lora`` declines the fused out-proj epilogue (the kernel
+    folds the wo matmul, which would skip the wo delta)."""
     from ray_tpu.ops import fused_norm as fnorm
     constrain = functools.partial(shd.constrain, mesh=mesh)
     eps = norm_eps(cfg)
@@ -320,6 +375,16 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
         q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
         k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
         v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        if lora is not None:
+            dq = lora_delta(lora, "wq", h)
+            dk = lora_delta(lora, "wk", h)
+            dv = lora_delta(lora, "wv", h)
+            if dq is not None:
+                q = q + dq.reshape(q.shape)
+            if dk is not None:
+                k = k + dk.reshape(k.shape)
+            if dv is not None:
+                v = v + dv.reshape(v.shape)
         if "bq" in lp:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -346,7 +411,7 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
         attn = constrain(attn, ("batch", "seq", "heads", None))
         B, S, Hn, hd = attn.shape
         d = x.shape[-1]
-        plan = fnorm.out_proj_norm_plan(
+        plan = None if lora is not None else fnorm.out_proj_norm_plan(
             B * S, Hn * hd, d, norm=cfg.norm,
             has_bias=("bo" in lp) or ("ln2_b" in lp),
             n_devices=getattr(mesh, "size", 1) if mesh is not None else 1,
@@ -363,6 +428,10 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
             h2 = y2.reshape(B, S, d)
         else:
             proj = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+            if lora is not None:
+                do = lora_delta(lora, "wo", attn.reshape(B, S, Hn * hd))
+                if do is not None:
+                    proj = proj + do
             if "bo" in lp:
                 proj = proj + lp["bo"]
             x = x + proj
@@ -371,9 +440,12 @@ def layer_apply(lp, x, cfg: GPTConfig, *, positions, attn_fn, mesh=None,
             h2 = _norm(x, lp["ln2"], cfg.norm, bias=lp.get("ln2_b"),
                        eps=eps)
         if cfg.n_experts > 0:
+            if lora is not None:
+                raise ValueError("LoRA adapters are dense-FFN only "
+                                 "(see adapters.lora.effective_targets)")
             ffn_out, aux = _moe_ffn(lp, h2, cfg)
         else:
-            ffn_out, aux = _dense_ffn(lp, h2, cfg), jnp.float32(0)
+            ffn_out, aux = _dense_ffn(lp, h2, cfg, lora=lora), jnp.float32(0)
         x = x + ffn_out
         x = constrain(x, ("batch", "seq", None))
     if cache is not None:
@@ -468,7 +540,7 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
                    attn_fn: Optional[Callable] = None, mesh=None,
                    fuse_norm: Optional[bool] = None,
                    final_norm: bool = True,
-                   segment_ids=None, positions=None):
+                   segment_ids=None, positions=None, lora=None):
     """tokens [B, S] int32 -> (final hidden [B, S, d], moe aux loss).
 
     ``attn_fn(q, k, v) -> out`` defaults to causal local attention; pass a
@@ -483,6 +555,11 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     (``ray_tpu.data.SamplePacker``): attention masks block-diagonally
     per segment and RoPE/learned positions restart at every document
     start, so the packed forward equals the per-document unpacked one.
+
+    ``lora``: a single adapter's stacked factors ([L, in, r]/[L, r, out]
+    per target, + scalar ``scale``) applied to every adapted matmul —
+    the trainable-adapter forward used by
+    ``models/training.py`` when the base params are frozen.
     """
     B, S = tokens.shape
     if attn_fn is None:
@@ -502,10 +579,18 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
     if positions is None:
         positions = jnp.arange(S)
 
-    def layer_body(x, lp):
+    # the adapter's stacked factors scan alongside params["layers"]
+    # (both carry leading L); the scalar scale broadcasts unscanned
+    lora_scan = None
+    if lora is not None:
+        lora_scan = {k: v for k, v in lora.items() if k != "scale"}
+
+    def layer_body(x, lp_la):
+        lp, la = lp_la
+        layer_lora = None if la is None else {**la, "scale": lora["scale"]}
         return layer_apply(lp, x, cfg, positions=positions,
                            attn_fn=attn_fn, mesh=mesh,
-                           fuse_norm=fuse_norm)
+                           fuse_norm=fuse_norm, lora=layer_lora)
 
     if cfg.remat:
         layer_body = jax.checkpoint(layer_body)
@@ -513,11 +598,13 @@ def forward_hidden(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
         aux_total = jnp.float32(0)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[i], params["layers"])
-            x, aux = layer_body(x, lp)
+            la = None if lora_scan is None else \
+                jax.tree.map(lambda a: a[i], lora_scan)
+            x, aux = layer_body(x, (lp, la))
             aux_total = aux_total + aux
     else:
-        x, auxes = lax.scan(lambda c, lp: layer_body(c, lp), x,
-                            params["layers"])
+        x, auxes = lax.scan(layer_body, x,
+                            (params["layers"], lora_scan))
         aux_total = jnp.sum(auxes)
     if final_norm:
         x = _norm(x, params["ln_f"], cfg.norm,
@@ -533,13 +620,13 @@ def lm_head(params, cfg: GPTConfig):
 def forward(params: Dict[str, Any], tokens, cfg: GPTConfig, *,
             attn_fn: Optional[Callable] = None, mesh=None,
             fuse_norm: Optional[bool] = None,
-            segment_ids=None, positions=None):
+            segment_ids=None, positions=None, lora=None):
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
     constrain = functools.partial(shd.constrain, mesh=mesh)
     x, aux = forward_hidden(params, tokens, cfg, attn_fn=attn_fn,
                             mesh=mesh, fuse_norm=fuse_norm,
                             segment_ids=segment_ids,
-                            positions=positions)
+                            positions=positions, lora=lora)
     logits = jnp.einsum("bsd,dv->bsv", x, lm_head(params, cfg))
     logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits.astype(jnp.float32), aux
@@ -613,7 +700,7 @@ def _chunked_ce(x, head, targets, *, chunk: int = _CE_CHUNK, mesh=None,
 
 def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
             aux_weight: float = 0.01, ce_mode: Optional[str] = None,
-            fuse_norm: Optional[bool] = None):
+            fuse_norm: Optional[bool] = None, lora=None):
     """batch: dict(tokens [B,S], targets [B,S]); returns scalar loss.
 
     ``fuse_norm`` pins the fused norm epilogues (default:
@@ -637,7 +724,7 @@ def loss_fn(params, batch, cfg: GPTConfig, *, attn_fn=None, mesh=None,
                             mesh=mesh, fuse_norm=fuse_norm,
                             final_norm=not ce_norm,
                             segment_ids=batch.get("segment_ids"),
-                            positions=batch.get("positions"))
+                            positions=batch.get("positions"), lora=lora)
     loss = loss_from_hidden(
         params, x, batch["targets"], cfg, mesh=mesh, ce_mode=ce_mode,
         norm_scale=params["ln_f"] if ce_norm else None)

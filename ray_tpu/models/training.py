@@ -160,6 +160,45 @@ def _maybe_instrument(fns: Dict[str, Callable], cfg, mesh, *,
                               label=label, config=config)
 
 
+def _resolve_lora(lora, base_params):
+    """``lora=`` kwarg -> effective LoraConfig (None when off), with
+    the base-params requirement enforced up front: adapter-only
+    training differentiates *through* a frozen base, so there must be
+    one to freeze."""
+    if not lora:
+        return None
+    from ray_tpu.adapters import LoraConfig, lora_config
+    lcfg = lora if isinstance(lora, LoraConfig) else lora_config()
+    if base_params is None:
+        raise ValueError(
+            "trainable-adapter mode (lora=...) needs base_params — the "
+            "frozen base weights the adapter is trained against (e.g. "
+            "gpt.init_params(...) or a served checkpoint)")
+    return lcfg
+
+
+def _adapter_fns(cfg, lcfg, base_params, mesh, base_sh):
+    """The trainable-adapter plumbing shared by both builders:
+    -> (sharded frozen base, replicated adapter param shardings,
+    init(key) -> adapter tree, lora_tree(adapter) -> forward kwarg)."""
+    from ray_tpu.adapters import lora as lora_mod
+    base = jax.device_put(base_params, base_sh)
+    replicated = NamedSharding(mesh, P())
+    adapter_shapes = jax.eval_shape(
+        lambda k: lora_mod.init_adapter(cfg, lcfg, k),
+        jax.random.PRNGKey(0))
+    param_sh = jax.tree.map(lambda _: replicated, adapter_shapes)
+    scale = jnp.asarray(lcfg.scale, jnp.float32)
+
+    def init_adapter(key):
+        return lora_mod.init_adapter(cfg, lcfg, key)
+
+    def lora_tree(adapter):
+        return {**adapter, "scale": scale}
+
+    return base, param_sh, init_adapter, lora_tree
+
+
 def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     optimizer=None,
                     sp_impl: str = "ring",
@@ -169,7 +208,9 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                     comm_quant: Optional[str] = None,
                     fuse_norm: Optional[bool] = None,
                     accum_steps: Optional[int] = None,
-                    telemetry: Optional[bool] = None) -> Dict[str, Callable]:
+                    telemetry: Optional[bool] = None,
+                    lora=None,
+                    base_params=None) -> Dict[str, Callable]:
     """Returns dict(init_fn, step_fn, loss_eval_fn, shardings).
 
     init_fn(key) -> TrainState (sharded); step_fn(state, batch) ->
@@ -223,6 +264,19 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     ``RAY_TPU_TELEMETRY``) wraps ``step_fn`` with a per-step
     :class:`ray_tpu.telemetry.StepTelemetry` recorder — the returned
     dict then also carries ``telemetry`` and ``raw_step_fn``.
+
+    ``lora`` (a :class:`ray_tpu.adapters.LoraConfig`, or ``True`` for
+    the env-resolved one) switches the builder to **trainable-adapter
+    mode** (r25): ``TrainState.params`` becomes the LoRA A/B factor
+    tree only, the frozen ``base_params`` (required) is closed over as
+    a jit constant, and gradients flow exclusively through the
+    adapters — the optimizer state, donation, checkpoints and
+    ``publish`` payloads all shrink to adapter size
+    (``adapters.adapter_nbytes``).  ``init_fn`` uses the standard LoRA
+    init (A gaussian, B zero), so step 0 is exactly the base model.
+    The overlap schedule has no adapter formulation and declines
+    loudly to gspmd; the returned dict carries the effective config as
+    ``fns["lora"]`` (``None`` when off).
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
     from ray_tpu.parallel import overlap as ovl
@@ -234,13 +288,21 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps} "
                          "(check RAY_TPU_ACCUM)")
+    lcfg = _resolve_lora(lora, base_params)
     if comm_mode is None:
         comm_mode = ovl.comm_config().mode
     if comm_mode not in ("gspmd", "overlap"):
         raise ValueError(f"unknown comm_mode {comm_mode!r}; "
                          "expected 'gspmd' or 'overlap'")
     if comm_mode == "overlap":
-        if getattr(mesh, "size", 1) <= 1:
+        if lcfg is not None:
+            import sys
+            print("comm_mode=overlap has no trainable-adapter "
+                  "formulation (the shard_map schedule gathers base "
+                  "weights per block); falling back to gspmd",
+                  file=sys.stderr)
+            comm_mode = "gspmd"
+        elif getattr(mesh, "size", 1) <= 1:
             comm_mode = "gspmd"   # single device: nothing to schedule
         elif accum_steps > 1:
             import sys
@@ -269,6 +331,10 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         comm_quant = "none"
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
+    base = init_adapter = lora_tree = None
+    if lcfg is not None:
+        base, param_sh, init_adapter, lora_tree = _adapter_fns(
+            cfg, lcfg, base_params, mesh, param_sh)
     if mesh.shape.get("sp", 1) > 1:
         if sp_impl == "ulysses":
             from ray_tpu.parallel.ulysses import make_ulysses_attention_fn
@@ -296,6 +362,11 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                 "supported by sequence-parallel attention (sp>1) yet "
                 "— stream unpacked (RAY_TPU_DATA_PACK=0) or use an "
                 "sp=1 mesh")
+        if lcfg is not None:
+            return gpt_mod.loss_fn(base, batch, cfg, attn_fn=attn_fn,
+                                   mesh=mesh, ce_mode=ce_mode,
+                                   fuse_norm=fuse_norm,
+                                   lora=lora_tree(params))
         return gpt_mod.loss_fn(params, batch, cfg, attn_fn=attn_fn,
                                mesh=mesh, ce_mode=ce_mode,
                                fuse_norm=fuse_norm)
@@ -317,7 +388,8 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         return jax.value_and_grad(loss)(params, batch)
 
     def init(key) -> TrainState:
-        params = gpt_mod.init_params(cfg, key)
+        params = init_adapter(key) if lcfg is not None \
+            else gpt_mod.init_params(cfg, key)
         return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
 
     st_sh = _state_shardings(init, param_sh, mesh)
@@ -349,6 +421,12 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     @functools.partial(jax.jit, in_shardings=(st_sh.params, batch_sh),
                        out_shardings=None)
     def forward_logits(params, batch):
+        if lcfg is not None:
+            logits, _ = gpt_mod.forward(base, batch["tokens"], cfg,
+                                        attn_fn=attn_fn, mesh=mesh,
+                                        fuse_norm=fuse_norm,
+                                        lora=lora_tree(params))
+            return logits
         logits, _ = gpt_mod.forward(params, batch["tokens"], cfg,
                                     attn_fn=attn_fn, mesh=mesh,
                                     fuse_norm=fuse_norm)
@@ -365,6 +443,7 @@ def build_gpt_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "comm_mode": comm_mode,
         "comm_quant": comm_quant,
         "accum_steps": accum_steps,
+        "lora": lcfg,
     }
     return _maybe_instrument(fns, cfg, mesh, comm_mode=comm_mode,
                              comm_quant=comm_quant,
@@ -399,7 +478,9 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
                        optimizer=None,
                        baseline: str = "rloo",
                        attn_pack2: Optional[bool] = None,
-                       accum_steps: int = 1
+                       accum_steps: int = 1,
+                       lora=None,
+                       base_params=None
                        ) -> Dict[str, Callable]:
     """Policy-gradient (REINFORCE/RLOO) step builder for the GPT family
     — the learner half of the ``ray_tpu.rl`` actor/learner split,
@@ -445,6 +526,14 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     the accumulated step is the same policy gradient to reduction
     order: the score-function loss is a plain sum over trajectories
     and decomposes exactly across microbatches.
+
+    ``lora``/``base_params`` switch to trainable-adapter mode exactly
+    as in :func:`build_gpt_train`: the TrainState carries only LoRA
+    A/B factors, the frozen base is a jit constant, and
+    ``params_host()`` snapshots — the RL *publish* payload — shrink
+    from full-model to adapter bytes, which is what makes per-tenant
+    RL publication through the :class:`~ray_tpu.adapters.AdapterStore`
+    cheap enough to do every few steps.
     """
     from ray_tpu.ops.attention import make_flash_attention_fn
 
@@ -456,8 +545,13 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     # an RL run's first (often only) handful of steps would be no-ops
     tx = optimizer or optax.chain(optax.clip_by_global_norm(1.0),
                                   optax.adam(3e-4))
+    lcfg = _resolve_lora(lora, base_params)
     logical = gpt_mod.param_logical_axes(cfg)
     param_sh = shd.tree_shardings(mesh, logical)
+    base = init_adapter = lora_tree = None
+    if lcfg is not None:
+        base, param_sh, init_adapter, lora_tree = _adapter_fns(
+            cfg, lcfg, base_params, mesh, param_sh)
     if mesh.shape.get("sp", 1) > 1:
         attn_fn = make_ring_attention_fn(mesh, causal=True)
     else:
@@ -470,11 +564,17 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
     batch_sh = {"tokens": seq_sh, "targets": seq_sh,
                 "rewards": traj_sh}
 
+    def policy_forward(p, tokens):
+        if lcfg is not None:
+            return gpt_mod.forward(base, tokens, cfg, attn_fn=attn_fn,
+                                   mesh=mesh, lora=lora_tree(p))
+        return gpt_mod.forward(p, tokens, cfg, attn_fn=attn_fn,
+                               mesh=mesh)
+
     def pg_loss(params, batch):
         tokens, targets = batch["tokens"], batch["targets"]
         B, S = tokens.shape
-        logits, _aux = gpt_mod.forward(params, tokens, cfg,
-                                       attn_fn=attn_fn, mesh=mesh)
+        logits, _aux = policy_forward(params, tokens)
         logp = jax.nn.log_softmax(logits, axis=-1)      # [B, S, V] f32
         chosen = jnp.take_along_axis(
             logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
@@ -509,8 +609,7 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
 
         def micro_loss(p, mb):
             tokens, targets = mb["tokens"], mb["targets"]
-            logits, _aux = gpt_mod.forward(p, tokens, cfg,
-                                           attn_fn=attn_fn, mesh=mesh)
+            logits, _aux = policy_forward(p, tokens)
             logp = jax.nn.log_softmax(logits, axis=-1)
             chosen = jnp.take_along_axis(
                 logp, jnp.maximum(targets, 0)[..., None],
@@ -558,7 +657,8 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         return jax.value_and_grad(pg_loss, has_aux=True)(params, batch)
 
     def init(key) -> TrainState:
-        params = gpt_mod.init_params(cfg, key)
+        params = init_adapter(key) if lcfg is not None \
+            else gpt_mod.init_params(cfg, key)
         return TrainState(params, tx.init(params),
                           jnp.zeros((), jnp.int32))
 
@@ -606,6 +706,7 @@ def build_gpt_rl_train(cfg: "gpt_mod.GPTConfig", mesh, *,
         "attn_fn": attn_fn,
         "baseline": baseline,
         "accum_steps": accum_steps,
+        "lora": lcfg,
     }
 
 

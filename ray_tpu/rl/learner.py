@@ -25,11 +25,22 @@ import numpy as np
 @dataclasses.dataclass
 class RLLearnerConfig:
     """LearnerGroup-side config for :class:`GPTPolicyLearner` (the
-    pickle-friendly counterpart of the driver's knobs)."""
+    pickle-friendly counterpart of the driver's knobs).
+
+    ``lora=True`` hosts **adapter-only** learners (r25): params are
+    the LoRA A/B tree, the frozen base is derived deterministically
+    from ``base_seed`` inside every learner actor (the pickle-friendly
+    stand-in for a shared checkpoint restore — all ranks freeze the
+    identical base by construction), and ``publish_params`` snapshots
+    shrink to adapter bytes."""
     lr: float = 1e-3
     grad_clip: float = 1.0
     baseline: str = "rloo"
     seed: int = 0
+    lora: bool = False
+    lora_rank: int = 8
+    lora_scale: float = 1.0
+    base_seed: int = 0
 
 
 def _rl_optimizer(lr: float, grad_clip: float):
@@ -49,7 +60,8 @@ class InProcessLearner:
 
     def __init__(self, cfg, *, mesh=None, baseline: str = "rloo",
                  lr: float = 1e-3, grad_clip: float = 1.0,
-                 optimizer=None, seed: int = 0, fns=None):
+                 optimizer=None, seed: int = 0, fns=None,
+                 lora=None, base_params=None):
         import jax
 
         from ray_tpu.models import training
@@ -64,7 +76,8 @@ class InProcessLearner:
         # are baked into it, so they are ignored when it is passed
         self.fns = fns or training.build_gpt_rl_train(
             cfg, mesh, baseline=baseline,
-            optimizer=optimizer or _rl_optimizer(lr, grad_clip))
+            optimizer=optimizer or _rl_optimizer(lr, grad_clip),
+            lora=lora, base_params=base_params)
         self.state = self.fns["init_fn"](jax.random.PRNGKey(seed))
         self.steps = 0
 
@@ -80,6 +93,23 @@ class InProcessLearner:
         copies in (the device TrainState stays resident here)."""
         import jax
         return jax.tree.map(np.asarray, self.state.params)
+
+    def publish_adapter(self, store, model_id: str, *,
+                        scale: Optional[float] = None) -> int:
+        """Adapter-only publication (r25): put the current A/B snapshot
+        into an :class:`~ray_tpu.adapters.AdapterStore` under
+        ``model_id`` and return the new version.  Bytes on the wire =
+        ``adapters.adapter_nbytes`` — rank-sized, not model-sized —
+        which is what lets per-tenant RL republish mid-traffic; serving
+        engines pick the version up through their adapter cache without
+        a single recompile (the bank is a call arg)."""
+        lcfg = self.fns.get("lora")
+        if lcfg is None:
+            raise ValueError(
+                "learner was not built with lora=...; its params are "
+                "full model weights — publish those through WeightStore")
+        return store.put(model_id, self.params_host(),
+                         scale=lcfg.scale if scale is None else scale)
 
     def state_host(self):
         """The *checkpoint* form: the full host TrainState (params +
@@ -115,15 +145,26 @@ class GPTPolicyLearner:
     def __init__(self, module, config: RLLearnerConfig):
         import jax
 
+        from ray_tpu.models import gpt as gpt_mod
         from ray_tpu.models import training
         from ray_tpu.parallel.mesh import make_mesh
         self.cfg = module                     # a pickled GPTConfig
         self.config = config
         mesh = make_mesh(dp=1, devices=jax.devices()[:1])
         self.tx = _rl_optimizer(config.lr, config.grad_clip)
+        lora = base = None
+        if config.lora:
+            from ray_tpu.adapters import LoraConfig
+            lora = LoraConfig(enabled=True, rank=config.lora_rank,
+                              scale=config.lora_scale)
+            # every learner derives the identical frozen base from the
+            # shared seed — the DDP invariant (identical steps on
+            # identical state) then holds for the adapter params too
+            base = gpt_mod.init_params(
+                self.cfg, jax.random.PRNGKey(config.base_seed))
         self.fns = training.build_gpt_rl_train(
             self.cfg, mesh, baseline=config.baseline,
-            optimizer=self.tx)
+            optimizer=self.tx, lora=lora, base_params=base)
         self._steps = 0
 
     def init_state(self, key):
@@ -165,13 +206,19 @@ class LearnerGroupAdapter:
 
     def __init__(self, cfg, *, num_learners: int = 1,
                  baseline: str = "rloo", lr: float = 1e-3,
-                 grad_clip: float = 1.0, seed: int = 0):
+                 grad_clip: float = 1.0, seed: int = 0,
+                 lora: bool = False, lora_rank: int = 8,
+                 lora_scale: float = 1.0, base_seed: int = 0):
         from ray_tpu.rllib.core.learner_group import LearnerGroup
         self.baseline = baseline
+        self.lora_scale = lora_scale if lora else None
         self.group = LearnerGroup(
             module=cfg,
             config=RLLearnerConfig(lr=lr, grad_clip=grad_clip,
-                                   baseline="none", seed=seed),
+                                   baseline="none", seed=seed,
+                                   lora=bool(lora), lora_rank=lora_rank,
+                                   lora_scale=lora_scale,
+                                   base_seed=base_seed),
             num_learners=num_learners,
             learner_cls="ray_tpu.rl.learner.GPTPolicyLearner")
         self.steps = 0
@@ -199,6 +246,22 @@ class LearnerGroupAdapter:
         """(version, ObjectRef) from the group — the object-store
         publication path."""
         return self.group.publish_params()
+
+    def publish_adapter(self, store, model_id: str) -> int:
+        """Adapter-only publication through the group's object-store
+        snapshot: ``publish_params`` hands over the rank-0 params
+        ObjectRef (which in lora mode IS the adapter tree) and the
+        :class:`~ray_tpu.adapters.AdapterStore` shelves it under
+        ``(model_id, version)`` without a driver round-trip.  The
+        group's monotonic version is pinned as the store version, so
+        rollout engines and the store agree on what "latest" means."""
+        if self.lora_scale is None:
+            raise ValueError(
+                "group was not built with lora=True; its params are "
+                "full model weights — publish via publish_ref()")
+        version, ref = self.group.publish_params()
+        return store.put(model_id, ref, scale=self.lora_scale,
+                         version=version)
 
     def stop(self):
         self.group.stop()

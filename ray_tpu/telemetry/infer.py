@@ -77,6 +77,13 @@ class InferTelemetry:
         self.kv_fetches = 0
         self.kv_fetch_seconds = 0.0
         self.kv_store_evictions = 0
+        # multi-tenant LoRA (r25): per-replica adapter-cache outcomes
+        # and load latency — the hit rate is what the router's
+        # adapter-affinity scoring is supposed to move
+        self.adapter_cache_hits = 0
+        self.adapter_cache_misses = 0
+        self.adapter_loads = 0
+        self.adapter_load_seconds = 0.0
         self.cache_info: Dict[str, Any] = {}
         self._metrics = None
         self._metrics_dead = False
@@ -224,6 +231,31 @@ class InferTelemetry:
         self.kv_store_evictions += n
         self._emit_store_evictions(n)
 
+    def record_adapter_cache(self, *, hit: bool) -> None:
+        """One adapter-resolution outcome: ``hit`` means the tenant's
+        factors were already resident in the engine's bank (zero-cost
+        resolution); a miss pays a store fetch + bank install before
+        the request can admit."""
+        if not self.enabled:
+            return
+        if hit:
+            self.adapter_cache_hits += 1
+        else:
+            self.adapter_cache_misses += 1
+        self._emit_adapter_cache(hit)
+
+    def record_adapter_load(self, wall_s: float, *,
+                            resident: int) -> None:
+        """One adapter fetched from the store and installed into the
+        bank (``wall_s`` = checkout + host ``.at[].set``), plus the
+        resident-tenant count after the install (the gauge operators
+        watch against ``RAY_TPU_ADAPTER_CACHE``)."""
+        if not self.enabled:
+            return
+        self.adapter_loads += 1
+        self.adapter_load_seconds += wall_s
+        self._emit_adapter_load(wall_s, resident)
+
     def record_tier_occupancy(self, *, hbm: int, dram: int,
                               store: int) -> None:
         """Per-tick tier occupancy gauges (pages resident per tier),
@@ -277,6 +309,15 @@ class InferTelemetry:
         if self.prompt_tokens:
             out["prefix_hit_rate"] = (self.prefix_hit_tokens
                                       / self.prompt_tokens)
+        if self.adapter_cache_hits or self.adapter_cache_misses:
+            looked = self.adapter_cache_hits + self.adapter_cache_misses
+            out["adapters"] = {
+                "cache_hits": self.adapter_cache_hits,
+                "cache_misses": self.adapter_cache_misses,
+                "cache_hit_rate": self.adapter_cache_hits / looked,
+                "loads": self.adapter_loads,
+                "load_seconds": self.adapter_load_seconds,
+            }
         if self.tier_hits or self.kv_fetches or self.kv_spill_bytes:
             out["tiers"] = {
                 "hits": dict(self.tier_hits),
@@ -391,6 +432,22 @@ class InferTelemetry:
                     "entries LRU-evicted from the capped fleet "
                     "KV page store",
                     tag_keys=tags),
+                "adapter_hits": Counter(
+                    "serve_adapter_cache_hits_total",
+                    "adapter resolutions served from the resident bank",
+                    tag_keys=tags),
+                "adapter_misses": Counter(
+                    "serve_adapter_cache_misses_total",
+                    "adapter resolutions that paid a store fetch",
+                    tag_keys=tags),
+                "adapter_load": Histogram(
+                    "serve_adapter_load_seconds",
+                    "adapter store-fetch + bank-install latency",
+                    boundaries=_TTFT_BOUNDARIES, tag_keys=tags),
+                "adapter_resident": Gauge(
+                    "serve_adapter_resident",
+                    "tenant adapters resident in the bank",
+                    tag_keys=tags),
             }
         return self._metrics
 
@@ -503,6 +560,29 @@ class InferTelemetry:
             if metrics is not None:
                 metrics["store_evictions"].inc(
                     float(n), tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_adapter_cache(self, hit: bool):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                key = "adapter_hits" if hit else "adapter_misses"
+                metrics[key].inc(1.0, tags={"label": self.label})
+        except Exception:  # noqa: BLE001 — never tax the serve loop
+            self._metrics_dead = True
+
+    def _emit_adapter_load(self, wall_s: float, resident: int):
+        if self._metrics_dead:
+            return
+        try:
+            metrics = self._metric_objects()
+            if metrics is not None:
+                tags = {"label": self.label}
+                metrics["adapter_load"].observe(wall_s, tags=tags)
+                metrics["adapter_resident"].set(resident, tags=tags)
         except Exception:  # noqa: BLE001 — never tax the serve loop
             self._metrics_dead = True
 

@@ -85,7 +85,16 @@ itself).  Current sites:
   installs a DRAM/store hit back into HBM, or ``:delay=`` stretches
   the fetch (a slow object-store read).  A fault stops the install
   walk at that page and the suffix prefill covers the rest — greedy
-  continuations stay bit-exact vs the unfaulted run.
+  continuations stay bit-exact vs the unfaulted run;
+- ``serve.adapter_load`` — the r25 multi-tenant adapter-cache miss
+  leg: fires as a replica resolves a request's ``model_id`` that is
+  not yet resident in its LoRA bank (cache hits never pay the site),
+  before the store checkout, or ``:delay=`` stretches the load (a
+  slow adapter fetch).  A fault surfaces as the typed
+  ``AdapterUnavailableError``: submit-time rejections re-route to
+  another replica, a resolution-time fault retires the waiting
+  request with the error on its stream — either way degraded, never
+  a hang — and resident tenants keep decoding untouched.
 
 Spec grammar: comma-separated entries::
 

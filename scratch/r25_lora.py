@@ -1,0 +1,71 @@
+"""Round-25 on-chip driver: multi-tenant LoRA serving — adapters as
+call args, serve-side multiplexing, adapter-only RL publishing.
+
+Usage: python scratch/r25_lora.py <variant>
+
+Variants:
+  lora  — multi-tenant A/B: `bench.py --infer --lora`.  Two
+          experiments: (1) a tenant-count sweep (base / 1 / 8 / 64
+          tenants through an 8-slot bank) — host-sim shows the flat
+          per-token cost of resident tenants (1-tenant within ~1% of
+          base) and the churn regime's eviction/reload tax (64
+          tenants: every request a store load), with compile counters
+          frozen in every arm (the bank is a call arg, never
+          exec-key material); (2) the router A/B — adapter-affinity
+          vs residency-blind over 6 tenants x 2 replicas (host-sim:
+          0.83 vs 0.67 cache hit rate, 6 vs 12 store loads).  The
+          chip questions: what the grouped-gather bank actually costs
+          per decode step at serving batch sizes (host-sim's 15%
+          8-tenant delta is dominated by the eager `.at[].set`
+          installs, not the gather), where the churn knee lands once
+          HBM-resident banks are large (RAY_TPU_ADAPTER_CACHE swept
+          against tenant count), and whether adapter-only republish
+          (17x fewer bytes than full params here; ~`2*r/d_model`x in
+          general) keeps mid-traffic RL publication off the decode
+          critical path on a real fleet.
+  trace — r24 per-request tracing report: `bench.py --infer --trace`
+          (no r24 driver exists; carried here).
+
+Carried arms (no chip session yet; every r06-r23 row in docs/PERF.md
+is still pending, so the first session runs everything from here):
+tiers plus all r6-r22 arms — delegated verbatim to
+scratch/r23_tiers.py.
+"""
+import os
+import subprocess
+import sys
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "lora"
+
+_R23_ARMS = ("tiers",
+             "dcn", "pp",
+             "spec",
+             "disagg",
+             "gray", "straggle",
+             "elastic", "accum",
+             "data", "resume",
+             "affinity", "kill",
+             "ckpt", "recover",
+             "rl", "swap",
+             "fuse", "subsmoke",
+             "prefix", "evict",
+             "kv8", "commq", "bytes",
+             "engine", "decode", "slots", "xplane", "timeline",
+             "overlap", "gspmd", "ring", "pack2ab", "flash", "noremat",
+             "ce", "b28", "b32", "b28x", "b32x", "bv512", "bn2048")
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if VARIANT in _R23_ARMS:
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(HERE, "r23_tiers.py"), VARIANT]
+        + sys.argv[2:]).returncode)
+
+if VARIANT == "trace":
+    sys.exit(subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+         "--trace"] + sys.argv[2:]).returncode)
+
+assert VARIANT == "lora", f"unknown variant {VARIANT!r}"
+sys.exit(subprocess.run(
+    [sys.executable, os.path.join(ROOT, "bench.py"), "--infer",
+     "--lora"] + sys.argv[2:]).returncode)

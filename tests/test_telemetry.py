@@ -481,6 +481,31 @@ def test_infer_telemetry_tier_summary():
     assert off.summary() == {"enabled": False}
 
 
+def test_infer_telemetry_adapter_summary():
+    """r25: adapter-cache lookups and load walls fold into an
+    ``adapters`` summary block — absent when no tenant ever looked
+    one up."""
+    from ray_tpu.telemetry import InferTelemetry
+    from ray_tpu.telemetry.config import TelemetryConfig
+
+    tel = InferTelemetry(config=TelemetryConfig(enabled=True))
+    assert "adapters" not in tel.summary()
+    tel.record_adapter_cache(hit=True)
+    tel.record_adapter_cache(hit=True)
+    tel.record_adapter_cache(hit=False)
+    tel.record_adapter_load(0.01, resident=2)
+    out = tel.summary()["adapters"]
+    assert out["cache_hits"] == 2
+    assert out["cache_misses"] == 1
+    assert abs(out["cache_hit_rate"] - 2 / 3) < 1e-9
+    assert out["loads"] == 1
+    assert abs(out["load_seconds"] - 0.01) < 1e-9
+    off = InferTelemetry(config=TelemetryConfig(enabled=False))
+    off.record_adapter_cache(hit=True)
+    off.record_adapter_load(0.01, resident=1)
+    assert off.summary() == {"enabled": False}
+
+
 @pytest.mark.slow
 def test_telemetry_overhead_under_one_percent():
     """Acceptance budget: telemetry-on steady-state step time exceeds
@@ -602,6 +627,9 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     infer.record_kv_spill(4096)
     infer.record_kv_fetch(0.002, tier="dram")
     infer.record_tier_occupancy(hbm=5, dram=2, store=7)
+    infer.record_adapter_cache(hit=True)
+    infer.record_adapter_cache(hit=False)
+    infer.record_adapter_load(0.01, resident=2)
     data = DataTelemetry(config=on)
     data.record_batch(128, 0.2, queue_depth=2)
     data.record_stall(0.003)
@@ -676,3 +704,9 @@ def test_dashboard_timeline_and_metrics_show_train_steps(
     assert "user_histogram_infer_kv_fetch_seconds_bucket" in text
     assert "infer_kv_tier_pages" in text
     assert 'tier="hbm"' in text and 'tier="dram"' in text
+    # r25 multi-tenant adapter series: cache hit/miss counters, the
+    # load-wall histogram, the resident-adapter gauge
+    assert "serve_adapter_cache_hits_total" in text
+    assert "serve_adapter_cache_misses_total" in text
+    assert "user_histogram_serve_adapter_load_seconds_bucket" in text
+    assert "serve_adapter_resident" in text
