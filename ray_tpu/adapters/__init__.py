@@ -16,9 +16,11 @@ multi-tenant seam across the stack:
   backed like ``WeightStore``/``KVPageStore``), keyed
   ``(model_id, version)`` with a monotonic per-model latest pointer.
 - :mod:`~ray_tpu.adapters.registry` — :class:`AdapterRegistry`, the
-  per-engine resident-adapter bookkeeping: which ``model_id`` sits in
-  which bank slot, LRU over unpinned residents, pins from in-flight
-  requests so an adapter mid-decode can never be evicted under it.
+  per-engine resident-adapter bookkeeping: which ``(model_id,
+  version)`` sits in which bank slot, LRU over unpinned residents,
+  pins from in-flight requests so factors mid-decode can never be
+  evicted or rewritten under the requests using them (a republish
+  lands in a fresh row until the old version's pins drain).
 - :mod:`~ray_tpu.adapters.config` — :class:`LoraConfig` and the
   ``RAY_TPU_LORA_*`` / ``RAY_TPU_ADAPTER_CACHE`` env knobs.
 

@@ -131,6 +131,39 @@ def bank_install(bank: Dict[str, Any], slot: int, adapter: Dict[str, Any],
     return out
 
 
+def bank_mismatch(bank: Dict[str, Any],
+                  adapter: Any) -> Optional[str]:
+    """Reason ``adapter``'s factors cannot install into ``bank``
+    (wrong rank / target set / layer dims), else None.
+
+    The serving engine gates every store fetch through this before
+    ``bank_install``: a tenant publishing factors of a different
+    geometry must surface as a typed per-request
+    ``AdapterUnavailableError``, not as a jax shape error escaping the
+    replica's step loop.  Targets *absent* from the adapter are fine
+    (the install zeroes them); targets the bank does not carry are a
+    mismatch — silently dropping them would diverge from the merged
+    oracle."""
+    if not isinstance(adapter, dict):
+        return (f"payload is {type(adapter).__name__}, "
+                "expected a factor dict")
+    targets = tuple(k for k in bank if k != "scale")
+    for k, v in adapter.items():
+        if k == "scale":
+            continue
+        ref = bank.get(k)
+        if ref is None:
+            return (f"factor {k!r} has no matching bank target "
+                    f"(bank carries {targets})")
+        shape = tuple(getattr(v, "shape", None)
+                      or np.asarray(v).shape)
+        row = tuple(ref.shape[1:])
+        if shape != row:
+            return (f"factor {k!r} shape {shape} != bank row "
+                    f"shape {row}")
+    return None
+
+
 def bank_clear(bank: Dict[str, Any], slot: int) -> Dict[str, Any]:
     """Zero a slot back to identity (evict without replacement)."""
     out = dict(bank)

@@ -137,9 +137,19 @@ class AdapterStore:
         if mat is not None:
             return version, mat, scale
         from ray_tpu.object_ref import ObjectRef
-        if isinstance(payload, ObjectRef):
-            import ray_tpu
-            payload = ray_tpu.get(payload)
+        try:
+            if isinstance(payload, ObjectRef):
+                import ray_tpu
+                payload = ray_tpu.get(payload)
+        except Exception as err:
+            # a failed materialization must not strand the pin:
+            # in_flight is the leak-audit counter, and a fetch that
+            # raised has nothing to check in later
+            with self._lock:
+                self.in_flight -= 1
+            raise AdapterUnavailableError(
+                model_id, f"object-store fetch of version {version} "
+                f"failed: {err}") from err
         with self._lock:
             self._mat[(model_id, version)] = payload
         return version, payload, scale

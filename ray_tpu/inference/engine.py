@@ -415,36 +415,58 @@ class InferenceEngine:
 
     # ---------------------------------------- multi-tenant LoRA (r25)
     def _adapter_release(self, req: Request) -> None:
-        """Drop a retiring request's pin on its tenant (idempotent:
-        the slot resets so double-retire paths can't double-unpin)."""
+        """Drop a retiring request's pin on its exact (tenant,
+        version) (idempotent: the slot resets so double-retire paths
+        can't double-unpin)."""
         if req.adapter_slot > 0 and self.adapters is not None:
-            self.adapters.unpin(req.model_id)
+            self.adapters.unpin(req.model_id, req.adapter_version)
         req.adapter_slot = 0
 
+    def _check_adapter(self, model_id: str, adapter) -> None:
+        """Gate factors against the bank geometry BEFORE the install:
+        a tenant publishing a different rank/target set/dims must
+        surface as the typed per-request error, never as a jax shape
+        error escaping step() and killing the replica for everyone."""
+        why = lora_mod.bank_mismatch(self.lora_bank, adapter)
+        if why is not None:
+            raise AdapterUnavailableError(
+                model_id, "published factors do not fit the serving "
+                f"bank: {why}")
+
     def _load_adapter(self, model_id: str,
-                      version: Optional[int] = None) -> Tuple[int, int]:
+                      version: Optional[int] = None, *,
+                      pin: bool = False) -> Tuple[int, int]:
         """Resolve ``model_id`` to a resident bank slot -> ``(slot,
         installed version)``, loading through the adapter store on a
-        miss (or a version bump: ``version=None`` tracks the store's
-        latest, so a mid-traffic republish reloads in place).  The
-        install is an eager ``.at[].set`` over the bank call-arg —
-        compile counters never move.  Fault site ``serve.adapter_load``
-        fires on the load leg only (cache hits are unaffected) and
-        surfaces as the typed :class:`AdapterUnavailableError`."""
+        miss (``version=None`` tracks the store's latest; a republish
+        lands in a *fresh* row, never over a pinned one).  The install
+        is an eager ``.at[].set`` over the bank call-arg — compile
+        counters never move.  ``pin=True`` pins the resolved (tenant,
+        version) under the same lock acquisition that resolves it, so
+        the row cannot vanish between resolution and admission.  Fault
+        site ``serve.adapter_load`` fires on the load leg only (cache
+        hits are unaffected) and surfaces as the typed
+        :class:`AdapterUnavailableError`.  Takes ``self._lock``
+        internally — callers must NOT hold it: the store checkout can
+        block on an object-store fetch, and submit()/cancel()/stats()
+        must not stall behind it."""
         reg = self.adapters
-        ent = reg.lookup(model_id)
         want = version
         if want is None and self.adapter_store is not None:
             want = self.adapter_store.latest_version(model_id)
-        if ent is not None and (want is None or ent[1] == want):
-            reg.touch(model_id)
-            reg.hits += 1
-            if self.telemetry.enabled:
-                self.telemetry.record_adapter_cache(hit=True)
-            return ent
-        reg.misses += 1
+        with self._lock:
+            ent = reg.lookup(model_id, want)
+            if ent is not None:
+                reg.touch(model_id, ent[1])
+                if pin:
+                    reg.pin(model_id, ent[1])
+                reg.hits += 1
+            else:
+                reg.misses += 1
         if self.telemetry.enabled:
-            self.telemetry.record_adapter_cache(hit=False)
+            self.telemetry.record_adapter_cache(hit=ent is not None)
+        if ent is not None:
+            return ent
         from ray_tpu.util import chaos
         try:
             chaos.maybe_fail("serve.adapter_load")
@@ -458,9 +480,13 @@ class InferenceEngine:
         t0 = time.monotonic()
         got, adapter, scale = self.adapter_store.checkout(model_id, want)
         try:
-            slot, _evicted = reg.place(model_id, got)
-            self.lora_bank = lora_mod.bank_install(
-                self.lora_bank, slot, adapter, scale=scale)
+            self._check_adapter(model_id, adapter)
+            with self._lock:
+                slot, _evicted = reg.place(model_id, got)
+                self.lora_bank = lora_mod.bank_install(
+                    self.lora_bank, slot, adapter, scale=scale)
+                if pin:
+                    reg.pin(model_id, got)
         finally:
             self.adapter_store.checkin()
         wall = time.monotonic() - t0
@@ -481,10 +507,12 @@ class InferenceEngine:
             raise AdapterUnavailableError(
                 model_id, "engine built without adapter support "
                 "(RAY_TPU_LORA / lora=)")
-        slot, _evicted = self.adapters.place(model_id, int(version))
-        self.lora_bank = lora_mod.bank_install(
-            self.lora_bank, slot, adapter, scale=scale)
-        self.adapters.loads += 1
+        self._check_adapter(model_id, adapter)
+        with self._lock:
+            slot, _evicted = self.adapters.place(model_id, int(version))
+            self.lora_bank = lora_mod.bank_install(
+                self.lora_bank, slot, adapter, scale=scale)
+            self.adapters.loads += 1
         return slot
 
     def _resolve_adapters(self, events: List["StepEvent"]) -> None:
@@ -493,31 +521,34 @@ class InferenceEngine:
         mutation).  Resolution sets the prefix-chain salt — it MUST
         land before ``_prefix_walk`` first hashes the prompt, so
         adapter K/V never aliases base K/V.  A failed load retires the
-        request with the typed error — degraded, never hung."""
+        request with the typed error — degraded, never hung.  The
+        engine lock is held only around registry/scheduler mutations,
+        NOT across the store fetch (``_load_adapter`` takes it at the
+        right points itself)."""
         if self.lora_cfg is None:
             return
-        failed: List[Request] = []
         with self._lock:
-            for req in list(self.scheduler.waiting):
-                if req.adapter_slot != -1:
-                    continue
-                try:
-                    slot, got = self._load_adapter(
-                        req.model_id, req.adapter_version or None)
-                except AdapterUnavailableError as err:
-                    self.scheduler.waiting.remove(req)
-                    req.error = err
-                    req.done = True
+            pending = [r for r in self.scheduler.waiting
+                       if r.adapter_slot == -1]
+        for req in pending:
+            try:
+                slot, got = self._load_adapter(
+                    req.model_id, req.adapter_version or None,
+                    pin=True)
+            except AdapterUnavailableError as err:
+                with self._lock:
+                    if req in self.scheduler.waiting:
+                        self.scheduler.waiting.remove(req)
                     self._requests.pop(req.rid, None)
-                    failed.append(req)
-                    continue
-                req.adapter_slot = slot
-                req.adapter_version = got
-                req.hash_salt = salt_bytes(req.model_id, got)
-                self.adapters.pin(req.model_id)
-        for req in failed:
-            events.append(StepEvent(req.rid, -1, True, 0.0,
-                                    error=req.error))
+                req.error = err
+                req.done = True
+                events.append(StepEvent(req.rid, -1, True, 0.0,
+                                        error=err))
+                continue
+            # req fields are read by this (the step) thread only
+            req.adapter_slot = slot
+            req.adapter_version = got
+            req.hash_salt = salt_bytes(req.model_id, got)
 
     def adapter_digest(self) -> frozenset:
         """Resident tenant model_ids — the router composes this into
@@ -569,22 +600,25 @@ class InferenceEngine:
         if len(prompt) > self.buckets[-1]:
             raise ValueError(f"prompt length {len(prompt)} exceeds the "
                              f"largest prefill bucket {self.buckets[-1]}")
-        # multi-tenant (r25): validate the tenant up front — a typed
-        # submit-time rejection the router can re-route — but defer the
-        # actual bank load to step() (``_resolve_adapters``), the only
-        # thread that may mutate the bank
         model_id = sampling.model_id if sampling is not None else None
-        if model_id:
-            if self.lora_cfg is None:
-                raise AdapterUnavailableError(
-                    model_id, "engine built without adapter support "
-                    "(RAY_TPU_LORA / lora=)")
-            if (self.adapters.lookup(model_id) is None
-                    and (self.adapter_store is None
-                         or model_id not in self.adapter_store)):
-                raise AdapterUnavailableError(
-                    model_id, "never published to the adapter store")
         with self._lock:
+            # multi-tenant (r25): validate the tenant up front — a
+            # typed submit-time rejection the router can re-route —
+            # but defer the actual bank load to step()
+            # (``_resolve_adapters``), the only thread that may mutate
+            # the bank.  Under the lock so the residency probe can't
+            # race a concurrent step()'s eviction/install.
+            if model_id:
+                if self.lora_cfg is None:
+                    raise AdapterUnavailableError(
+                        model_id, "engine built without adapter "
+                        "support (RAY_TPU_LORA / lora=)")
+                if (self.adapters.lookup(model_id) is None
+                        and (self.adapter_store is None
+                             or model_id not in self.adapter_store)):
+                    raise AdapterUnavailableError(
+                        model_id, "never published to the adapter "
+                        "store")
             rid = self._next_rid
             self._next_rid += 1
             req = Request(rid=rid, prompt=prompt,

@@ -200,26 +200,53 @@ def test_adapter_registry_lru_eviction_and_pin_discipline():
     s1, ev = reg.place("a", 1)
     s2, _ = reg.place("b", 1)
     assert {s1, s2} == {1, 2} and ev is None
-    reg.touch("a")                       # LRU order is now b, a
+    reg.touch("a", 1)                    # LRU order is now b, a
     s3, evicted = reg.place("c", 1)
     assert evicted == "b" and s3 == s2   # b's row is recycled
     assert set(reg.resident_ids) == {"a", "c"}
-    # a version bump keeps the row (no eviction)
+    # an unpinned version bump retires the stale row and recycles it
     slot_a = reg.lookup("a")[0]
     same, ev = reg.place("a", 2)
     assert same == slot_a and ev is None and reg.lookup("a") == (slot_a, 2)
+    assert reg.lookup("a", 1) is None    # v1 retired with the bump
     # everything pinned -> typed error, never a hang
-    reg.pin("a")
-    reg.pin("c")
+    reg.pin("a", 2)
+    reg.pin("c", 1)
     with pytest.raises(AdapterUnavailableError, match="pinned"):
         reg.place("d", 1)
-    reg.unpin("a")
+    reg.unpin("a", 2)
     slot_d, evicted = reg.place("d", 1)
     assert evicted == "a" and slot_d == slot_a
-    reg.unpin("c")
+    reg.unpin("c", 1)
     assert reg.pinned_total == 0
     with pytest.raises(RuntimeError, match="without a pin"):
-        reg.unpin("c")
+        reg.unpin("c", 1)
+
+
+def test_adapter_registry_pinned_republish_gets_fresh_slot():
+    """A version republish while the old version is pinned by
+    in-flight requests must NOT rewrite the pinned row: the new
+    version lands in a different slot, both stay addressable by exact
+    version, and the stale row only becomes evictable once its pins
+    drain."""
+    from ray_tpu.adapters import AdapterRegistry, AdapterUnavailableError
+    reg = AdapterRegistry(cache_slots=2)
+    s_old, _ = reg.place("a", 1)
+    reg.pin("a", 1)
+    s_new, ev = reg.place("a", 2)
+    assert s_new != s_old and ev is None
+    assert reg.lookup("a", 1) == (s_old, 1)   # pinned factors intact
+    assert reg.lookup("a", 2) == (s_new, 2)
+    assert reg.lookup("a") == (s_new, 2)      # unversioned -> newest
+    # the pinned row can never be re-placed in place either
+    with pytest.raises(AdapterUnavailableError, match="pinned"):
+        reg.place("a", 1)
+    # pins drained: v1 is ordinary LRU prey, v2 survives
+    reg.unpin("a", 1)
+    s_b, evicted = reg.place("b", 1)
+    assert s_b == s_old and evicted is None   # "a" still resident (v2)
+    assert reg.lookup("a") == (s_new, 2)
+    assert reg.pinned_total == 0
 
 
 # --------------------------------------------------- engine parity battery
@@ -360,6 +387,72 @@ def test_hot_load_and_republish_keep_compiles_frozen(tiny_f32, adapters):
     del rid_live
 
 
+def test_republish_mid_decode_keeps_pinned_version_factors(tiny_f32,
+                                                           adapters):
+    """A request decoding under v1 when the tenant republishes v2 —
+    with a co-batched latest-tracking request resolving v2 while the
+    v1 pin is live — must finish under v1's EXACT factors: the new
+    version lands in a fresh bank row, never over the pinned one."""
+    store = _store_with(adapters, ids=("t1",))   # v1 = t1's factors
+    eng = _engine(tiny_f32, lora=_lcfg(), adapter_store=store)
+    cfg, _ = tiny_f32
+    p1 = _prompt(8, cfg.vocab_size, seed=13)
+    p2 = _prompt(8, cfg.vocab_size, seed=14)
+    expect_v1 = _engine(tiny_f32, params=_merged(
+        tiny_f32, adapters["t1"])).generate([p1], 8, _greedy())[0]
+    expect_v2 = _engine(tiny_f32, params=_merged(
+        tiny_f32, adapters["t2"])).generate([p2], 4, _greedy())[0]
+
+    rid1 = eng.submit(p1, 8, _greedy("t1"))
+    out = {rid1: []}
+    republished = False
+    while eng.has_work():
+        for (rid, tok, _d) in eng.step():
+            out[rid].append(tok)
+        if not republished:
+            republished = True
+            store.put("t1", adapters["t2"], scale=0.5)  # v2 factors
+            rid2 = eng.submit(p2, 4, _greedy("t1"))     # tracks v2
+            out[rid2] = []
+    assert out[rid1] == expect_v1     # v1 pin survived the republish
+    assert out[rid2] == expect_v2     # v2 resolved alongside, fresh row
+    assert eng.leak_free()
+
+
+def test_bad_geometry_publish_is_typed_not_fatal(tiny_f32, adapters):
+    """A tenant publishing factors of the wrong rank/targets must
+    retire only that tenant's request with the typed error — the
+    replica's step loop and its other tenants keep serving."""
+    import jax
+
+    from ray_tpu.adapters import AdapterUnavailableError, init_adapter
+    cfg, _ = tiny_f32
+    store = _store_with(adapters, ids=("t1",))
+    store.put("bad", init_adapter(cfg, _lcfg(rank=7),
+                                  jax.random.PRNGKey(9), random_b=True))
+    eng = _engine(tiny_f32, lora=_lcfg(), adapter_store=store)
+    p = _prompt(8, cfg.vocab_size, seed=15)
+    rid_bad = eng.submit(p, 4, _greedy("bad"))
+    rid_ok = eng.submit(p, 4, _greedy("t1"))
+    got_ok, bad_err = [], None
+    while eng.has_work():
+        for ev in eng.step():
+            rid, tok, _d = ev
+            if rid == rid_bad and ev.error is not None:
+                bad_err = ev.error
+            elif rid == rid_ok and ev.error is None:
+                got_ok.append(tok)
+    assert isinstance(bad_err, AdapterUnavailableError)
+    assert "do not fit" in str(bad_err)
+    assert len(got_ok) == 4
+    assert eng.leak_free()
+    assert store.stats()["in_flight"] == 0
+    # the direct-install path is gated by the same check
+    with pytest.raises(AdapterUnavailableError, match="do not fit"):
+        eng.load_adapter("bad2", init_adapter(
+            cfg, _lcfg(rank=7), jax.random.PRNGKey(10)))
+
+
 def test_submit_rejections_are_typed(tiny_f32, adapters):
     from ray_tpu.adapters import AdapterUnavailableError
     cfg, _ = tiny_f32
@@ -418,9 +511,9 @@ def test_leak_audit_covers_adapter_pins_and_store(tiny_f32, adapters):
     cfg, _ = tiny_f32
     eng.generate([_prompt(6, cfg.vocab_size)], 4, _greedy("t1"))
     assert eng.leak_free()
-    eng.adapters.pin("t1")                   # orphan pin
+    eng.adapters.pin("t1", 1)                # orphan pin
     assert not eng.leak_free()
-    eng.adapters.unpin("t1")
+    eng.adapters.unpin("t1", 1)
     assert eng.leak_free()
     store.checkout("t1")                     # un-checked-in fetch
     assert not eng.leak_free()
